@@ -1,0 +1,49 @@
+"""mmlspark_tpu — a TPU-native ML pipeline framework.
+
+A brand-new, TPU-first re-imagining of MMLSpark (Microsoft Machine Learning
+for Apache Spark): composable Transformer/Estimator stages over schema'd
+columnar data, deep-network inference and distributed training on JAX/XLA
+via pjit over device meshes, a native histogram gradient-boosting engine,
+image ingestion/transforms, transfer learning, HTTP client + streaming
+serving, and an AutoML convenience tier — with zero CUDA dependency.
+
+Reference parity: kangyangyang520/mmlspark (see SURVEY.md). Citations to the
+reference appear in docstrings as ``ref: <path>:<line>``.
+"""
+
+from mmlspark_tpu.version import __version__
+
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.core.schema import (
+    Schema,
+    Field,
+    ImageSchema,
+    BinaryFileSchema,
+)
+from mmlspark_tpu.core.stage import (
+    PipelineStage,
+    Transformer,
+    Estimator,
+    Model,
+    Pipeline,
+    PipelineModel,
+    load_stage,
+)
+from mmlspark_tpu.core.params import Param
+
+__all__ = [
+    "__version__",
+    "DataTable",
+    "Schema",
+    "Field",
+    "ImageSchema",
+    "BinaryFileSchema",
+    "PipelineStage",
+    "Transformer",
+    "Estimator",
+    "Model",
+    "Pipeline",
+    "PipelineModel",
+    "load_stage",
+    "Param",
+]

@@ -1,0 +1,36 @@
+"""Namespaced logging (ref: src/core/env/src/main/scala/Logging.scala:14-23).
+
+Loggers are namespaced ``mmlspark_tpu.<subspace>`` like the reference's
+``mmlspark.<subspace>`` log4j2 hierarchy.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_ROOT = "mmlspark_tpu"
+_configured = False
+
+
+def _ensure_configured():
+    global _configured
+    if _configured:
+        return
+    root = logging.getLogger(_ROOT)
+    if not root.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"))
+        root.addHandler(handler)
+    from mmlspark_tpu.core import config
+    level = config.get("log_level", "INFO")  # env wins inside config.get
+    root.setLevel(getattr(logging, str(level).upper(), logging.INFO))
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(subspace: str = "") -> logging.Logger:
+    _ensure_configured()
+    name = f"{_ROOT}.{subspace}" if subspace else _ROOT
+    return logging.getLogger(name)

@@ -1,0 +1,38 @@
+"""Metric name constants (ref: src/core/metrics/src/main/scala/MetricConstants.scala:9-83)."""
+
+from __future__ import annotations
+
+# regression
+MSE = "mse"
+RMSE = "rmse"
+R2 = "r2"
+MAE = "mae"
+REGRESSION_METRICS = [MSE, RMSE, R2, MAE]
+
+# classification
+AUC = "auc"
+ACCURACY = "accuracy"
+PRECISION = "precision"
+RECALL = "recall"
+F1 = "f1"
+CLASSIFICATION_METRICS = [AUC, ACCURACY, PRECISION, RECALL, F1]
+
+CONFUSION_MATRIX = "confusion_matrix"
+
+# per-instance (ref: MetricConstants.scala per-instance L1/L2/log_loss)
+L1_LOSS = "l1_loss"
+L2_LOSS = "l2_loss"
+LOG_LOSS = "log_loss"
+
+ALL_METRICS = "all"
+
+CLASSIFICATION_EVALUATION = "classification"
+REGRESSION_EVALUATION = "regression"
+
+
+def is_classification_metric(name: str) -> bool:
+    return name in CLASSIFICATION_METRICS or name == CONFUSION_MATRIX
+
+
+def is_regression_metric(name: str) -> bool:
+    return name in REGRESSION_METRICS

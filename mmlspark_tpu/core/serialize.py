@@ -1,0 +1,284 @@
+"""Stage persistence, including non-JSON ("complex") params.
+
+TPU-native analog of the reference's core/serialize layer
+(ref: src/core/serialize/src/main/scala/ComplexParam.scala,
+ConstructorWriter.scala:22-90, Serializer.scala:26-160 and the 14 typed
+params under serialize/params/). Every stage — including models holding
+weights, nested stages, tables, UDFs — round-trips through
+``save_stage``/``load_stage``.
+
+Layout::
+
+    path/
+      metadata.json        class, uid, json params, complex-param kinds
+      complex/<name>/...   one subdir/file per complex param, by handler
+
+Handlers are keyed by a "kind" string recorded at save time, so load never
+guesses from file extensions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+from typing import Any, Dict, List
+
+import numpy as np
+
+from mmlspark_tpu.version import __version__
+
+SERIALIZATION_FORMAT_VERSION = 1
+
+
+# ---------------------------------------------------------------------------
+# complex value handlers
+# ---------------------------------------------------------------------------
+
+
+def _is_stage(v) -> bool:
+    from mmlspark_tpu.core.stage import PipelineStage
+    return isinstance(v, PipelineStage)
+
+
+def _is_table(v) -> bool:
+    from mmlspark_tpu.core.table import DataTable
+    return isinstance(v, DataTable)
+
+
+def _kind_of(value: Any) -> str:
+    """Pick the handler kind for a complex value."""
+    if _is_stage(value):
+        return "stage"
+    if _is_table(value):
+        return "table"
+    if isinstance(value, np.ndarray):
+        return "ndarray"
+    if isinstance(value, (list, tuple)) and value and all(_is_stage(v) for v in value):
+        return "stage_list"
+    if isinstance(value, dict) and _looks_like_pytree(value):
+        return "pytree"
+    if callable(value):
+        return "callable"
+    return "pickle"
+
+
+def _looks_like_pytree(d: dict) -> bool:
+    """True if every leaf is an array/scalar — i.e. model weights."""
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves(d)
+    except Exception:
+        return False
+    if not leaves:
+        return False
+    return all(isinstance(l, (np.ndarray, np.generic, int, float, bool))
+               or type(l).__module__.startswith("jax")
+               for l in leaves)
+
+
+def save_complex(value: Any, path: str) -> str:
+    """Save a complex value under ``path``; returns the handler kind."""
+    kind = _kind_of(value)
+    os.makedirs(path, exist_ok=True)
+    if kind == "stage":
+        save_stage(value, os.path.join(path, "stage"))
+    elif kind == "stage_list":
+        with open(os.path.join(path, "count.json"), "w") as f:
+            json.dump({"n": len(value)}, f)
+        for i, st in enumerate(value):
+            save_stage(st, os.path.join(path, f"stage_{i}"))
+    elif kind == "table":
+        value.save(os.path.join(path, "table"))
+    elif kind == "ndarray":
+        np.save(os.path.join(path, "array.npy"), value, allow_pickle=False)
+    elif kind == "pytree":
+        _save_pytree(value, path)
+    else:  # callable / pickle
+        with open(os.path.join(path, "value.pkl"), "wb") as f:
+            pickle.dump(value, f)
+    return kind
+
+
+def load_complex(kind: str, path: str) -> Any:
+    if kind == "stage":
+        return load_stage(os.path.join(path, "stage"))
+    if kind == "stage_list":
+        with open(os.path.join(path, "count.json")) as f:
+            n = json.load(f)["n"]
+        return [load_stage(os.path.join(path, f"stage_{i}")) for i in range(n)]
+    if kind == "table":
+        from mmlspark_tpu.core.table import DataTable
+        return DataTable.load(os.path.join(path, "table"))
+    if kind == "ndarray":
+        return np.load(os.path.join(path, "array.npy"), allow_pickle=False)
+    if kind == "pytree":
+        return _load_pytree(path)
+    with open(os.path.join(path, "value.pkl"), "rb") as f:
+        return pickle.load(f)
+
+
+def _save_pytree(tree: Any, path: str) -> None:
+    """Weights pytree → npz of leaves + a JSON structure skeleton.
+
+    The skeleton records container kinds (dict/list/tuple) and python
+    scalar leaf types exactly, so the loaded tree has the same treedef as
+    the original (tuples stay tuples, scalars stay scalars)."""
+    leaves: List[np.ndarray] = []
+
+    def encode(node: Any) -> Any:
+        if isinstance(node, dict):
+            return {"t": "dict",
+                    "items": {str(k): encode(v) for k, v in node.items()}}
+        if isinstance(node, tuple):
+            return {"t": "tuple", "items": [encode(v) for v in node]}
+        if isinstance(node, list):
+            return {"t": "list", "items": [encode(v) for v in node]}
+        if node is None:
+            return {"t": "none"}
+        # leaf
+        idx = len(leaves)
+        py = None
+        if isinstance(node, bool):
+            py = "bool"
+        elif isinstance(node, int):
+            py = "int"
+        elif isinstance(node, float):
+            py = "float"
+        leaves.append(np.asarray(node))
+        return {"t": "leaf", "i": idx, "py": py}
+
+    skeleton = encode(tree)
+    np.savez(os.path.join(path, "leaves.npz"),
+             **{f"leaf_{i}": a for i, a in enumerate(leaves)})
+    with open(os.path.join(path, "treedef.json"), "w") as f:
+        json.dump({"skeleton": skeleton, "n": len(leaves)}, f)
+
+
+def _load_pytree(path: str) -> Any:
+    with open(os.path.join(path, "treedef.json")) as f:
+        meta = json.load(f)
+    npz = np.load(os.path.join(path, "leaves.npz"))
+
+    def decode(node: Any) -> Any:
+        t = node["t"]
+        if t == "dict":
+            return {k: decode(v) for k, v in node["items"].items()}
+        if t == "tuple":
+            return tuple(decode(v) for v in node["items"])
+        if t == "list":
+            return [decode(v) for v in node["items"]]
+        if t == "none":
+            return None
+        leaf = npz[f"leaf_{node['i']}"]
+        py = node.get("py")
+        if py == "bool":
+            return bool(leaf.item())
+        if py == "int":
+            return int(leaf.item())
+        if py == "float":
+            return float(leaf.item())
+        return leaf
+
+    return decode(meta["skeleton"])
+
+
+# ---------------------------------------------------------------------------
+# json-param encoding
+# ---------------------------------------------------------------------------
+
+
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, (np.bool_,)):
+        return bool(v)
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    return v
+
+
+# ---------------------------------------------------------------------------
+# stage save/load
+# ---------------------------------------------------------------------------
+
+
+def save_stage(stage, path: str, overwrite: bool = True) -> None:
+    from mmlspark_tpu.core.stage import PipelineStage
+    if not isinstance(stage, PipelineStage):
+        raise TypeError(f"not a PipelineStage: {stage!r}")
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    os.makedirs(path)
+
+    json_params: Dict[str, Any] = {}
+    complex_kinds: Dict[str, str] = {}
+    complex_dir = os.path.join(path, "complex")
+    for p in type(stage).params():
+        if p.name not in stage._paramMap:
+            continue
+        value = stage._paramMap[p.name]
+        if p.is_complex and value is not None:
+            kind = save_complex(value, os.path.join(complex_dir, p.name))
+            complex_kinds[p.name] = kind
+        else:
+            json_params[p.name] = _json_safe(value)
+
+    extra = {}
+    if hasattr(stage, "_save_extra"):
+        extra_dir = os.path.join(path, "extra")
+        os.makedirs(extra_dir, exist_ok=True)
+        extra = stage._save_extra(extra_dir) or {}
+
+    meta = {
+        "class": type(stage).__name__,
+        "module": type(stage).__module__,
+        "uid": stage.uid,
+        "library_version": __version__,
+        "format_version": SERIALIZATION_FORMAT_VERSION,
+        "params": json_params,
+        "complex_params": complex_kinds,
+        "extra": _json_safe(extra),
+    }
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+
+
+def load_stage(path: str):
+    from mmlspark_tpu.core.stage import STAGE_REGISTRY, PipelineStage
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    cls_name = meta["class"]
+    cls = STAGE_REGISTRY.get(cls_name)
+    if cls is None:
+        # attempt to import the declaring module, which registers the class
+        import importlib
+        try:
+            importlib.import_module(meta.get("module", ""))
+        except Exception:
+            pass
+        cls = STAGE_REGISTRY.get(cls_name)
+    if cls is None:
+        raise KeyError(f"stage class {cls_name!r} not registered; "
+                       f"import its module first")
+    stage: PipelineStage = cls.__new__(cls)
+    PipelineStage.__init__(stage)  # fresh uid + empty param map
+    stage.uid = meta["uid"]
+    for name, value in meta["params"].items():
+        try:
+            stage.set(name, value)
+        except KeyError:
+            pass  # forward-compat: ignore unknown params
+    for name, kind in meta["complex_params"].items():
+        value = load_complex(kind, os.path.join(path, "complex", name))
+        stage._paramMap[name] = value
+    if hasattr(stage, "_load_extra"):
+        stage._load_extra(os.path.join(path, "extra"), meta.get("extra", {}))
+    return stage

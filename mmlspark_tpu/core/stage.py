@@ -1,0 +1,262 @@
+"""PipelineStage / Transformer / Estimator / Pipeline.
+
+The single most important API decision inherited from the reference: every
+component is a Transformer (``.transform(table)``) or an Estimator
+(``.fit(table) -> Model``), so arbitrary composition happens through
+``Pipeline`` (ref: SURVEY.md §1 L3/L4 interface; SparkML Pipeline API).
+
+Stages auto-register by class name (``__init_subclass__``) for load-time
+resolution and for the structural fuzzing coverage test
+(ref: src/core/test/fuzzing/src/test/scala/FuzzingTest.scala:13).
+"""
+
+from __future__ import annotations
+
+import uuid as _uuid
+from typing import Any, Dict, List, Optional, Sequence, Type
+
+from mmlspark_tpu.core.params import Param, _NO_VALUE
+from mmlspark_tpu.core.schema import Schema
+from mmlspark_tpu.core.table import DataTable
+
+# global registry: class name -> class. Analog of JarLoadingUtils reflection
+# scanning (ref: src/core/utils/src/main/scala/JarLoadingUtils.scala).
+STAGE_REGISTRY: Dict[str, Type["PipelineStage"]] = {}
+
+
+def registered_stages() -> Dict[str, Type["PipelineStage"]]:
+    return dict(STAGE_REGISTRY)
+
+
+class PipelineStage:
+    """Base for all stages: typed params, uid, copy, save/load."""
+
+    def __init__(self, **kwargs):
+        self.uid = f"{type(self).__name__}_{_uuid.uuid4().hex[:12]}"
+        self._paramMap: Dict[str, Any] = {}
+        for k, v in kwargs.items():
+            p = self.param(k)
+            self.set(p, v)
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        STAGE_REGISTRY[cls.__name__] = cls
+
+    # -- param machinery ---------------------------------------------------
+
+    @classmethod
+    def _param_map_cls(cls) -> Dict[str, Param]:
+        """name -> Param for this class, cached per-class (classes are
+        static, so the MRO scan runs once)."""
+        cached = cls.__dict__.get("_params_cache")
+        if cached is not None:
+            return cached
+        seen: Dict[str, Param] = {}
+        for klass in reversed(cls.__mro__):
+            for k, v in vars(klass).items():
+                if isinstance(v, Param):
+                    seen[v.name or k] = v
+        cls._params_cache = seen
+        return seen
+
+    @classmethod
+    def params(cls) -> List[Param]:
+        """All Param descriptors declared on the class and its bases."""
+        return list(cls._param_map_cls().values())
+
+    @classmethod
+    def param(cls, name: str) -> Param:
+        p = cls._param_map_cls().get(name)
+        if p is None:
+            raise KeyError(f"{cls.__name__} has no param {name!r}")
+        return p
+
+    def set(self, param, value) -> "PipelineStage":
+        if isinstance(param, str):
+            param = self.param(param)
+        self._paramMap[param.name] = param.validate(value)
+        return self
+
+    def get(self, param) -> Any:
+        if isinstance(param, str):
+            param = self.param(param)
+        if param.name in self._paramMap:
+            return self._paramMap[param.name]
+        if param.has_default:
+            return param.default
+        raise KeyError(
+            f"param {param.name!r} of {type(self).__name__} is not set "
+            f"and has no default")
+
+    def get_or_none(self, param) -> Any:
+        try:
+            return self.get(param)
+        except KeyError:
+            return None
+
+    def is_set(self, param) -> bool:
+        if isinstance(param, str):
+            param = self.param(param)
+        return param.name in self._paramMap
+
+    def is_defined(self, param) -> bool:
+        if isinstance(param, str):
+            param = self.param(param)
+        return param.name in self._paramMap or param.has_default
+
+    def clear(self, param) -> "PipelineStage":
+        if isinstance(param, str):
+            param = self.param(param)
+        self._paramMap.pop(param.name, None)
+        return self
+
+    def copy(self, extra: Optional[Dict[str, Any]] = None) -> "PipelineStage":
+        import copy as _copy
+        other = type(self).__new__(type(self))
+        other.__dict__.update(
+            {k: v for k, v in self.__dict__.items() if k != "_paramMap"})
+        other._paramMap = dict(self._paramMap)
+        other.uid = self.uid
+        if extra:
+            for k, v in extra.items():
+                other.set(k, v)
+        return other
+
+    def explain_params(self) -> str:
+        lines = []
+        for p in type(self).params():
+            cur = self._paramMap.get(p.name, _NO_VALUE)
+            bits = [f"{p.name}: {p.doc}"]
+            if p.has_default:
+                bits.append(f"(default: {p.default!r})")
+            if cur is not _NO_VALUE:
+                bits.append(f"(current: {cur!r})")
+            lines.append(" ".join(bits))
+        return "\n".join(lines)
+
+    def _set_defaults(self, **kv) -> "PipelineStage":
+        for k, v in kv.items():
+            if not self.is_set(k):
+                self.set(k, v)
+        return self
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str, overwrite: bool = True) -> None:
+        from mmlspark_tpu.core import serialize
+        serialize.save_stage(self, path, overwrite=overwrite)
+
+    @classmethod
+    def load(cls, path: str) -> "PipelineStage":
+        from mmlspark_tpu.core import serialize
+        stage = serialize.load_stage(path)
+        if cls is not PipelineStage and not isinstance(stage, cls):
+            raise TypeError(
+                f"loaded {type(stage).__name__}, expected {cls.__name__}")
+        return stage
+
+    def __repr__(self):
+        set_params = ", ".join(
+            f"{k}={v!r}" for k, v in sorted(self._paramMap.items())
+            if not isinstance(v, (DataTable,)))
+        return f"{type(self).__name__}({set_params})"
+
+
+def load_stage(path: str) -> PipelineStage:
+    from mmlspark_tpu.core import serialize
+    return serialize.load_stage(path)
+
+
+class Transformer(PipelineStage):
+    """A table -> table stage."""
+
+    def transform(self, table: DataTable) -> DataTable:
+        raise NotImplementedError
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        """Validate/propagate the schema without touching data
+        (ref analog: PipelineStage.transformSchema)."""
+        return schema
+
+    def __call__(self, table: DataTable) -> DataTable:
+        return self.transform(table)
+
+
+class Estimator(PipelineStage):
+    """A table -> Model stage."""
+
+    def fit(self, table: DataTable) -> "Model":
+        raise NotImplementedError
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema
+
+
+class Model(Transformer):
+    """A fitted Transformer produced by an Estimator."""
+
+
+class Pipeline(Estimator):
+    """Chain of stages; fit() runs estimators in sequence feeding each the
+    output of the previous fitted prefix (SparkML Pipeline semantics)."""
+
+    from mmlspark_tpu.core.params import ComplexParam as _CP
+    stages = _CP("The stages of the pipeline", default=None)
+
+    def __init__(self, stages: Optional[Sequence[PipelineStage]] = None, **kw):
+        super().__init__(**kw)
+        if stages is not None:
+            self.set_stages(stages)
+
+    def set_stages(self, stages: Sequence[PipelineStage]) -> "Pipeline":
+        self.set("stages", list(stages))
+        return self
+
+    def get_stages(self) -> List[PipelineStage]:
+        return self.get("stages") or []
+
+    def fit(self, table: DataTable) -> "PipelineModel":
+        fitted: List[Transformer] = []
+        cur = table
+        stages = self.get_stages()
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                if i < len(stages) - 1:
+                    cur = model.transform(cur)
+            elif isinstance(stage, Transformer):
+                fitted.append(stage)
+                if i < len(stages) - 1:
+                    cur = stage.transform(cur)
+            else:
+                raise TypeError(f"stage {stage!r} is not Transformer/Estimator")
+        return PipelineModel(stages=fitted)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for stage in self.get_stages():
+            schema = stage.transform_schema(schema)
+        return schema
+
+
+class PipelineModel(Model):
+    from mmlspark_tpu.core.params import ComplexParam as _CP
+    stages = _CP("The fitted stages of the pipeline", default=None)
+
+    def __init__(self, stages: Optional[Sequence[Transformer]] = None, **kw):
+        super().__init__(**kw)
+        if stages is not None:
+            self.set("stages", list(stages))
+
+    def get_stages(self) -> List[Transformer]:
+        return self.get("stages") or []
+
+    def transform(self, table: DataTable) -> DataTable:
+        for stage in self.get_stages():
+            table = stage.transform(table)
+        return table
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for stage in self.get_stages():
+            schema = stage.transform_schema(schema)
+        return schema

@@ -1,0 +1,62 @@
+"""Async/concurrency helpers (ref: src/core/utils/src/main/scala/AsyncUtils.scala).
+
+``buffered_map`` reproduces the reference's bounded-concurrency buffered
+futures pattern used by the HTTP AsyncClient
+(ref: src/io/http/src/main/scala/Clients.scala:102-116): results stream in
+input order while at most ``concurrency`` tasks are in flight.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Iterable, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+def buffered_map(fn: Callable[[T], U], items: Iterable[T],
+                 concurrency: int = 8,
+                 timeout: Optional[float] = None) -> Iterator[U]:
+    """Map ``fn`` over ``items`` with a sliding window of futures,
+    yielding results in input order."""
+    items = iter(items)
+    with ThreadPoolExecutor(max_workers=concurrency) as pool:
+        window: list[Future] = []
+        try:
+            for _ in range(concurrency):
+                window.append(pool.submit(fn, next(items)))
+        except StopIteration:
+            pass
+        while window:
+            fut = window.pop(0)
+            try:
+                window.append(pool.submit(fn, next(items)))
+            except StopIteration:
+                pass
+            yield fut.result(timeout=timeout)
+
+
+def retry_with_backoff(fn: Callable[[], U],
+                       retries: int = 3,
+                       initial_delay: float = 0.1,
+                       backoff: float = 2.0,
+                       exceptions=(Exception,),
+                       on_retry: Optional[Callable[[Exception, int], None]] = None
+                       ) -> U:
+    """ref: downloader FaultToleranceUtils.retryWithTimeout
+    (ModelDownloader.scala:37-50) and HTTP retry
+    (HTTPClients.scala:47-97)."""
+    delay = initial_delay
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except exceptions as e:
+            if attempt == retries:
+                raise
+            if on_retry:
+                on_retry(e, attempt)
+            time.sleep(delay)
+            delay *= backoff
+    raise RuntimeError("unreachable")

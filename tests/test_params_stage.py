@@ -1,0 +1,140 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.params import (
+    BoolParam, ColParam, EnumParam, FloatParam, HasInputCol, HasOutputCol,
+    IntParam, Param, StringParam, range_domain,
+)
+from mmlspark_tpu.core.stage import (
+    Estimator, Model, Pipeline, PipelineModel, PipelineStage, Transformer,
+    STAGE_REGISTRY,
+)
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.testing.datagen import make_basic_table
+
+
+class AddConstant(Transformer, HasInputCol, HasOutputCol):
+    amount = FloatParam("amount to add", default=1.0)
+
+    def transform(self, table):
+        return table.with_column(
+            self.get_output_col(),
+            np.asarray(table[self.get_input_col()], dtype=np.float64)
+            + self.get("amount"))
+
+    def transform_schema(self, schema):
+        from mmlspark_tpu.core.schema import Field, F64
+        return schema.add_or_replace(Field(self.get_output_col(), F64))
+
+
+class MeanShift(Estimator, HasInputCol, HasOutputCol):
+    """Toy estimator: learns the column mean, subtracts it."""
+
+    def fit(self, table):
+        mean = float(np.mean(table[self.get_input_col()]))
+        return MeanShiftModel(mean=mean,
+                             inputCol=self.get_input_col(),
+                             outputCol=self.get_output_col())
+
+
+class MeanShiftModel(Model, HasInputCol, HasOutputCol):
+    mean = FloatParam("learned mean", default=0.0)
+
+    def transform(self, table):
+        return table.with_column(
+            self.get_output_col(),
+            np.asarray(table[self.get_input_col()], dtype=np.float64)
+            - self.get("mean"))
+
+
+def test_param_defaults_and_set():
+    s = AddConstant()
+    assert s.get("amount") == 1.0
+    s.set("amount", 3)  # int coerced to float
+    assert s.get("amount") == 3.0
+    s2 = AddConstant(amount=2.5, inputCol="numbers", outputCol="out")
+    assert s2.get("amount") == 2.5
+
+
+def test_param_validation():
+    class Ranged(Transformer):
+        k = IntParam("k", default=1, domain=range_domain(lo=1, hi=10))
+
+    r = Ranged()
+    with pytest.raises(ValueError):
+        r.set("k", 0)
+    with pytest.raises(TypeError):
+        r.set("k", "five")
+    r.set("k", 10)
+
+
+def test_enum_param():
+    class HasMode(Transformer):
+        mode = EnumParam(["fast", "slow"], "mode", default="fast")
+
+    h = HasMode()
+    with pytest.raises(ValueError):
+        h.set("mode", "medium")
+
+
+def test_bool_not_int():
+    class HasK(Transformer):
+        k = IntParam("k", default=1)
+
+    with pytest.raises(TypeError):
+        HasK().set("k", True)
+
+
+def test_transform_and_schema():
+    t = make_basic_table()
+    s = AddConstant(inputCol="numbers", outputCol="plus", amount=10.0)
+    out = s.transform(t)
+    assert list(out["plus"]) == [10.0, 11.0, 12.0, 13.0]
+    sch = s.transform_schema(t.schema)
+    assert "plus" in sch
+
+
+def test_estimator_fit():
+    t = make_basic_table()
+    est = MeanShift(inputCol="numbers", outputCol="centered")
+    model = est.fit(t)
+    out = model.transform(t)
+    assert abs(float(np.mean(out["centered"]))) < 1e-9
+
+
+def test_pipeline():
+    t = make_basic_table()
+    pipe = Pipeline([
+        AddConstant(inputCol="numbers", outputCol="plus", amount=5.0),
+        MeanShift(inputCol="plus", outputCol="centered"),
+    ])
+    pm = pipe.fit(t)
+    assert isinstance(pm, PipelineModel)
+    out = pm.transform(t)
+    assert "plus" in out.column_names and "centered" in out.column_names
+    assert abs(float(np.mean(out["centered"]))) < 1e-9
+
+
+def test_copy_is_independent():
+    s = AddConstant(amount=1.0)
+    c = s.copy({"amount": 9.0})
+    assert s.get("amount") == 1.0
+    assert c.get("amount") == 9.0
+    assert c.uid == s.uid
+
+
+def test_registry():
+    assert "AddConstant" in STAGE_REGISTRY
+    assert "MeanShiftModel" in STAGE_REGISTRY
+
+
+def test_explain_params():
+    text = AddConstant(amount=4.0).explain_params()
+    assert "amount" in text and "current: 4.0" in text
+
+
+def test_unknown_param_raises():
+    with pytest.raises(KeyError):
+        AddConstant().get("nope")
+    with pytest.raises(KeyError):
+        AddConstant(bogus=1)

@@ -1,0 +1,143 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.params import (
+    ArrayParam, FloatParam, HasInputCol, HasOutputCol, PyTreeParam, StageParam,
+    TableParam, UDFParam,
+)
+from mmlspark_tpu.core.stage import (
+    Pipeline, PipelineModel, PipelineStage, Transformer, load_stage,
+)
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.testing.datagen import make_basic_table
+from mmlspark_tpu.testing.equality import assert_table_equal
+
+
+class WeightsHolder(Transformer):
+    weights = PyTreeParam("model weights", default=None)
+    scale = FloatParam("scale", default=1.0)
+
+    def transform(self, table):
+        return table
+
+
+class ArrayHolder(Transformer):
+    arr = ArrayParam("an array", default=None)
+
+    def transform(self, table):
+        return table
+
+
+class TableHolder(Transformer):
+    ref_table = TableParam("a table", default=None)
+
+    def transform(self, table):
+        return table
+
+
+class StageHolder(Transformer):
+    inner = StageParam("inner stage", default=None)
+
+    def transform(self, table):
+        return self.get("inner").transform(table)
+
+
+def _global_udf(x):
+    return x * 2
+
+
+class UdfHolder(Transformer):
+    fn = UDFParam("a function", default=None)
+
+    def transform(self, table):
+        return table
+
+
+def test_simple_roundtrip(tmp_path):
+    s = WeightsHolder(scale=2.5)
+    p = str(tmp_path / "s")
+    s.save(p)
+    s2 = load_stage(p)
+    assert type(s2) is WeightsHolder
+    assert s2.get("scale") == 2.5
+    assert s2.uid == s.uid
+
+
+def test_pytree_roundtrip(tmp_path):
+    tree = {"dense": {"kernel": np.ones((3, 4)), "bias": np.zeros(4)},
+            "layers": [np.arange(3.0), np.arange(2.0)]}
+    s = WeightsHolder(weights=tree)
+    p = str(tmp_path / "w")
+    s.save(p)
+    s2 = load_stage(p)
+    w = s2.get("weights")
+    np.testing.assert_array_equal(w["dense"]["kernel"], tree["dense"]["kernel"])
+    np.testing.assert_array_equal(w["layers"][1], tree["layers"][1])
+
+
+def test_ndarray_roundtrip(tmp_path):
+    arr = np.random.default_rng(0).normal(size=(5, 7)).astype(np.float32)
+    s = ArrayHolder(arr=arr)
+    p = str(tmp_path / "a")
+    s.save(p)
+    s2 = load_stage(p)
+    np.testing.assert_array_equal(s2.get("arr"), arr)
+    assert s2.get("arr").dtype == np.float32
+
+
+def test_table_param_roundtrip(tmp_path):
+    t = make_basic_table()
+    s = TableHolder(ref_table=t)
+    p = str(tmp_path / "t")
+    s.save(p)
+    s2 = load_stage(p)
+    assert_table_equal(s2.get("ref_table"), t)
+
+
+def test_nested_stage_roundtrip(tmp_path):
+    inner = WeightsHolder(scale=7.0)
+    s = StageHolder(inner=inner)
+    p = str(tmp_path / "n")
+    s.save(p)
+    s2 = load_stage(p)
+    assert s2.get("inner").get("scale") == 7.0
+
+
+def test_udf_roundtrip(tmp_path):
+    s = UdfHolder(fn=_global_udf)
+    p = str(tmp_path / "u")
+    s.save(p)
+    s2 = load_stage(p)
+    assert s2.get("fn")(21) == 42
+
+
+def test_pipeline_roundtrip(tmp_path):
+    from tests.test_params_stage import AddConstant, MeanShift
+    t = make_basic_table()
+    pipe = Pipeline([
+        AddConstant(inputCol="numbers", outputCol="plus", amount=5.0),
+        MeanShift(inputCol="plus", outputCol="centered"),
+    ])
+    pm = pipe.fit(t)
+    out1 = pm.transform(t)
+
+    pipe_path = str(tmp_path / "pipe")
+    pipe.save(pipe_path)
+    pipe2 = load_stage(pipe_path)
+    out2 = pipe2.fit(t).transform(t)
+    assert_table_equal(out1, out2)
+
+    pm_path = str(tmp_path / "pm")
+    pm.save(pm_path)
+    pm2 = load_stage(pm_path)
+    out3 = pm2.transform(t)
+    assert_table_equal(out1, out3)
+
+
+def test_overwrite_behavior(tmp_path):
+    s = WeightsHolder(scale=1.0)
+    p = str(tmp_path / "x")
+    s.save(p)
+    s.save(p)  # overwrite ok by default
+    with pytest.raises(FileExistsError):
+        s.save(p, overwrite=False)

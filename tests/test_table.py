@@ -1,0 +1,124 @@
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import schema as S
+from mmlspark_tpu.core.schema import Field, ImageSchema, Schema
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.testing.datagen import generate_table, make_basic_table
+from mmlspark_tpu.testing.equality import assert_table_equal
+
+
+def test_construction_and_types():
+    t = make_basic_table()
+    assert len(t) == 4
+    assert t.schema["numbers"].tag == S.I64
+    assert t.schema["words"].tag == S.STRING
+    assert isinstance(t["numbers"], np.ndarray)
+
+
+def test_row_count_mismatch():
+    with pytest.raises(ValueError):
+        DataTable({"a": [1, 2, 3], "b": [1, 2]})
+
+
+def test_vector_column_dense():
+    t = DataTable({"v": np.ones((5, 3))})
+    assert t.schema["v"].tag == S.VECTOR
+    assert t["v"].shape == (5, 3)
+
+
+def test_ragged_vector_column():
+    t = DataTable({"v": [np.ones(2), np.ones(3)]})
+    assert t.schema["v"].tag == S.VECTOR
+    assert isinstance(t["v"], list)
+
+
+def test_with_column_drop_select_rename():
+    t = make_basic_table()
+    t2 = t.with_column("doubled", t["numbers"] * 2)
+    assert list(t2["doubled"]) == [0, 2, 4, 6]
+    t3 = t2.drop("words")
+    assert "words" not in t3.column_names
+    t4 = t3.select("numbers", "doubled")
+    assert t4.column_names == ["numbers", "doubled"]
+    t5 = t4.rename({"doubled": "x2"})
+    assert "x2" in t5.column_names
+    # original untouched
+    assert "doubled" not in t.column_names
+
+
+def test_filter_slice_sort_shuffle():
+    t = make_basic_table()
+    f = t.filter(t["numbers"] > 1)
+    assert list(f["numbers"]) == [2, 3]
+    f2 = t.filter(lambda r: r["words"] == "bass")
+    assert len(f2) == 1
+    s = t.sort_by("numbers", ascending=False)
+    assert list(s["numbers"]) == [3, 2, 1, 0]
+    sh = t.shuffle(seed=42)
+    assert sorted(sh["numbers"]) == [0, 1, 2, 3]
+
+
+def test_rows_roundtrip():
+    t = make_basic_table()
+    t2 = DataTable.from_rows(t.to_rows())
+    assert_table_equal(t, t2)
+
+
+def test_concat_and_shards():
+    t = make_basic_table()
+    c = DataTable.concat([t, t])
+    assert len(c) == 8
+    shards = c.repartition(3).shards()
+    assert len(shards) == 3
+    assert sum(len(s) for s in shards) == 8
+
+
+def test_batches():
+    t = generate_table(n_rows=10)
+    bs = list(t.batches(3))
+    assert [len(b) for b in bs] == [3, 3, 3, 1]
+
+
+def test_image_struct_inference():
+    row = ImageSchema.make_row("a.png", np.zeros((4, 5, 3), dtype=np.uint8))
+    t = DataTable({"image": [row]})
+    f = t.schema["image"]
+    assert ImageSchema.is_image(f)
+
+
+def test_pandas_roundtrip():
+    t = make_basic_table()
+    df = t.to_pandas()
+    t2 = DataTable.from_pandas(df)
+    assert_table_equal(t, t2, check_schema=False)
+
+
+def test_save_load(tmp_path):
+    t = make_basic_table().with_column("vec", np.arange(8).reshape(4, 2) * 1.0)
+    p = str(tmp_path / "table")
+    t.save(p)
+    t2 = DataTable.load(p)
+    assert_table_equal(t, t2)
+
+
+def test_find_unused_name():
+    t = make_basic_table()
+    assert t.schema.find_unused_name("numbers") == "numbers_1"
+    assert t.schema.find_unused_name("fresh") == "fresh"
+
+
+def test_categorical_metadata():
+    t = make_basic_table()
+    f = S.set_categorical_levels(t.schema["words"], ["a", "b"])
+    t2 = t.with_field(f)
+    assert S.get_categorical_levels(t2.schema["words"]) == ["a", "b"]
+    # json roundtrip preserves meta
+    s2 = Schema.from_json(t2.schema.to_json())
+    assert S.get_categorical_levels(s2["words"]) == ["a", "b"]
+
+
+def test_distinct_values():
+    t = DataTable({"a": [1, 2, 2, 3], "b": ["x", "x", "y", "z"]})
+    assert sorted(t.distinct_values("a")) == [1, 2, 3]
+    assert sorted(t.distinct_values("b")) == ["x", "y", "z"]
