@@ -269,7 +269,7 @@ def load_stage(path: str):
         raise KeyError(f"stage class {cls_name!r} not registered; "
                        f"import its module first")
     stage: PipelineStage = cls.__new__(cls)
-    PipelineStage.__init__(stage)  # fresh uid + empty param map
+    PipelineStage.__init__(stage)  # fresh uid + empty param map + _post_init
     stage.uid = meta["uid"]
     for name, value in meta["params"].items():
         try:

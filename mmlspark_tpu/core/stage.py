@@ -34,9 +34,15 @@ class PipelineStage:
     def __init__(self, **kwargs):
         self.uid = f"{type(self).__name__}_{_uuid.uuid4().hex[:12]}"
         self._paramMap: Dict[str, Any] = {}
+        self._post_init()
         for k, v in kwargs.items():
             p = self.param(k)
             self.set(p, v)
+
+    def _post_init(self) -> None:
+        """Initialize non-param runtime state (jit caches, meshes).
+        Called by __init__ AND by load_stage/copy, so subclasses must put
+        transient attributes here, not in __init__."""
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -75,7 +81,12 @@ class PipelineStage:
         if isinstance(param, str):
             param = self.param(param)
         self._paramMap[param.name] = param.validate(value)
+        self._on_param_change(param.name)
         return self
+
+    def _on_param_change(self, name: str) -> None:
+        """Hook for subclasses to invalidate derived/runtime state when a
+        param changes (e.g. cached device weights)."""
 
     def get(self, param) -> Any:
         if isinstance(param, str):
@@ -115,6 +126,9 @@ class PipelineStage:
         other = type(self).__new__(type(self))
         other.__dict__.update(
             {k: v for k, v in self.__dict__.items() if k != "_paramMap"})
+        # reset transient runtime state AFTER the copy so the clone never
+        # shares jit caches / device buffers with the original
+        other._post_init()
         other._paramMap = dict(self._paramMap)
         other.uid = self.uid
         if extra:

@@ -1,0 +1,6 @@
+"""IO readers (ref: src/io/src/main/scala/Readers.scala:14-46)."""
+
+from mmlspark_tpu.io.binary import read_binary_files
+from mmlspark_tpu.io.image import read_images, write_images
+
+__all__ = ["read_binary_files", "read_images", "write_images"]

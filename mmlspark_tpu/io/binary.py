@@ -1,0 +1,34 @@
+"""Binary-file table source.
+
+TPU-native analog of the reference's HadoopFsRelation binary source
+(ref: src/io/binary/src/main/scala/BinaryFileFormat.scala:116,
+BinaryFileReader.scala:18): directory-recursive, zip-inspecting, sampled
+reads into a {path, bytes} struct column.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from mmlspark_tpu.core.schema import BinaryFileSchema, Schema
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.utils.file_utils import iter_binary_files
+
+
+def read_binary_files(path: str,
+                      recursive: bool = True,
+                      pattern: Optional[str] = None,
+                      sample_ratio: float = 1.0,
+                      inspect_zip: bool = True,
+                      seed: int = 0,
+                      column_name: str = "value") -> DataTable:
+    rows = [
+        {column_name: BinaryFileSchema.make_row(p, data)}
+        for p, data in iter_binary_files(
+            path, pattern=pattern, recursive=recursive,
+            inspect_zip=inspect_zip, sample_ratio=sample_ratio, seed=seed)
+    ]
+    schema = Schema([BinaryFileSchema.field(column_name)])
+    if not rows:
+        return DataTable({column_name: []}, schema)
+    return DataTable.from_rows(rows, schema)

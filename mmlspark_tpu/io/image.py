@@ -1,0 +1,94 @@
+"""Image table source/sink.
+
+TPU-native analog of the reference's OpenCV-backed image reader
+(ref: src/io/image/src/main/scala/Image.scala:22-75, ImageFileFormat.scala:25):
+reads a directory (recursively, with sampling and zip inspection) into an
+image struct column {path, height, width, channels, mode, data} with BGR
+uint8 HWC data, matching the reference's OpenCV storage convention.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.schema import ImageSchema, Schema
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.utils.file_utils import iter_binary_files
+
+IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif",
+                    ".tiff", ".webp")
+
+
+def decode_image(data: bytes) -> Optional[np.ndarray]:
+    """bytes -> BGR HWC uint8 array, or None on failure
+    (ref: Image.scala:47-75 decode semantics: undecodable -> null row)."""
+    try:
+        import cv2
+        arr = np.frombuffer(data, dtype=np.uint8)
+        img = cv2.imdecode(arr, cv2.IMREAD_COLOR)
+        if img is None:
+            return None
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img
+    except ImportError:
+        pass
+    try:
+        import io as _io
+        from PIL import Image as PILImage
+        img = PILImage.open(_io.BytesIO(data)).convert("RGB")
+        return np.asarray(img)[:, :, ::-1].copy()  # RGB -> BGR
+    except Exception:
+        return None
+
+
+def encode_image(img: np.ndarray, ext: str = ".png") -> bytes:
+    import cv2
+    ok, buf = cv2.imencode(ext, img)
+    if not ok:
+        raise ValueError(f"failed to encode image as {ext}")
+    return buf.tobytes()
+
+
+def read_images(path: str,
+                recursive: bool = True,
+                sample_ratio: float = 1.0,
+                inspect_zip: bool = True,
+                seed: int = 0,
+                column_name: str = "image",
+                drop_undecodable: bool = True) -> DataTable:
+    rows = []
+    for p, data in iter_binary_files(path, recursive=recursive,
+                                     inspect_zip=inspect_zip,
+                                     sample_ratio=sample_ratio, seed=seed):
+        if not p.lower().endswith(IMAGE_EXTENSIONS):
+            continue
+        img = decode_image(data)
+        if img is None:
+            if drop_undecodable:
+                continue
+            rows.append({column_name: None})
+        else:
+            rows.append({column_name: ImageSchema.make_row(p, img, "BGR")})
+    schema = Schema([ImageSchema.field(column_name)])
+    if not rows:
+        return DataTable({column_name: []}, schema)
+    return DataTable.from_rows(rows, schema)
+
+
+def write_images(table: DataTable, directory: str,
+                 column_name: str = "image", ext: str = ".png") -> None:
+    """ref: src/io/image ImageWriter."""
+    os.makedirs(directory, exist_ok=True)
+    for i, row in enumerate(table.rows()):
+        img = row[column_name]
+        if img is None:
+            continue
+        base = os.path.basename(str(img.get(ImageSchema.PATH, f"img_{i}")))
+        stem = os.path.splitext(base)[0] or f"img_{i}"
+        out = os.path.join(directory, f"{stem}{ext}")
+        with open(out, "wb") as f:
+            f.write(encode_image(np.asarray(img[ImageSchema.DATA]), ext))

@@ -1,0 +1,219 @@
+"""TPUModel — batched DNN inference over tables.
+
+TPU-native analog of the reference's CNTKModel
+(ref: src/cntk-model/src/main/scala/CNTKModel.scala:147-514):
+where the reference broadcasts a serialized CNTK graph to executors,
+clones it per partition with shared weights, and feeds minibatched rows
+through JNI (``CNTKModelUtils.applyModel``/``applyCNTKFunction``
+:30-140), we hold a JAX apply function + weights pytree, jit it once per
+(batch-shape, dtype), shard the batch over the mesh's data axis, and let
+XLA run the whole minibatch on the MXU. ``feedDict``/``fetchDict``
+multi-input/output maps follow CNTKModel.scala:206-225; input coercion
+(float/double/vector) follows :419-462.
+
+The weights are device-resident and replicated across the mesh — the
+analog of the reference's broadcast + ``ParameterCloningMethod.Share``
+(:83) without any copy per partition.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.core.params import (
+    DictParam, EnumParam, HasInputCol, HasOutputCol, IntParam, PyTreeParam,
+    StringParam, UDFParam,
+)
+from mmlspark_tpu.core.schema import Field, ImageSchema, Schema, TENSOR, VECTOR
+from mmlspark_tpu.core.stage import Model
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.parallel import mesh as mesh_lib
+
+
+def _column_to_array(col, field: Field, dtype) -> np.ndarray:
+    """Coerce a table column into a dense batch array
+    (ref: CNTKModel.scala:419-462 coerceDFAndFeedDict)."""
+    if field is not None and ImageSchema.is_image(field):
+        return np.stack([np.asarray(r[ImageSchema.DATA]) for r in col]
+                        ).astype(dtype)
+    if isinstance(col, np.ndarray):
+        return np.asarray(col, dtype=dtype)
+    first = next((x for x in col if x is not None), None)
+    if isinstance(first, np.ndarray):
+        return np.stack([np.asarray(x) for x in col]).astype(dtype)
+    return np.asarray(col, dtype=dtype)
+
+
+class TPUModel(Model, HasInputCol, HasOutputCol):
+    """Run a jitted forward function over a table, minibatched + sharded.
+
+    The model is ``model_fn(weights, inputs: dict[str, Array]) ->
+    dict[str, Array] | Array``. Use ``from_flax`` / ``from_fn`` to build.
+    """
+
+    modelFn = UDFParam("callable (weights, inputs dict) -> outputs", default=None)
+    weights = PyTreeParam("model weights pytree", default=None)
+    feedDict = DictParam(
+        "map model input name -> table column "
+        "(ref: CNTKModel feedDict :206)", default=None)
+    fetchDict = DictParam(
+        "map output column -> model output name "
+        "(ref: CNTKModel fetchDict :217)", default=None)
+    batchSize = IntParam("minibatch size", default=64)
+    computeDtype = EnumParam(["float32", "bfloat16", "float64"],
+                             "on-device compute dtype", default="float32")
+
+    def _post_init(self):
+        self._mesh: Optional[Mesh] = None
+        self._jitted: Dict[Tuple, Callable] = {}
+        self._device_weights = None
+
+    def _on_param_change(self, name: str) -> None:
+        if name == "weights":
+            self._device_weights = None
+        elif name == "modelFn":
+            self._jitted = {}
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def from_fn(fn: Callable, weights: Any, **kw) -> "TPUModel":
+        return TPUModel(modelFn=fn, weights=weights, **kw)
+
+    @staticmethod
+    def from_flax(module, variables: Any, method=None, **kw) -> "TPUModel":
+        """Wrap a flax module; inputs dict values are passed positionally
+        in feedDict order (single input the common case)."""
+        fn = _FlaxApply(module, method)
+        weights = variables["params"] if (isinstance(variables, dict)
+                                          and "params" in variables) else variables
+        return TPUModel(modelFn=fn, weights=weights, **kw)
+
+    # -- mesh / jit management ----------------------------------------------
+
+    def set_mesh(self, mesh: Optional[Mesh]) -> "TPUModel":
+        self._mesh = mesh
+        self._jitted = {}
+        self._device_weights = None
+        return self
+
+    def _get_mesh(self) -> Mesh:
+        if self._mesh is None:
+            self._mesh = mesh_lib.make_mesh()
+        return self._mesh
+
+    def _weights_on_device(self):
+        """Replicate weights across the mesh once (broadcast analog,
+        ref: CNTKModel.scala:413 rebroadcastCNTKModel)."""
+        if self._device_weights is None:
+            m = self._get_mesh()
+            repl = NamedSharding(m, P())
+            self._device_weights = jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a), repl),
+                self.get("weights"))
+        return self._device_weights
+
+    def _feeds(self) -> Dict[str, str]:
+        fd = self.get("feedDict")
+        if fd:
+            return dict(fd)
+        return {"input": self.get_input_col()}
+
+    def _fetches(self) -> Dict[str, str]:
+        fd = self.get("fetchDict")
+        if fd:
+            return dict(fd)
+        return {self.get_output_col(): "output"}
+
+    def _compiled(self, shapes_key: Tuple) -> Callable:
+        fn = self._jitted.get(shapes_key)
+        if fn is None:
+            model_fn = self.get("modelFn")
+
+            def run(weights, inputs: Dict[str, jnp.ndarray]):
+                out = model_fn(weights, inputs)
+                if not isinstance(out, dict):
+                    out = {"output": out}
+                return out
+
+            fn = jax.jit(run)
+            self._jitted[shapes_key] = fn
+        return fn
+
+    # -- transform ----------------------------------------------------------
+
+    def transform(self, table: DataTable) -> DataTable:
+        feeds = self._feeds()
+        fetches = self._fetches()
+        dtype = np.dtype(self.get("computeDtype")) \
+            if self.get("computeDtype") != "bfloat16" else jnp.bfloat16
+        batch_size = self.get("batchSize")
+        mesh = self._get_mesh()
+        weights = self._weights_on_device()
+
+        n = len(table)
+        out_cols: Dict[str, List[np.ndarray]] = {c: [] for c in fetches}
+        for start in range(0, n, batch_size):
+            stop = min(start + batch_size, n)
+            inputs = {}
+            true_len = stop - start
+            for model_in, col_name in feeds.items():
+                field = table.schema.get(col_name)
+                arr = table[col_name][start:stop]
+                arr = _column_to_array(arr, field, np.float32
+                                       if dtype == jnp.bfloat16 else dtype)
+                sharded, _ = mesh_lib.shard_batch(mesh, arr)
+                if dtype == jnp.bfloat16:
+                    sharded = sharded.astype(jnp.bfloat16)
+                inputs[model_in] = sharded
+            shapes_key = tuple(sorted(
+                (k, v.shape, str(v.dtype)) for k, v in inputs.items()))
+            outputs = self._compiled(shapes_key)(weights, inputs)
+            for out_col, model_out in fetches.items():
+                if model_out not in outputs:
+                    raise KeyError(
+                        f"model output {model_out!r} not in outputs "
+                        f"{list(outputs)}")
+                val = np.asarray(outputs[model_out].astype(jnp.float32)
+                                 if outputs[model_out].dtype == jnp.bfloat16
+                                 else outputs[model_out])
+                out_cols[out_col].append(val[:true_len])
+
+        result = table
+        for out_col, parts in out_cols.items():
+            merged = np.concatenate(parts, axis=0) if parts else np.empty((0,))
+            tag = VECTOR if merged.ndim == 2 else TENSOR if merged.ndim > 2 \
+                else Field(out_col, "f32").tag
+            result = result.with_column(out_col, merged, Field(out_col, tag))
+        return result
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for col_name in self._feeds().values():
+            schema.require(col_name)
+        out = schema
+        for out_col in self._fetches():
+            out = out.add_or_replace(Field(out_col, VECTOR))
+        return out
+
+
+class _FlaxApply:
+    """Picklable flax apply wrapper (module defs pickle by value of their
+    config, weights travel separately as a PyTreeParam)."""
+
+    def __init__(self, module, method=None):
+        self.module = module
+        self.method = method
+
+    def __call__(self, weights, inputs: Dict[str, jnp.ndarray]):
+        args = list(inputs.values())
+        kwargs = {}
+        if self.method is not None:
+            return self.module.apply({"params": weights}, *args,
+                                     method=self.method, **kwargs)
+        return self.module.apply({"params": weights}, *args, **kwargs)

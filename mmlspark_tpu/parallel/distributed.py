@@ -1,0 +1,76 @@
+"""Multi-host distributed initialization.
+
+Replaces the reference's three ad-hoc coordination mechanisms with one:
+- driver rendezvous ServerSocket + allgather of worker host:port
+  (ref: src/lightgbm/.../LightGBMUtils.scala:66-105),
+- MPI-over-ssh launch with scp'd hostfiles
+  (ref: src/cntk-train/.../CommandBuilders.scala:108-267),
+- executor discovery via Spark BlockManager
+  (ref: LightGBMUtils.scala:139-158).
+
+TPU-native: ``jax.distributed.initialize`` gives every host the same view
+of the global device set; collectives ride ICI/DCN via XLA. The
+"distributed-without-a-cluster" test mode fakes a pod on one process with
+``xla_force_host_platform_device_count`` (ref pattern: SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+@dataclass
+class HostInfo:
+    process_index: int
+    process_count: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_index == 0
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> HostInfo:
+    """Initialize multi-host JAX if requested via args or env
+    (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID).
+    Safe to call in single-host mode — becomes a no-op."""
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address and not _initialized:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=(num_processes if num_processes is not None
+                           else int(os.environ.get("JAX_NUM_PROCESSES", "1"))),
+            process_id=(process_id if process_id is not None
+                        else int(os.environ.get("JAX_PROCESS_ID", "0"))),
+        )
+        _initialized = True
+    return host_info()
+
+
+def host_info() -> HostInfo:
+    return HostInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def shard_table_for_host(table, info: Optional[HostInfo] = None):
+    """Each host keeps only its row range — the host-partitioned feeding
+    that replaces HDFS staging + scp (ref: CNTKLearner.scala:123-140)."""
+    info = info or host_info()
+    if info.process_count <= 1:
+        return table
+    return table.shards(info.process_count)[info.process_index]

@@ -1,0 +1,118 @@
+"""Device mesh construction and batch sharding helpers.
+
+This replaces the reference's entire distributed-communication inventory
+(ref: SURVEY.md §2 "Parallelism & distributed-communication components"):
+where the reference hand-rolls a driver rendezvous socket
+(ref: src/lightgbm/.../LightGBMUtils.scala:66-105), ships data over
+ssh/scp for MPI (ref: src/cntk-train/.../CommandBuilders.scala:108-267),
+and broadcasts models per-executor (ref: CNTKModel.scala:413), we use one
+`jax.sharding.Mesh` with named axes and let XLA insert collectives over
+ICI/DCN.
+
+Axis conventions (scaling-book style):
+- ``data``  — batch/data parallelism (DP); gradients psum over it.
+- ``fsdp``  — parameter sharding along data (ZeRO-style), optional.
+- ``model`` — tensor parallelism (TP) for wide layers.
+- ``seq``   — sequence/context parallelism (ring attention).
+- ``expert``— expert parallelism for MoE.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Create a Mesh with named axes.
+
+    ``axes`` maps axis name -> size; a size of -1 means "everything left".
+    Default: all devices on the data axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n_fill = sizes.count(-1)
+    if n_fill > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(s for s in sizes if s != -1)
+    if n_fill:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        sizes = [n // fixed if s == -1 else s for s in sizes]
+    if math.prod(sizes) != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} need {math.prod(sizes)} "
+            f"devices, have {n}")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=names)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh({DATA_AXIS: 1}, devices=jax.devices()[:1])
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1,
+                  axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard dim 0 over the data axis, replicate the rest."""
+    batch_axes: Tuple = (axis,) + (None,) * (ndim - 1)
+    return NamedSharding(mesh, P(*batch_axes))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int,
+                    axis: int = 0) -> Tuple[np.ndarray, int]:
+    """Pad ``axis`` up to a multiple (XLA needs static, divisible shapes —
+    the analog of the reference's minibatch padding). Returns (padded,
+    original_length)."""
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    pad_width = [(0, 0)] * arr.ndim
+    pad_width[axis] = (0, rem)
+    # edge-pad so padded rows are valid inputs (avoids NaN paths)
+    mode = "edge" if n > 0 else "constant"
+    return np.pad(arr, pad_width, mode=mode), n
+
+
+def shard_batch(mesh: Mesh, arr: np.ndarray,
+                axis_name: str = DATA_AXIS) -> Tuple[jax.Array, int]:
+    """Host numpy batch -> device array sharded over the data axis,
+    padding the batch to divide evenly. Returns (device_array, true_len)."""
+    n_shards = mesh.shape[axis_name]
+    padded, n = pad_to_multiple(np.asarray(arr), n_shards, axis=0)
+    sharding = NamedSharding(mesh, P(axis_name))
+    if padded.ndim > 1:
+        sharding = NamedSharding(
+            mesh, P(*((axis_name,) + (None,) * (padded.ndim - 1))))
+    return jax.device_put(padded, sharding), n
+
+
+def mesh_num_devices(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def local_batch_size(global_batch: int, mesh: Mesh,
+                     axis: str = DATA_AXIS) -> int:
+    return global_batch // mesh.shape[axis]

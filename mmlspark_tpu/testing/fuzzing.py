@@ -33,6 +33,8 @@ FUZZING_REGISTRY: Dict[str, List[Callable[[], "TestObject"]]] = {}
 class TestObject:
     """ref: Fuzzing.scala:19 TestObject(stage, fitDF, transDF, validateDF)."""
 
+    __test__ = False  # not a pytest class
+
     def __init__(self, stage: PipelineStage,
                  fit_table: Optional[DataTable] = None,
                  transform_table: Optional[DataTable] = None,

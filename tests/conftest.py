@@ -1,28 +1,31 @@
-"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE any
+backend initialization.
 
 Distributed-without-a-cluster pattern (ref: SURVEY.md §4 — LightGBM tests
-run local[*] with partitions as nodes): we fake a TPU pod with
-``--xla_force_host_platform_device_count=8`` so all sharding/collective
-code paths run in CI on CPU.
+run local[*] with partitions as nodes): we fake a TPU pod with 8 virtual
+CPU devices so all sharding/collective code paths run in CI on CPU.
+
+Note: this image's site customization imports jax at interpreter start
+and pins JAX_PLATFORMS=axon (the real TPU tunnel), so env vars are too
+late — we must use jax.config.update before first backend use.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
+os.environ.setdefault("MMLSPARK_TPU_TEST_MODE", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def cpu_mesh_devices():
-    import jax
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs
