@@ -1,0 +1,77 @@
+"""Flagship benchmark: CIFAR-10 ConvNet training throughput (imgs/sec/chip).
+
+This is the cntk-train headline path (ref: notebooks/gpu/401 — BrainScript
+ConvNet on 32x32x3 CIFAR-10, parallelTrain on a 4-GPU Azure N-series VM).
+BASELINE.md: the reference publishes no absolute numbers, so the baseline
+constant below is the commonly-reported single-K80 CNTK ConvNet throughput
+for that hardware class, ~1000 imgs/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Runs on whatever jax.devices() provides (the real TPU chip under axon).
+"""
+
+import json
+import time
+
+import numpy as np
+
+# Azure N-series (K80-class) CNTK ConvNet throughput, imgs/sec/GPU — the
+# reference's notebook-401 hardware (no absolute number published; see
+# BASELINE.md).
+BASELINE_IMGS_PER_SEC_PER_CHIP = 1000.0
+
+BATCH = 256
+STEPS_TARGET = 60
+WARMUP_FRACTION = 0.3
+
+
+def main():
+    import jax
+
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.models.learner import TPULearner
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+
+    n_chips = len(jax.devices())
+    mesh = mesh_lib.make_mesh({"data": n_chips})
+
+    rng = np.random.default_rng(0)
+    n = BATCH * 8
+    x = rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.float32) / 255.0
+    y = rng.integers(0, 10, size=n).astype(np.int64)
+    table = DataTable({"features": x.reshape(n, -1), "label": y})
+
+    steps_per_epoch = n // BATCH
+    epochs = max(1, STEPS_TARGET // steps_per_epoch)
+
+    # notebook-401 ConvNet shape: 3 conv layers + dense, bf16 on the MXU
+    learner = TPULearner(
+        networkSpec={"type": "convnet", "conv_features": [64, 64, 64],
+                     "dense_features": [256], "num_classes": 10},
+        inputShape=[32, 32, 3],
+        batchSize=BATCH, learningRate=0.1, computeDtype="bfloat16",
+        epochs=epochs, logEvery=1)
+    learner.set_mesh(mesh)
+
+    learner.fit(table)
+
+    # steady-state throughput from per-step timestamps, skipping warmup
+    times = [h["time"] for h in learner.history]
+    n_steps = len(times)
+    skip = max(1, int(n_steps * WARMUP_FRACTION))
+    steady = times[skip:]
+    dt = steady[-1] - steady[0]
+    steps = len(steady) - 1
+    imgs_per_sec = steps * BATCH / dt
+    per_chip = imgs_per_sec / n_chips
+
+    print(json.dumps({
+        "metric": "cifar10_convnet_train_imgs_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -83,12 +83,20 @@ def write_images(table: DataTable, directory: str,
                  column_name: str = "image", ext: str = ".png") -> None:
     """ref: src/io/image ImageWriter."""
     os.makedirs(directory, exist_ok=True)
+    used = set()
     for i, row in enumerate(table.rows()):
         img = row[column_name]
         if img is None:
             continue
         base = os.path.basename(str(img.get(ImageSchema.PATH, f"img_{i}")))
         stem = os.path.splitext(base)[0] or f"img_{i}"
-        out = os.path.join(directory, f"{stem}{ext}")
+        # uniquify: recursive reads can yield identical basenames from
+        # different subdirectories
+        name, k = stem, 1
+        while name in used:
+            name = f"{stem}_{k}"
+            k += 1
+        used.add(name)
+        out = os.path.join(directory, f"{name}{ext}")
         with open(out, "wb") as f:
             f.write(encode_image(np.asarray(img[ImageSchema.DATA]), ext))

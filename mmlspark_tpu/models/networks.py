@@ -1,0 +1,214 @@
+"""Flax model zoo — the network families the reference trains/serves.
+
+TPU-native replacement for the reference's CNTK graphs: the BrainScript
+ConvNet the cntk-train notebooks build (ref: notebooks/gpu/401 BrainScript
+cell; src/cntk-train/.../BrainscriptBuilder.scala:16-120), the ResNet used
+for CIFAR inference (ref: notebooks 301), ImageFeaturizer backbones
+(ref: src/image-featurizer), and the Bi-LSTM entity extractor
+(ref: notebook 304).
+
+All modules are standard flax.linen, NHWC layouts, bfloat16-friendly:
+``dtype`` controls compute precision while params stay float32 (the
+canonical TPU mixed-precision recipe — MXU eats bf16, accumulates f32).
+
+Every module exposes ``feature_layers()`` naming its intermediate
+activation points so ImageFeaturizer-style layer cutting
+(ref: ImageFeaturizer.scala:91-141 cutOutputLayers/layerNames) works on
+any zoo model: pass ``capture=<name>`` to ``__call__`` and the module
+returns that intermediate instead of the head output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+Dtype = Any
+
+
+class MLP(nn.Module):
+    """Plain MLP over flat feature vectors."""
+
+    features: Sequence[int] = (256, 128)
+    num_classes: int = 10
+    dtype: Dtype = jnp.float32
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, capture: Optional[str] = None):
+        x = x.astype(self.dtype)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+            if self.dropout > 0:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+            if capture == f"dense_{i}":
+                return x
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+    def feature_layers(self) -> List[str]:
+        return [f"dense_{i}" for i in range(len(self.features))]
+
+
+class ConvNet(nn.Module):
+    """The CIFAR ConvNet family of the cntk-train notebooks: stacked
+    conv-relu(-pool) blocks then dense layers (ref: notebooks/gpu/401
+    BrainScript ConvNet 32:32:3)."""
+
+    conv_features: Sequence[int] = (64, 64, 64)
+    kernel: Tuple[int, int] = (3, 3)
+    pool_every: int = 1
+    dense_features: Sequence[int] = (256,)
+    num_classes: int = 10
+    dtype: Dtype = jnp.float32
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, capture: Optional[str] = None):
+        x = x.astype(self.dtype)
+        for i, f in enumerate(self.conv_features):
+            x = nn.Conv(f, self.kernel, dtype=self.dtype, name=f"conv_{i}")(x)
+            x = nn.relu(x)
+            if (i + 1) % self.pool_every == 0:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            if capture == f"conv_{i}":
+                return x
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.dense_features):
+            x = nn.Dense(f, dtype=self.dtype, name=f"dense_{i}")(x)
+            x = nn.relu(x)
+            if self.dropout > 0:
+                x = nn.Dropout(self.dropout, deterministic=not train)(x)
+            if capture == f"dense_{i}":
+                return x
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+    def feature_layers(self) -> List[str]:
+        return ([f"conv_{i}" for i in range(len(self.conv_features))]
+                + [f"dense_{i}" for i in range(len(self.dense_features))])
+
+
+class ResNetBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False,
+                    dtype=self.dtype)(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
+                         scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    dtype=self.dtype)(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR-style ResNet (6n+2): stage_sizes=(3,3,3) -> ResNet-20."""
+
+    stage_sizes: Sequence[int] = (3, 3, 3)
+    width: int = 16
+    num_classes: int = 10
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, capture: Optional[str] = None):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (3, 3), use_bias=False, dtype=self.dtype,
+                    name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        for s, n_blocks in enumerate(self.stage_sizes):
+            for b in range(n_blocks):
+                strides = (2, 2) if (s > 0 and b == 0) else (1, 1)
+                x = ResNetBlock(self.width * (2 ** s), strides,
+                                self.dtype, name=f"stage{s}_block{b}")(
+                                    x, train=train)
+            if capture == f"stage{s}":
+                return x
+        x = jnp.mean(x, axis=(1, 2))
+        if capture == "pool":
+            return x
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+    def feature_layers(self) -> List[str]:
+        return [f"stage{s}" for s in range(len(self.stage_sizes))] + ["pool"]
+
+
+class BiLSTMTagger(nn.Module):
+    """Bidirectional LSTM sequence tagger — the TPU twin of the notebook
+    304 Bi-LSTM medical-entity extractor (Keras/CNTK backend there).
+
+    Input: int32 token ids [B, T]; output: per-token class logits
+    [B, T, num_tags]. Uses nn.RNN over LSTMCells; the backward pass uses
+    ``reverse=True`` with masking-friendly fixed-length scan, which XLA
+    compiles to a single fused loop on TPU.
+    """
+
+    vocab_size: int = 10000
+    embed_dim: int = 128
+    hidden: int = 128
+    num_tags: int = 8
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False,
+                 capture: Optional[str] = None):
+        emb = nn.Embed(self.vocab_size, self.embed_dim,
+                       dtype=self.dtype, name="embed")(tokens)
+        fwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden), name="lstm_fwd")
+        bwd = nn.RNN(nn.OptimizedLSTMCell(self.hidden), reverse=True,
+                     keep_order=True, name="lstm_bwd")
+        h = jnp.concatenate([fwd(emb), bwd(emb)], axis=-1)
+        if capture == "lstm":
+            return h
+        return nn.Dense(self.num_tags, dtype=jnp.float32, name="head")(h)
+
+    def feature_layers(self) -> List[str]:
+        return ["lstm"]
+
+
+# ---------------------------------------------------------------------------
+# registry + spec construction (BrainScriptBuilder analog)
+# ---------------------------------------------------------------------------
+
+NETWORK_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
+    "mlp": MLP,
+    "convnet": ConvNet,
+    "resnet": ResNet,
+    "bilstm": BiLSTMTagger,
+}
+
+
+def build_network(spec: Dict[str, Any]) -> nn.Module:
+    """Build a module from a JSON-able spec — the declarative network
+    definition layer replacing BrainScript emission
+    (ref: BrainscriptBuilder.scala:16-120). Example::
+
+        {"type": "resnet", "stage_sizes": [3,3,3], "num_classes": 10,
+         "dtype": "bfloat16"}
+    """
+    spec = dict(spec)
+    kind = spec.pop("type")
+    if kind not in NETWORK_REGISTRY:
+        raise KeyError(f"unknown network type {kind!r}; "
+                       f"have {sorted(NETWORK_REGISTRY)}")
+    if "dtype" in spec and isinstance(spec["dtype"], str):
+        spec["dtype"] = jnp.dtype(spec["dtype"])
+    for key in ("conv_features", "dense_features", "stage_sizes",
+                "features", "kernel"):
+        if key in spec and isinstance(spec[key], list):
+            spec[key] = tuple(spec[key])
+    return NETWORK_REGISTRY[kind](**spec)

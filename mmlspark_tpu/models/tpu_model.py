@@ -66,7 +66,9 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         "map output column -> model output name "
         "(ref: CNTKModel fetchDict :217)", default=None)
     batchSize = IntParam("minibatch size", default=64)
-    computeDtype = EnumParam(["float32", "bfloat16", "float64"],
+    # float64 deliberately absent: JAX canonicalizes f64->f32 unless the
+    # global jax_enable_x64 flag is on, which we don't silently toggle
+    computeDtype = EnumParam(["float32", "bfloat16"],
                              "on-device compute dtype", default="float32")
 
     def _post_init(self):
@@ -89,11 +91,13 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
     @staticmethod
     def from_flax(module, variables: Any, method=None, **kw) -> "TPUModel":
         """Wrap a flax module; inputs dict values are passed positionally
-        in feedDict order (single input the common case)."""
+        in feedDict order (single input the common case). ``variables`` is
+        the full init() result — every collection (params, batch_stats, …)
+        is kept so BatchNorm-style models work at inference."""
         fn = _FlaxApply(module, method)
-        weights = variables["params"] if (isinstance(variables, dict)
-                                          and "params" in variables) else variables
-        return TPUModel(modelFn=fn, weights=weights, **kw)
+        if not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": variables}
+        return TPUModel(modelFn=fn, weights=dict(variables), **kw)
 
     # -- mesh / jit management ----------------------------------------------
 
@@ -131,8 +135,10 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
             return dict(fd)
         return {self.get_output_col(): "output"}
 
-    def _compiled(self, shapes_key: Tuple) -> Callable:
-        fn = self._jitted.get(shapes_key)
+    def _compiled(self) -> Callable:
+        """One jit wrapper per model (jax.jit handles per-shape retraces
+        internally); invalidated when modelFn changes."""
+        fn = self._jitted.get("run")
         if fn is None:
             model_fn = self.get("modelFn")
 
@@ -143,7 +149,7 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                 return out
 
             fn = jax.jit(run)
-            self._jitted[shapes_key] = fn
+            self._jitted["run"] = fn
         return fn
 
     # -- transform ----------------------------------------------------------
@@ -172,9 +178,7 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                 if dtype == jnp.bfloat16:
                     sharded = sharded.astype(jnp.bfloat16)
                 inputs[model_in] = sharded
-            shapes_key = tuple(sorted(
-                (k, v.shape, str(v.dtype)) for k, v in inputs.items()))
-            outputs = self._compiled(shapes_key)(weights, inputs)
+            outputs = self._compiled()(weights, inputs)
             for out_col, model_out in fetches.items():
                 if model_out not in outputs:
                     raise KeyError(
@@ -212,8 +216,8 @@ class _FlaxApply:
 
     def __call__(self, weights, inputs: Dict[str, jnp.ndarray]):
         args = list(inputs.values())
-        kwargs = {}
+        variables = weights if (isinstance(weights, dict)
+                                and "params" in weights) else {"params": weights}
         if self.method is not None:
-            return self.module.apply({"params": weights}, *args,
-                                     method=self.method, **kwargs)
-        return self.module.apply({"params": weights}, *args, **kwargs)
+            return self.module.apply(variables, *args, method=self.method)
+        return self.module.apply(variables, *args)

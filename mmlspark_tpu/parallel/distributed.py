@@ -10,8 +10,9 @@ Replaces the reference's three ad-hoc coordination mechanisms with one:
 
 TPU-native: ``jax.distributed.initialize`` gives every host the same view
 of the global device set; collectives ride ICI/DCN via XLA. The
-"distributed-without-a-cluster" test mode fakes a pod on one process with
-``xla_force_host_platform_device_count`` (ref pattern: SURVEY.md §4).
+"distributed-without-a-cluster" test mode fakes a pod in one process with
+``jax.config.update("jax_num_cpu_devices", n)`` before first backend use
+(ref pattern: SURVEY.md §4; see tests/conftest.py).
 """
 
 from __future__ import annotations

@@ -158,8 +158,9 @@ class ImageTransformer(Transformer, HasInputCol, HasOutputCol):
 
         out_rows: List[Optional[Dict[str, Any]]] = []
         if uniform:
-            batch = jnp.stack(
-                [jnp.asarray(r[ImageSchema.DATA]) for r in rows])
+            # stack on host, one contiguous host->device transfer
+            batch = jnp.asarray(np.stack(
+                [np.asarray(r[ImageSchema.DATA]) for r in rows]))
             result = np.asarray(self._apply_batch_fn()(batch))
             result = np.clip(np.round(result), 0, 255).astype(np.uint8)
             for r, img in zip(rows, result):
