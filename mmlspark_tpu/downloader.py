@@ -1,0 +1,213 @@
+"""Model zoo: sha256-verified schemas, repos, downloader.
+
+TPU-native analog of the reference's downloader component
+(ref: src/downloader/src/main/scala/ModelDownloader.scala:37-209,
+Schema.scala:54): a repo is a directory (local or remote) holding an
+``index.json`` of model schemas plus one weight blob per model; every
+fetch verifies the blob's sha256 against the schema before returning, and
+remote fetches retry with backoff (ref: FaultToleranceUtils
+ModelDownloader.scala:37-50).
+
+Weights are stored as flax msgpack bytes (``flax.serialization``) next to
+a JSON network spec (see models/networks.build_network) — the
+TPU-idiomatic replacement for CNTK's binary graph files: the graph is a
+declarative spec, the weights a pytree blob.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from mmlspark_tpu.core.logging_utils import get_logger
+
+log = get_logger("downloader")
+
+DEFAULT_CACHE = os.path.expanduser("~/.mmlspark_tpu/models")
+
+
+class ModelSchema:
+    """Schema of a zoo model (ref: downloader Schema.scala:54-100)."""
+
+    def __init__(self, name: str, dataset: str = "", model_type: str = "",
+                 uri: str = "", sha256: str = "", size: int = 0,
+                 input_shape: Optional[List[int]] = None,
+                 num_layers: int = 0,
+                 layer_names: Optional[List[str]] = None,
+                 network_spec: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.dataset = dataset
+        self.model_type = model_type
+        self.uri = uri
+        self.sha256 = sha256
+        self.size = int(size)
+        self.input_shape = list(input_shape or [])
+        self.num_layers = int(num_layers)
+        self.layer_names = list(layer_names or [])
+        self.network_spec = dict(network_spec or {})
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"name": self.name, "dataset": self.dataset,
+                "model_type": self.model_type, "uri": self.uri,
+                "sha256": self.sha256, "size": self.size,
+                "input_shape": self.input_shape,
+                "num_layers": self.num_layers,
+                "layer_names": self.layer_names,
+                "network_spec": self.network_spec}
+
+    @staticmethod
+    def from_json(d: Dict[str, Any]) -> "ModelSchema":
+        return ModelSchema(**d)
+
+    def __repr__(self):
+        return f"ModelSchema({self.name!r}, dataset={self.dataset!r})"
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def retry_with_backoff(fn, times: int = 3, base_delay: float = 0.5):
+    """ref: FaultToleranceUtils.retryWithTimeout
+    (ModelDownloader.scala:37-50)."""
+    last: Optional[Exception] = None
+    for i in range(times):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — intentional broad retry
+            last = e
+            log.warning("attempt %d/%d failed: %s", i + 1, times, e)
+            if i < times - 1:
+                time.sleep(base_delay * (2 ** i))
+    raise last  # type: ignore[misc]
+
+
+class LocalRepo:
+    """Directory-backed model repo (ref: HDFSRepo Schema analog —
+    ModelDownloader.scala:54-123): ``index.json`` + ``<name>.msgpack``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _index_path(self) -> str:
+        return os.path.join(self.path, "index.json")
+
+    def _load_index(self) -> Dict[str, Dict[str, Any]]:
+        if not os.path.exists(self._index_path()):
+            return {}
+        with open(self._index_path()) as f:
+            return json.load(f)
+
+    def list_schemas(self) -> Iterator[ModelSchema]:
+        for d in self._load_index().values():
+            yield ModelSchema.from_json(d)
+
+    def get_schema(self, name: str) -> ModelSchema:
+        idx = self._load_index()
+        if name not in idx:
+            raise KeyError(
+                f"model {name!r} not in repo {self.path}; "
+                f"have {sorted(idx)}")
+        return ModelSchema.from_json(idx[name])
+
+    def blob_path(self, schema: ModelSchema) -> str:
+        return os.path.join(self.path, f"{schema.name}.msgpack")
+
+    def read_blob(self, schema: ModelSchema, verify: bool = True) -> bytes:
+        path = self.blob_path(schema)
+        if verify and _sha256(path) != schema.sha256:
+            raise IOError(
+                f"sha256 mismatch for {schema.name}: file {path} corrupt "
+                f"(ref behavior: ModelDownloader verifies hash on fetch)")
+        with open(path, "rb") as f:
+            return f.read()
+
+    def publish(self, name: str, network_spec: Dict[str, Any],
+                variables: Any = None, dataset: str = "",
+                model_type: str = "",
+                input_shape: Optional[List[int]] = None,
+                layer_names: Optional[List[str]] = None,
+                blob: Optional[bytes] = None) -> ModelSchema:
+        """Add a model to the repo (the zoo-maintainer path). Pass either
+        a flax ``variables`` pytree or pre-serialized ``blob`` bytes."""
+        if blob is None:
+            from flax import serialization
+            blob = serialization.to_bytes(variables)
+        blob_path = os.path.join(self.path, f"{name}.msgpack")
+        with open(blob_path, "wb") as f:
+            f.write(blob)
+        schema = ModelSchema(
+            name=name, dataset=dataset, model_type=model_type,
+            uri=f"file://{blob_path}",
+            sha256=hashlib.sha256(blob).hexdigest(), size=len(blob),
+            input_shape=input_shape, layer_names=layer_names,
+            network_spec=network_spec)
+        idx = self._load_index()
+        idx[name] = schema.to_json()
+        with open(self._index_path(), "w") as f:
+            json.dump(idx, f, indent=1)
+        return schema
+
+
+class ModelDownloader:
+    """Fetch models from a repo into a local cache, verifying hashes
+    (ref: ModelDownloader.scala:209-280 — downloadModel/downloadByName,
+    local caching, retry)."""
+
+    def __init__(self, local_path: str = DEFAULT_CACHE,
+                 repo: Optional[LocalRepo] = None):
+        self.local = LocalRepo(local_path)
+        self.repo = repo
+
+    def list_models(self) -> List[ModelSchema]:
+        source = self.repo if self.repo is not None else self.local
+        return list(source.list_schemas())
+
+    def download_by_name(self, name: str) -> ModelSchema:
+        # cached already?
+        try:
+            schema = self.local.get_schema(name)
+            self.local.read_blob(schema)  # verifies hash
+            return schema
+        except (KeyError, IOError, FileNotFoundError):
+            pass
+        if self.repo is None:
+            raise KeyError(
+                f"model {name!r} not cached and no remote repo configured")
+        schema = self.repo.get_schema(name)
+        blob = retry_with_backoff(lambda: self.repo.read_blob(schema))
+        return self.local.publish(
+            name, schema.network_spec, blob=blob,
+            dataset=schema.dataset, model_type=schema.model_type,
+            input_shape=schema.input_shape, layer_names=schema.layer_names)
+
+    def download_model(self, schema: ModelSchema) -> ModelSchema:
+        return self.download_by_name(schema.name)
+
+    def load_variables(self, name: str) -> Any:
+        """Blob -> flax variables pytree."""
+        from flax import serialization
+        schema = self.download_by_name(name)  # verifies the cached blob
+        blob = self.local.read_blob(schema, verify=False)
+        module = self.build_module(schema)
+        import jax
+        import jax.numpy as jnp
+        shape = [1] + list(schema.input_shape)
+        dummy_dtype = jnp.int32 if schema.model_type == "sequence" \
+            else jnp.float32
+        target = module.init(jax.random.PRNGKey(0),
+                             jnp.zeros(shape, dummy_dtype))
+        return serialization.from_bytes(target, blob)
+
+    @staticmethod
+    def build_module(schema: ModelSchema):
+        from mmlspark_tpu.models.networks import build_network
+        return build_network(schema.network_spec)

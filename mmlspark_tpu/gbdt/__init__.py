@@ -1,0 +1,25 @@
+"""TPU-native gradient-boosted decision trees.
+
+Re-creation of the capabilities of the reference's distributed LightGBM
+wrapper (ref: src/lightgbm/src/main/scala/*) as a TPU-first engine:
+quantile binning on host, histogram building and leaf-wise tree growth as
+jitted XLA programs (one-hot/matmul histograms on the MXU), and
+data-parallel training via shard_map + psum of histograms over the mesh —
+the ICI-collective analog of LightGBM's socket allreduce ring
+(ref: TrainUtils.scala:207 LGBM_NetworkInit).
+"""
+
+from mmlspark_tpu.gbdt.binning import BinMapper
+from mmlspark_tpu.gbdt.booster import Booster, train
+from mmlspark_tpu.gbdt.estimators import (
+    TPUBoostClassificationModel,
+    TPUBoostClassifier,
+    TPUBoostRegressionModel,
+    TPUBoostRegressor,
+)
+
+__all__ = [
+    "BinMapper", "Booster", "train",
+    "TPUBoostClassifier", "TPUBoostClassificationModel",
+    "TPUBoostRegressor", "TPUBoostRegressionModel",
+]

@@ -1,0 +1,113 @@
+"""Quantile feature binning (host side).
+
+Analog of LightGBM's BinMapper construction, which the reference drives
+through ``LGBM_DatasetCreateFromMat`` (ref: src/lightgbm/src/main/scala/
+LightGBMUtils.scala:283-351): continuous features are discretized into at
+most ``max_bin`` equal-frequency bins; the binned matrix is what the
+histogram kernels consume on device.
+
+Host/numpy by design: binning is a one-time O(N·F) preprocessing pass
+(sort-based), exactly the part LightGBM also keeps on CPU. The output is a
+small int matrix that ships to HBM once.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+
+class BinMapper:
+    """Per-feature quantile bin boundaries.
+
+    ``upper_bounds[f]`` holds ascending split values; value ``v`` maps to
+    bin ``searchsorted(upper_bounds[f], v, side='left')``. NaNs map to bin
+    0 (treated as smallest — the reference's zero_as_missing=false default
+    folds missing into the lowest bin).
+    """
+
+    def __init__(self, upper_bounds: List[np.ndarray], max_bin: int):
+        self.upper_bounds = [np.asarray(u, dtype=np.float64)
+                             for u in upper_bounds]
+        self.max_bin = int(max_bin)
+
+    @property
+    def num_features(self) -> int:
+        return len(self.upper_bounds)
+
+    @property
+    def num_bins(self) -> np.ndarray:
+        """Actual bin count per feature (<= max_bin)."""
+        return np.asarray([len(u) + 1 for u in self.upper_bounds])
+
+    @staticmethod
+    def fit(X: np.ndarray, max_bin: int = 255,
+            sample_cnt: int = 200_000, seed: int = 2) -> "BinMapper":
+        X = np.asarray(X, dtype=np.float64)
+        n, f = X.shape
+        if n > sample_cnt:
+            rng = np.random.default_rng(seed)
+            idx = rng.choice(n, size=sample_cnt, replace=False)
+            X = X[idx]
+        bounds = [_feature_bounds(X[:, j], max_bin) for j in range(f)]
+        return BinMapper(bounds, max_bin)
+
+    def transform(self, X: np.ndarray) -> np.ndarray:
+        """Raw features -> int32 bin indices, shape (N, F)."""
+        X = np.asarray(X, dtype=np.float64)
+        out = np.empty(X.shape, dtype=np.int32)
+        for j, ub in enumerate(self.upper_bounds):
+            col = X[:, j]
+            binned = np.searchsorted(ub, col, side="left")
+            binned[np.isnan(col)] = 0
+            out[:, j] = binned
+        return out
+
+    def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
+        """The raw-value threshold for 'go left if bin <= bin_idx':
+        the upper boundary of that bin. Rows with value <= this boundary
+        land in bins [0..bin_idx]."""
+        ub = self.upper_bounds[feature]
+        if len(ub) == 0:
+            return np.inf
+        bin_idx = min(int(bin_idx), len(ub) - 1)
+        return float(ub[bin_idx])
+
+    # -- persistence --------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {"max_bin": self.max_bin,
+                "upper_bounds": [u.tolist() for u in self.upper_bounds]}
+
+    @staticmethod
+    def from_json(d: dict) -> "BinMapper":
+        return BinMapper([np.asarray(u) for u in d["upper_bounds"]],
+                         d["max_bin"])
+
+
+def _feature_bounds(col: np.ndarray, max_bin: int) -> np.ndarray:
+    """Equal-frequency boundaries for one feature column."""
+    col = col[np.isfinite(col)]
+    if col.size == 0:
+        return np.empty(0)
+    distinct, counts = np.unique(col, return_counts=True)
+    if len(distinct) <= 1:
+        return np.empty(0)
+    if len(distinct) <= max_bin:
+        # one bin per distinct value; boundaries at midpoints
+        return (distinct[:-1] + distinct[1:]) / 2.0
+    # equal-frequency: walk cumulative counts, cut when a bin's quota fills
+    total = counts.sum()
+    per_bin = total / max_bin
+    bounds = []
+    acc = 0.0
+    target = per_bin
+    for i in range(len(distinct) - 1):
+        acc += counts[i]
+        if acc >= target:
+            bounds.append((distinct[i] + distinct[i + 1]) / 2.0)
+            target = acc + per_bin
+            if len(bounds) == max_bin - 1:
+                break
+    return np.asarray(bounds)

@@ -1,0 +1,267 @@
+"""TPUBoostClassifier / TPUBoostRegressor pipeline stages.
+
+Stage-level parity with the reference's LightGBM estimators
+(ref: src/lightgbm/src/main/scala/LightGBMClassifier.scala:36-68,
+LightGBMRegressor.scala, TrainParams.scala:9-61): same param surface
+(numIterations, learningRate, numLeaves, ... objective incl. quantile and
+tweedie), fit() -> Model holding a string-serializable booster, and model
+transform() producing rawPrediction / probability / prediction columns.
+The model re-hydrates its booster lazily from the model string, like
+LightGBMBooster.score (ref: LightGBMBooster.scala:20-33).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    BoolParam, ColParam, EnumParam, FloatParam, HasFeaturesCol, HasLabelCol,
+    HasPredictionCol, IntParam, StringParam, TableParam, range_domain,
+)
+from mmlspark_tpu.core.schema import Field, Schema, VECTOR, F64, I64
+from mmlspark_tpu.core.stage import Estimator, Model
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.gbdt.booster import Booster, train
+
+
+class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
+    """Shared boosting params (ref: TrainParams.scala:9-47)."""
+
+    numIterations = IntParam("number of boosting iterations", default=100,
+                             domain=range_domain(lo=1))
+    learningRate = FloatParam("shrinkage rate", default=0.1,
+                              domain=range_domain(lo=0.0, lo_inc=False))
+    numLeaves = IntParam("max leaves per tree", default=31,
+                         domain=range_domain(lo=2))
+    maxBin = IntParam("max feature bins", default=255,
+                      domain=range_domain(lo=2))
+    maxDepth = IntParam("max tree depth (<=0 unlimited)", default=0)
+    minDataInLeaf = IntParam("min rows per leaf", default=20)
+    minSumHessianInLeaf = FloatParam("min hessian sum per leaf", default=1e-3)
+    lambdaL1 = FloatParam("L1 regularization", default=0.0)
+    lambdaL2 = FloatParam("L2 regularization", default=0.0)
+    minGainToSplit = FloatParam("min gain to split", default=0.0)
+    featureFraction = FloatParam("feature subsample per tree", default=1.0,
+                                 domain=range_domain(lo=0.0, hi=1.0,
+                                                     lo_inc=False))
+    baggingFraction = FloatParam("row subsample fraction", default=1.0,
+                                 domain=range_domain(lo=0.0, hi=1.0,
+                                                     lo_inc=False))
+    baggingFreq = IntParam("bagging frequency (0 off)", default=0)
+    earlyStoppingRound = IntParam("early stopping rounds (0 off)", default=0)
+    boostFromAverage = BoolParam("start from average score", default=True)
+    seed = IntParam("random seed", default=0)
+    weightCol = ColParam("optional row-weight column", default=None)
+    histMethod = EnumParam(["scatter", "onehot"],
+                           "device histogram strategy", default="scatter")
+    parallelism = EnumParam(
+        ["serial", "data"],
+        "tree learner parallelism (ref: TrainParams.scala:26)",
+        default="serial")
+    validationData = TableParam("held-out table for early stopping",
+                                default=None)
+
+    def _train_params(self) -> Dict[str, Any]:
+        return {
+            "num_iterations": self.get("numIterations"),
+            "learning_rate": self.get("learningRate"),
+            "num_leaves": self.get("numLeaves"),
+            "max_bin": self.get("maxBin"),
+            "max_depth": self.get("maxDepth"),
+            "min_data_in_leaf": self.get("minDataInLeaf"),
+            "min_sum_hessian_in_leaf": self.get("minSumHessianInLeaf"),
+            "lambda_l1": self.get("lambdaL1"),
+            "lambda_l2": self.get("lambdaL2"),
+            "min_gain_to_split": self.get("minGainToSplit"),
+            "feature_fraction": self.get("featureFraction"),
+            "bagging_fraction": self.get("baggingFraction"),
+            "bagging_freq": self.get("baggingFreq"),
+            "early_stopping_round": self.get("earlyStoppingRound"),
+            "boost_from_average": self.get("boostFromAverage"),
+            "seed": self.get("seed"),
+            "hist_method": self.get("histMethod"),
+            "parallelism": self.get("parallelism"),
+        }
+
+    def _features_matrix(self, table: DataTable) -> np.ndarray:
+        col = table.column(self.get_features_col())
+        if isinstance(col, np.ndarray) and col.ndim == 2:
+            return np.asarray(col, dtype=np.float64)
+        return np.stack([np.asarray(v, dtype=np.float64) for v in col])
+
+    def _fit_arrays(self, table: DataTable):
+        X = self._features_matrix(table)
+        y = np.asarray(table.column(self.get_label_col()), dtype=np.float64)
+        wcol = self.get_or_none("weightCol")
+        w = (np.asarray(table.column(wcol), dtype=np.float64)
+             if wcol else None)
+        vt = self.get_or_none("validationData")
+        valid = None
+        if vt is not None:
+            valid = (self._features_matrix(vt),
+                     np.asarray(vt.column(self.get_label_col()),
+                                dtype=np.float64))
+        return X, y, w, valid
+
+
+class TPUBoostClassifier(Estimator, _BoostParams):
+    """GBDT classifier (ref: LightGBMClassifier.scala:36)."""
+
+    objective = EnumParam(["binary", "multiclass"],
+                          "classification objective", default="binary")
+    probabilityCol = ColParam("probability output column",
+                              default="probability")
+    rawPredictionCol = ColParam("raw score output column",
+                                default="rawPrediction")
+
+    def fit(self, table: DataTable) -> "TPUBoostClassificationModel":
+        X, y, w, valid = self._fit_arrays(table)
+        classes = np.unique(y)
+        num_class = len(classes)
+        if not np.array_equal(classes, np.arange(num_class)):
+            raise ValueError(
+                f"labels must be 0..K-1 integers, got {classes[:10]}; "
+                f"use ValueIndexer / TrainClassifier for raw labels")
+        params = self._train_params()
+        if num_class > 2:
+            params["objective"] = "multiclass"
+            params["num_class"] = num_class
+        else:
+            params["objective"] = "binary"
+        booster = train(params, X, y, sample_weight=w, valid=valid)
+        model = TPUBoostClassificationModel(
+            modelString=booster.model_to_string(),
+            numClasses=num_class)
+        for name in ("featuresCol", "predictionCol", "probabilityCol",
+                     "rawPredictionCol"):
+            model.set(name, self.get(name))
+        return model
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        schema.require(self.get_features_col())
+        schema.require(self.get_label_col())
+        return (schema
+                .add_or_replace(Field(self.get("rawPredictionCol"), VECTOR))
+                .add_or_replace(Field(self.get("probabilityCol"), VECTOR))
+                .add_or_replace(Field(self.get_prediction_col(), F64)))
+
+
+class TPUBoostClassificationModel(Model, HasFeaturesCol, HasPredictionCol):
+    """Fitted GBDT classifier (ref: LightGBMClassificationModel)."""
+
+    modelString = StringParam("serialized booster", default="")
+    numClasses = IntParam("number of classes", default=2)
+    probabilityCol = ColParam("probability output column",
+                              default="probability")
+    rawPredictionCol = ColParam("raw score output column",
+                                default="rawPrediction")
+
+    def _post_init(self):
+        self._booster: Optional[Booster] = None
+
+    def _on_param_change(self, name):
+        if name == "modelString":
+            self._booster = None
+
+    def get_booster(self) -> Booster:
+        if self._booster is None:
+            self._booster = Booster.from_string(self.get("modelString"))
+        return self._booster
+
+    def transform(self, table: DataTable) -> DataTable:
+        import jax.numpy as jnp
+        X = self._features_matrix(table)
+        booster = self.get_booster()
+        raw = booster.raw_score(X)   # single forest walk; reuse for both
+        prob = np.asarray(booster.objective.transform(jnp.asarray(raw)))
+        if booster.num_class == 1:          # binary
+            raw2 = np.stack([-raw, raw], axis=1)
+            prob2 = np.stack([1 - prob, prob], axis=1)
+        else:
+            raw2 = np.asarray(raw).T
+            prob2 = prob.T
+        pred = np.argmax(prob2, axis=1).astype(np.float64)
+        return (table
+                .with_column(self.get("rawPredictionCol"), raw2)
+                .with_column(self.get("probabilityCol"), prob2)
+                .with_column(self.get_prediction_col(), pred))
+
+    _features_matrix = _BoostParams._features_matrix
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        schema.require(self.get_features_col())
+        return (schema
+                .add_or_replace(Field(self.get("rawPredictionCol"), VECTOR))
+                .add_or_replace(Field(self.get("probabilityCol"), VECTOR))
+                .add_or_replace(Field(self.get_prediction_col(), F64)))
+
+    def save_native_model(self, path: str) -> None:
+        self.get_booster().save_native_model(path)
+
+    def get_feature_importances(self, kind: str = "split") -> np.ndarray:
+        return self.get_booster().feature_importance(kind)
+
+
+class TPUBoostRegressor(Estimator, _BoostParams):
+    """GBDT regressor with quantile/tweedie/poisson/huber objectives
+    (ref: LightGBMRegressor.scala, TrainParams.scala:48-61)."""
+
+    objective = EnumParam(
+        ["regression", "regression_l1", "huber", "quantile", "poisson",
+         "tweedie", "gamma", "l2", "l1", "mae", "mse"],
+        "regression objective", default="regression")
+    alpha = FloatParam("quantile level / huber delta", default=0.9)
+    tweedieVariancePower = FloatParam("tweedie variance power in (1,2)",
+                                      default=1.5)
+
+    def fit(self, table: DataTable) -> "TPUBoostRegressionModel":
+        X, y, w, valid = self._fit_arrays(table)
+        params = self._train_params()
+        params["objective"] = self.get("objective")
+        params["alpha"] = self.get("alpha")
+        params["tweedie_variance_power"] = self.get("tweedieVariancePower")
+        booster = train(params, X, y, sample_weight=w, valid=valid)
+        model = TPUBoostRegressionModel(modelString=booster.model_to_string())
+        for name in ("featuresCol", "predictionCol"):
+            model.set(name, self.get(name))
+        return model
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        schema.require(self.get_features_col())
+        schema.require(self.get_label_col())
+        return schema.add_or_replace(Field(self.get_prediction_col(), F64))
+
+
+class TPUBoostRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
+    modelString = StringParam("serialized booster", default="")
+
+    def _post_init(self):
+        self._booster: Optional[Booster] = None
+
+    def _on_param_change(self, name):
+        if name == "modelString":
+            self._booster = None
+
+    def get_booster(self) -> Booster:
+        if self._booster is None:
+            self._booster = Booster.from_string(self.get("modelString"))
+        return self._booster
+
+    _features_matrix = _BoostParams._features_matrix
+
+    def transform(self, table: DataTable) -> DataTable:
+        X = self._features_matrix(table)
+        pred = np.asarray(self.get_booster().predict(X), dtype=np.float64)
+        return table.with_column(self.get_prediction_col(), pred)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        schema.require(self.get_features_col())
+        return schema.add_or_replace(Field(self.get_prediction_col(), F64))
+
+    def save_native_model(self, path: str) -> None:
+        self.get_booster().save_native_model(path)
+
+    def get_feature_importances(self, kind: str = "split") -> np.ndarray:
+        return self.get_booster().feature_importance(kind)

@@ -1,0 +1,105 @@
+"""Histogram building — the GBDT hot loop, on device.
+
+The reference's hot loop is LightGBM's native histogram construction with
+a socket allreduce between workers per iteration
+(ref: src/lightgbm/src/main/scala/TrainUtils.scala:82-89 — distributed
+sync happens inside ``LGBM_BoosterUpdateOneIter``). Here the histogram is
+an XLA program and the allreduce is ``lax.psum`` over the mesh's data
+axis — riding ICI instead of ethernet sockets.
+
+Two device strategies, one contract:
+  - 'scatter': segment_sum scatter-add. Best on CPU and fine on TPU for
+    small bin counts.
+  - 'onehot': stats×one-hot einsum over row chunks — turns the histogram
+    into matmuls the MXU executes directly. Chunked with lax.scan so peak
+    memory is chunk×F×B, not N×F×B.
+
+Output layout: (3, L, F, B) float32 — channels grad / hess / count,
+L leaf slots, F features, B bins.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+                    weight: jnp.ndarray, leaf_of_row: jnp.ndarray,
+                    num_leaves: int, num_bins: int,
+                    method: str = "scatter",
+                    axis_name: Optional[str] = None) -> jnp.ndarray:
+    """Per-(leaf, feature, bin) sums of grad/hess/count.
+
+    bins: (N, F) int32; grad/hess/weight: (N,) f32; leaf_of_row: (N,) int32.
+    weight doubles as the padding/bagging mask (0 = row ignored).
+    Returns (3, L, F, B) f32, psum'd over ``axis_name`` when given.
+    """
+    if method == "onehot":
+        hist = _hist_onehot(bins, grad, hess, weight, leaf_of_row,
+                            num_leaves, num_bins)
+    else:
+        hist = _hist_scatter(bins, grad, hess, weight, leaf_of_row,
+                             num_leaves, num_bins)
+    if axis_name is not None:
+        hist = lax.psum(hist, axis_name)
+    return hist
+
+
+def _hist_scatter(bins, grad, hess, weight, leaf_of_row,
+                  num_leaves, num_bins):
+    n, f = bins.shape
+    lfb = num_leaves * f * num_bins
+    # flat segment id per (row, feature): ((leaf * F) + f) * B + bin
+    seg = (leaf_of_row[:, None] * f + jnp.arange(f)[None, :]) * num_bins + bins
+    seg = seg.reshape(-1)
+
+    def one(values):
+        v = jnp.broadcast_to(values[:, None], (n, f)).reshape(-1)
+        return jax.ops.segment_sum(v, seg, num_segments=lfb,
+                                   indices_are_sorted=False)
+
+    g = one(grad * weight)
+    h = one(hess * weight)
+    c = one(weight)
+    return jnp.stack([g, h, c]).reshape(3, num_leaves, f, num_bins)
+
+
+def _hist_onehot(bins, grad, hess, weight, leaf_of_row,
+                 num_leaves, num_bins, chunk: int = 4096):
+    n, f = bins.shape
+    x = f * num_bins
+    pad = (-n) % chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        grad = jnp.pad(grad, (0, pad))
+        hess = jnp.pad(hess, (0, pad))
+        weight = jnp.pad(weight, (0, pad))  # pad rows weight 0 → no effect
+        leaf_of_row = jnp.pad(leaf_of_row, (0, pad))
+    steps = (n + pad) // chunk
+    bins_c = bins.reshape(steps, chunk, f)
+    grad_c = grad.reshape(steps, chunk)
+    hess_c = hess.reshape(steps, chunk)
+    w_c = weight.reshape(steps, chunk)
+    leaf_c = leaf_of_row.reshape(steps, chunk)
+
+    def body(acc, args):
+        b, g, h, w, l = args
+        stats = jnp.stack([g * w, h * w, w], axis=0)          # (3, C)
+        leaf_oh = jax.nn.one_hot(l, num_leaves,
+                                 dtype=jnp.float32)            # (C, L)
+        lhs = stats[:, None, :] * leaf_oh.T[None, :, :]        # (3, L, C)
+        bin_oh = jax.nn.one_hot(b, num_bins, dtype=jnp.float32)  # (C, F, B)
+        rhs = bin_oh.reshape(chunk, x)                         # (C, F*B)
+        contrib = jnp.einsum(
+            "slc,cx->slx", lhs, rhs,
+            preferred_element_type=jnp.float32)                # (3, L, X)
+        return acc + contrib, None
+
+    init = jnp.zeros((3, num_leaves, x), dtype=jnp.float32)
+    acc, _ = lax.scan(body, init, (bins_c, grad_c, hess_c, w_c, leaf_c))
+    return acc.reshape(3, num_leaves, f, num_bins)

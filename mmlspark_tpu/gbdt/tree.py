@@ -1,0 +1,233 @@
+"""Leaf-wise tree growth as one jitted XLA program.
+
+Where LightGBM grows trees in native C++ with pointer-chasing node
+structures (driven from ref: src/lightgbm/src/main/scala/TrainUtils.scala
+:82-89 ``LGBM_BoosterUpdateOneIter``), the TPU design makes the whole
+tree a fixed-shape tensor program: a ``lax.fori_loop`` over ``num_leaves-1``
+split steps, each step = histogram pass (MXU/scatter) → vectorized best-gain
+scan over (leaf, feature, bin) → masked leaf reassignment. All shapes are
+static (L leaf slots, 2L-1 node slots), so XLA compiles it once per
+dataset shape and every iteration reuses the executable.
+
+Distributed: when ``axis_name`` is set the histogram is psum'd across the
+mesh data axis, so all devices see identical split decisions and grow
+identical trees on disjoint row shards — the collective-based equivalent
+of LightGBM's data-parallel tree learner (ref: TrainParams.scala:26
+``tree_learner=data``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mmlspark_tpu.gbdt.histogram import build_histogram
+
+NEG_INF = -1e30
+
+
+class GrowParams(NamedTuple):
+    """Static growth hyperparams (hashable → part of the jit key)."""
+    num_leaves: int = 31
+    num_bins: int = 64
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    max_depth: int = 0  # <=0 means unlimited
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    min_gain_to_split: float = 0.0
+    hist_method: str = "scatter"
+
+
+class Tree(NamedTuple):
+    """Flat tree arrays; node 0 is the root, max 2L-1 nodes.
+
+    Leaves have left == right == own index (self-loop), which makes batch
+    inference a fixed-depth pointer-walk with gathers (see predict_trees).
+    """
+    feature: jnp.ndarray      # (M,) int32 split feature (internal nodes)
+    bin_threshold: jnp.ndarray  # (M,) int32 'go left if bin <= t'
+    threshold: jnp.ndarray    # (M,) f32 raw-value threshold (filled on host)
+    left: jnp.ndarray         # (M,) int32
+    right: jnp.ndarray        # (M,) int32
+    value: jnp.ndarray        # (M,) f32 leaf output
+    is_leaf: jnp.ndarray      # (M,) bool
+    gain: jnp.ndarray         # (M,) f32 split gain at internal nodes
+    count: jnp.ndarray        # (M,) f32 row count at node
+
+
+def _leaf_output(g, h, l1, l2):
+    """Optimal leaf value with L1 soft-thresholding (LightGBM's
+    ThresholdL1): -sgn(g)·max(|g|-l1, 0) / (h + l2)."""
+    num = jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+    return -num / (h + l2)
+
+
+def _split_gain(g, h, l1, l2):
+    num = jnp.maximum(jnp.abs(g) - l1, 0.0)
+    return num * num / (h + l2)
+
+
+@partial(jax.jit, static_argnames=("p", "axis_name"))
+def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+              weight: jnp.ndarray, feature_mask: jnp.ndarray,
+              p: GrowParams, axis_name: Optional[str] = None):
+    """Grow one tree; returns (Tree, leaf_of_row, leaf_values_per_slot).
+
+    bins (N,F) int32; grad/hess/weight (N,) f32; feature_mask (F,) f32
+    (0 disables a feature this tree — featureFraction sampling).
+    """
+    n, f = bins.shape
+    L = p.num_leaves
+    M = 2 * L - 1
+    B = p.num_bins
+
+    leaf_of_row = jnp.zeros(n, dtype=jnp.int32)
+
+    state = dict(
+        leaf_of_row=leaf_of_row,
+        n_leaves=jnp.int32(1),
+        next_node=jnp.int32(1),
+        done=jnp.bool_(False),
+        feature=jnp.zeros(M, jnp.int32),
+        bin_threshold=jnp.zeros(M, jnp.int32),
+        left=jnp.arange(M, dtype=jnp.int32),   # self-loops by default
+        right=jnp.arange(M, dtype=jnp.int32),
+        is_leaf=jnp.ones(M, dtype=bool),
+        gain_arr=jnp.zeros(M, jnp.float32),
+        count_arr=jnp.zeros(M, jnp.float32),
+        # leaf slot -> node id; slot 0 starts at root
+        leaf_to_node=jnp.zeros(L, dtype=jnp.int32),
+        leaf_depth=jnp.zeros(L, dtype=jnp.int32),
+    )
+
+    min_hess = p.min_sum_hessian_in_leaf
+    min_data = float(p.min_data_in_leaf)
+
+    def body(_, st):
+        hist = build_histogram(
+            bins, grad, hess, weight, st["leaf_of_row"], L, B,
+            method=p.hist_method, axis_name=axis_name)   # (3, L, F, B)
+        Gh, Hh, Ch = hist[0], hist[1], hist[2]
+        # per-leaf totals (any feature partitions all rows; use feature 0)
+        G = jnp.sum(Gh[:, 0, :], axis=-1)                # (L,)
+        H = jnp.sum(Hh[:, 0, :], axis=-1)
+        C = jnp.sum(Ch[:, 0, :], axis=-1)
+        GL = jnp.cumsum(Gh, axis=-1)                     # (L, F, B)
+        HL = jnp.cumsum(Hh, axis=-1)
+        CL = jnp.cumsum(Ch, axis=-1)
+        GR = G[:, None, None] - GL
+        HR = H[:, None, None] - HL
+        CR = C[:, None, None] - CL
+        parent_score = _split_gain(G, H, p.lambda_l1, p.lambda_l2)
+        gain = (_split_gain(GL, HL, p.lambda_l1, p.lambda_l2)
+                + _split_gain(GR, HR, p.lambda_l1, p.lambda_l2)
+                - parent_score[:, None, None])
+        active = jnp.arange(L) < st["n_leaves"]
+        if p.max_depth > 0:
+            active = active & (st["leaf_depth"] < p.max_depth)
+        ok = ((CL >= min_data) & (CR >= min_data)
+              & (HL >= min_hess) & (HR >= min_hess)
+              & active[:, None, None]
+              & (feature_mask[None, :, None] > 0))
+        gain = jnp.where(ok, gain, NEG_INF)
+        flat = jnp.argmax(gain)
+        best_gain = gain.reshape(-1)[flat]
+        lfb = jnp.unravel_index(flat, gain.shape)
+        bl, bf, bb = (x.astype(jnp.int32) for x in lfb)
+
+        do = (~st["done"]) & (best_gain > p.min_gain_to_split) \
+            & (best_gain > NEG_INF / 2)
+
+        new_leaf = st["n_leaves"]
+        goes_right = (st["leaf_of_row"] == bl) & (bins[:, bf] > bb)
+        leaf_of_row2 = jnp.where(goes_right & do, new_leaf,
+                                 st["leaf_of_row"])
+
+        parent = st["leaf_to_node"][bl]
+        lid = st["next_node"]
+        rid = st["next_node"] + 1
+
+        def upd(arr, idx, val):
+            return arr.at[idx].set(jnp.where(do, val, arr[idx]))
+
+        st2 = dict(st)
+        st2["leaf_of_row"] = leaf_of_row2
+        st2["feature"] = upd(st["feature"], parent, bf)
+        st2["bin_threshold"] = upd(st["bin_threshold"], parent, bb)
+        st2["left"] = upd(st["left"], parent, lid)
+        st2["right"] = upd(st["right"], parent, rid)
+        st2["is_leaf"] = st["is_leaf"].at[parent].set(
+            jnp.where(do, False, st["is_leaf"][parent]))
+        st2["gain_arr"] = upd(st["gain_arr"], parent, best_gain)
+        cl_best = CL[bl, bf, bb]
+        st2["count_arr"] = upd(
+            upd(st["count_arr"], lid, cl_best), rid, C[bl] - cl_best)
+        st2["leaf_to_node"] = upd(
+            upd(st["leaf_to_node"], bl, lid), new_leaf, rid)
+        child_depth = st["leaf_depth"][bl] + 1
+        st2["leaf_depth"] = upd(
+            upd(st["leaf_depth"], bl, child_depth), new_leaf, child_depth)
+        st2["n_leaves"] = st["n_leaves"] + jnp.where(do, 1, 0)
+        st2["next_node"] = st["next_node"] + jnp.where(do, 2, 0)
+        st2["done"] = st["done"] | (~do)
+        return st2
+
+    st = lax.fori_loop(0, L - 1, body, state)
+
+    # final per-leaf-slot sums → leaf values (tiny 1-D histogram over slots)
+    seg = st["leaf_of_row"]
+    g_leaf = jax.ops.segment_sum(grad * weight, seg, num_segments=L)
+    h_leaf = jax.ops.segment_sum(hess * weight, seg, num_segments=L)
+    if axis_name is not None:
+        g_leaf = lax.psum(g_leaf, axis_name)
+        h_leaf = lax.psum(h_leaf, axis_name)
+    leaf_values = _leaf_output(g_leaf, h_leaf, p.lambda_l1, p.lambda_l2)
+    active = jnp.arange(L) < st["n_leaves"]
+    leaf_values = jnp.where(active, leaf_values, 0.0)
+
+    # inactive slots all hold leaf_to_node=0; route them to a dummy slot M
+    # so the scatter can't zero the root's value (node 0)
+    scatter_idx = jnp.where(active, st["leaf_to_node"], M)
+    value = jnp.zeros(M + 1, jnp.float32).at[scatter_idx].set(
+        jnp.where(active, leaf_values, 0.0))[:M]
+
+    tree = Tree(feature=st["feature"],
+                bin_threshold=st["bin_threshold"],
+                threshold=jnp.zeros(M, jnp.float32),
+                left=st["left"], right=st["right"],
+                value=value, is_leaf=st["is_leaf"],
+                gain=st["gain_arr"], count=st["count_arr"])
+    return tree, st["leaf_of_row"], leaf_values, st["n_leaves"]
+
+
+@partial(jax.jit, static_argnames=("max_depth",))
+def predict_trees(features: jnp.ndarray, feature_arr: jnp.ndarray,
+                  threshold_arr: jnp.ndarray, left_arr: jnp.ndarray,
+                  right_arr: jnp.ndarray, value_arr: jnp.ndarray,
+                  max_depth: int) -> jnp.ndarray:
+    """Batch inference over stacked trees.
+
+    features (N, F) f32; tree arrays (T, M). Returns (T, N) leaf outputs.
+    Fixed-depth pointer walk: leaves self-loop, so walking max_depth steps
+    from the root always lands on the reached leaf — no data-dependent
+    control flow, pure gathers that XLA vectorizes.
+    """
+    def one_tree(feat, thr, lft, rgt, val):
+        def step(node, _):
+            f = feat[node]                       # (N,)
+            fv = features[jnp.arange(features.shape[0]), f]
+            # NaN must go LEFT to match training, where binning maps NaN
+            # to bin 0 (binning.py); `~(fv > thr)` is True for NaN
+            go_left = ~(fv > thr[node])
+            return jnp.where(go_left, lft[node], rgt[node]), None
+        node0 = jnp.zeros(features.shape[0], dtype=jnp.int32)
+        node, _ = lax.scan(step, node0, None, length=max_depth)
+        return val[node]
+
+    return jax.vmap(one_tree)(feature_arr, threshold_arr, left_arr,
+                              right_arr, value_arr)

@@ -1,0 +1,156 @@
+"""ImageFeaturizer — transfer learning via truncated pretrained networks.
+
+TPU-native analog of the reference's image-featurizer
+(ref: src/image-featurizer/src/main/scala/ImageFeaturizer.scala:36-141):
+the reference composes ImageTransformer.resize → UnrollImage → CNTKModel
+with ``cutOutputLayers`` removing the head layers. Here the zoo network is
+a flax module whose ``feature_layers()`` names its capture points; cutting
+N output layers means capturing at ``feature_layers()[-N]`` and running
+one jitted forward per minibatch, batch sharded over the mesh data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.params import (
+    BoolParam, DictParam, HasInputCol, HasOutputCol, IntParam, PyTreeParam,
+    StringParam,
+)
+from mmlspark_tpu.core.schema import Field, ImageSchema, Schema, VECTOR
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.models.networks import build_network
+from mmlspark_tpu.ops import image_ops
+from mmlspark_tpu.parallel import mesh as mesh_lib
+
+
+class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
+    """Resize images, forward through a truncated zoo network, emit the
+    captured activation as a flat feature vector column."""
+
+    networkSpec = DictParam(
+        "declarative network spec (models.networks.build_network)",
+        default=None)
+    weights = PyTreeParam("flax variables pytree", default=None)
+    cutOutputLayers = IntParam(
+        "how many output layers to cut; 0 = keep head "
+        "(ref: ImageFeaturizer.scala cutOutputLayers :91)", default=1)
+    inputHeight = IntParam("network input height", default=32)
+    inputWidth = IntParam("network input width", default=32)
+    inputChannels = IntParam("network input channels", default=3)
+    scaleImage = BoolParam("scale uint8 [0,255] to [0,1]", default=True)
+    batchSize = IntParam("inference minibatch size", default=64)
+    modelName = StringParam("zoo model name (informational)", default="")
+
+    def __init__(self, **kw):
+        kw.setdefault("inputCol", "image")
+        kw.setdefault("outputCol", "features")
+        super().__init__(**kw)
+
+    def _post_init(self):
+        self._module = None
+        self._jitted = None
+        self._mesh = None
+
+    def _on_param_change(self, name: str) -> None:
+        if name in ("networkSpec", "cutOutputLayers"):
+            self._module = None
+            self._jitted = None
+
+    # -- construction from the model zoo ------------------------------------
+
+    @staticmethod
+    def from_model_schema(schema, downloader, **kw) -> "ImageFeaturizer":
+        """Build from a downloader ModelSchema
+        (ref: ImageFeaturizer.setModel(ModelSchema))."""
+        variables = downloader.load_variables(schema.name)
+        feat = ImageFeaturizer(networkSpec=schema.network_spec,
+                               weights=variables,
+                               modelName=schema.name, **kw)
+        if len(schema.input_shape) == 3:
+            h, w, c = schema.input_shape
+            feat.set("inputHeight", int(h))
+            feat.set("inputWidth", int(w))
+            feat.set("inputChannels", int(c))
+        return feat
+
+    def set_mesh(self, mesh) -> "ImageFeaturizer":
+        self._mesh = mesh
+        return self
+
+    # -- forward ------------------------------------------------------------
+
+    def _get_module(self):
+        if self._module is None:
+            spec = self.get("networkSpec")
+            if spec is None:
+                raise ValueError("networkSpec is not set")
+            self._module = build_network(spec)
+        return self._module
+
+    def _capture_layer(self) -> Optional[str]:
+        cut = self.get("cutOutputLayers")
+        if cut <= 0:
+            return None
+        layers = self._get_module().feature_layers()
+        if cut > len(layers):
+            raise ValueError(
+                f"cutOutputLayers={cut} but network has only "
+                f"{len(layers)} feature layers: {layers}")
+        return layers[-cut]
+
+    def _forward(self):
+        if self._jitted is None:
+            module = self._get_module()
+            capture = self._capture_layer()
+
+            def run(variables, x):
+                out = module.apply(variables, x, capture=capture)
+                return out.reshape((x.shape[0], -1)).astype(jnp.float32)
+
+            self._jitted = jax.jit(run)
+        return self._jitted
+
+    def transform(self, table: DataTable) -> DataTable:
+        h, w = self.get("inputHeight"), self.get("inputWidth")
+        rows = table[self.get_input_col()]
+        variables = self.get("weights")
+        if not (isinstance(variables, dict)
+                and ("params" in variables or not variables)):
+            variables = {"params": variables}
+        mesh = self._mesh or mesh_lib.make_mesh()
+        fwd = self._forward()
+        bs = self.get("batchSize")
+        scale = 1.0 / 255.0 if self.get("scaleImage") else 1.0
+
+        imgs = []
+        for r in rows:
+            img = np.asarray(r[ImageSchema.DATA], dtype=np.float32)
+            if img.ndim == 2:
+                img = img[:, :, None]
+            if img.shape[:2] != (h, w):
+                img = image_ops.resize_host(img, h, w)
+            imgs.append(img * scale)
+        feats: List[np.ndarray] = []
+        for start in range(0, len(imgs), bs):
+            batch = np.stack(imgs[start:start + bs])
+            sharded, true_len = mesh_lib.shard_batch(mesh, batch)
+            out = np.asarray(fwd(variables, sharded))[:true_len]
+            feats.append(out)
+        merged = (np.concatenate(feats, axis=0) if feats
+                  else np.empty((0, 0), np.float32))
+        return table.with_column(self.get_output_col(), merged,
+                                 Field(self.get_output_col(), VECTOR))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        f = schema[self.get_input_col()]
+        if not ImageSchema.is_image(f):
+            raise TypeError(
+                f"column {self.get_input_col()!r} is not an image column")
+        return schema.add_or_replace(Field(self.get_output_col(), VECTOR))

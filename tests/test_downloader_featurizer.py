@@ -1,0 +1,139 @@
+"""ModelDownloader + ImageFeaturizer tests
+(ref strategy: downloader DownloaderSuite + image-featurizer
+ImageFeaturizerSuite — fetch from repo, verify, featurize tiny images)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.schema import ImageSchema
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.downloader import LocalRepo, ModelDownloader, ModelSchema
+from mmlspark_tpu.models.networks import build_network
+from mmlspark_tpu.stages.featurizer import ImageFeaturizer
+
+
+@pytest.fixture(scope="module")
+def zoo(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("zoo")
+    repo = LocalRepo(str(tmp / "repo"))
+    spec = {"type": "resnet", "stage_sizes": [1, 1, 1], "width": 8,
+            "num_classes": 10}
+    mod = build_network(spec)
+    variables = mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    schema = repo.publish("ResNet_tiny", spec, variables, dataset="CIFAR",
+                          model_type="image", input_shape=[32, 32, 3],
+                          layer_names=mod.feature_layers())
+    dl = ModelDownloader(str(tmp / "cache"), repo=repo)
+    return repo, dl, schema
+
+
+def _image_table(n=6, hw=(32, 32), seed=0):
+    rng = np.random.default_rng(seed)
+    rows = [ImageSchema.make_row(
+        f"img{i}", rng.integers(0, 255, (*hw, 3)).astype(np.uint8), "RGB")
+        for i in range(n)]
+    return DataTable({"image": rows})
+
+
+class TestModelDownloader:
+    def test_download_and_verify(self, zoo):
+        _, dl, schema = zoo
+        s2 = dl.download_by_name("ResNet_tiny")
+        assert s2.sha256 == schema.sha256
+        assert s2.network_spec["type"] == "resnet"
+
+    def test_cached_fetch_without_repo(self, zoo):
+        _, dl, _ = zoo
+        dl.download_by_name("ResNet_tiny")
+        dl2 = ModelDownloader(dl.local.path, repo=None)
+        assert dl2.download_by_name("ResNet_tiny").name == "ResNet_tiny"
+
+    def test_unknown_model_raises(self, zoo):
+        _, dl, _ = zoo
+        with pytest.raises(KeyError):
+            dl.download_by_name("NoSuchModel")
+
+    def test_load_variables_shapes(self, zoo):
+        _, dl, _ = zoo
+        v = dl.load_variables("ResNet_tiny")
+        assert "params" in v
+
+    def test_corruption_detected(self, zoo, tmp_path):
+        repo = LocalRepo(str(tmp_path / "r2"))
+        spec = {"type": "mlp", "features": [4], "num_classes": 2}
+        mod = build_network(spec)
+        variables = mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+        schema = repo.publish("m", spec, variables, input_shape=[8])
+        blob = repo.blob_path(schema)
+        with open(blob, "r+b") as f:
+            f.seek(0)
+            f.write(b"corrupted!")
+        with pytest.raises(IOError, match="sha256"):
+            repo.read_blob(schema)
+
+    def test_list_models(self, zoo):
+        _, dl, _ = zoo
+        names = [s.name for s in dl.list_models()]
+        assert "ResNet_tiny" in names
+
+
+class TestImageFeaturizer:
+    def test_featurize_cut1(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(schema, dl,
+                                                 cutOutputLayers=1)
+        out = feat.transform(_image_table())
+        f = out["features"]
+        assert f.shape == (6, 32)  # pool layer of width-8 resnet: 8*4
+        assert np.isfinite(f).all()
+
+    def test_deeper_cut_gives_spatial_features(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(schema, dl,
+                                                 cutOutputLayers=2)
+        out = feat.transform(_image_table())
+        assert out["features"].shape[1] > 32
+
+    def test_keep_head(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(schema, dl,
+                                                 cutOutputLayers=0)
+        out = feat.transform(_image_table())
+        assert out["features"].shape == (6, 10)  # logits
+
+    def test_resizes_nonconforming_images(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(schema, dl)
+        out = feat.transform(_image_table(hw=(48, 64)))
+        assert out["features"].shape == (6, 32)
+
+    def test_schema_propagation(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(schema, dl)
+        t = _image_table(2)
+        out_schema = feat.transform_schema(t.schema)
+        assert "features" in out_schema.names
+
+    def test_save_load_roundtrip(self, zoo, tmp_path):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(schema, dl,
+                                                 cutOutputLayers=1)
+        t = _image_table(3)
+        ref = feat.transform(t)["features"]
+        path = str(tmp_path / "featurizer")
+        feat.save(path)
+        feat2 = ImageFeaturizer.load(path)
+        np.testing.assert_allclose(feat2.transform(t)["features"], ref,
+                                   atol=1e-5)
+
+    def test_cut_too_deep_raises(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(schema, dl,
+                                                 cutOutputLayers=99)
+        with pytest.raises(ValueError, match="feature layers"):
+            feat.transform(_image_table(2))

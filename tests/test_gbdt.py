@@ -1,0 +1,340 @@
+"""GBDT engine tests.
+
+Modeled on the reference's LightGBM suites: small-data correctness plus
+benchmark-CSV-style accuracy floors
+(ref: src/lightgbm/src/test/resources/benchmarks_VerifyLightGBMClassifier.csv
+— e.g. breast-cancer AUC 0.9925) and the distributed-without-a-cluster
+pattern (ref: SURVEY.md §4 — partitions as nodes on localhost; here:
+shard_map over the 8-device virtual CPU mesh).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.gbdt import (
+    BinMapper, Booster, TPUBoostClassifier, TPUBoostRegressor, train,
+)
+from mmlspark_tpu.gbdt.histogram import build_histogram
+from mmlspark_tpu.parallel import mesh as mesh_lib
+
+import jax.numpy as jnp
+
+
+def _auc(y, p):
+    from sklearn.metrics import roc_auc_score
+    return roc_auc_score(y, p)
+
+
+@pytest.fixture(scope="module")
+def breast_cancer():
+    from sklearn.datasets import load_breast_cancer
+    return load_breast_cancer(return_X_y=True)
+
+
+class TestBinning:
+    def test_few_distinct_values(self):
+        X = np.asarray([[0.0], [1.0], [1.0], [2.0]])
+        m = BinMapper.fit(X, max_bin=255)
+        assert m.num_bins[0] == 3
+        b = m.transform(X)
+        assert list(b[:, 0]) == [0, 1, 1, 2]
+
+    def test_quantile_bins_roughly_equal(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(10_000, 1))
+        m = BinMapper.fit(X, max_bin=16)
+        b = m.transform(X)
+        counts = np.bincount(b[:, 0], minlength=16)
+        assert counts.min() > 300  # ~625 expected per bin
+
+    def test_nan_goes_to_bin_zero(self):
+        X = np.asarray([[np.nan], [1.0], [2.0]])
+        m = BinMapper.fit(X, max_bin=8)
+        assert m.transform(X)[0, 0] == 0
+
+    def test_threshold_value_separates(self):
+        X = np.linspace(0, 1, 100).reshape(-1, 1)
+        m = BinMapper.fit(X, max_bin=10)
+        b = m.transform(X)
+        thr = m.bin_threshold_value(0, 4)
+        lhs = X[b[:, 0] <= 4, 0]
+        rhs = X[b[:, 0] > 4, 0]
+        assert lhs.max() <= thr < rhs.min()
+
+    def test_json_roundtrip(self):
+        X = np.random.default_rng(1).normal(size=(500, 3))
+        m = BinMapper.fit(X, max_bin=32)
+        m2 = BinMapper.from_json(m.to_json())
+        assert np.array_equal(m.transform(X), m2.transform(X))
+
+
+class TestHistogram:
+    def test_scatter_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        n, f, L, B = 200, 3, 4, 8
+        bins = rng.integers(0, B, size=(n, f)).astype(np.int32)
+        grad = rng.normal(size=n).astype(np.float32)
+        hess = rng.uniform(0.1, 1, size=n).astype(np.float32)
+        w = (rng.random(n) < 0.8).astype(np.float32)
+        leaf = rng.integers(0, L, size=n).astype(np.int32)
+        hist = np.asarray(build_histogram(
+            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(w), jnp.asarray(leaf), L, B, method="scatter"))
+        # numpy reference
+        ref = np.zeros((3, L, f, B), np.float64)
+        for i in range(n):
+            for j in range(f):
+                ref[0, leaf[i], j, bins[i, j]] += grad[i] * w[i]
+                ref[1, leaf[i], j, bins[i, j]] += hess[i] * w[i]
+                ref[2, leaf[i], j, bins[i, j]] += w[i]
+        np.testing.assert_allclose(hist, ref, rtol=1e-4, atol=1e-4)
+
+    def test_onehot_matches_scatter(self):
+        rng = np.random.default_rng(1)
+        n, f, L, B = 500, 4, 6, 16
+        bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+        grad = jnp.asarray(rng.normal(size=n), jnp.float32)
+        hess = jnp.asarray(rng.uniform(0.1, 1, size=n), jnp.float32)
+        w = jnp.ones(n, jnp.float32)
+        leaf = jnp.asarray(rng.integers(0, L, size=n), jnp.int32)
+        h1 = build_histogram(bins, grad, hess, w, leaf, L, B, "scatter")
+        h2 = build_histogram(bins, grad, hess, w, leaf, L, B, "onehot")
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                                   rtol=1e-3, atol=1e-3)
+
+
+class TestBoosterTraining:
+    def test_binary_auc_benchmark_floor(self, breast_cancer):
+        # accuracy floor from the reference's benchmark CSV (0.9925 on
+        # full data with native LightGBM; we assert a holdout floor)
+        X, y = breast_cancer
+        rng = np.random.default_rng(0)
+        idx = rng.permutation(len(y))
+        tr, te = idx[:400], idx[400:]
+        b = train({"objective": "binary", "num_iterations": 100}, X[tr], y[tr])
+        assert _auc(y[te], b.predict(X[te])) > 0.97
+
+    def test_overfits_train_set(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 50,
+                   "min_data_in_leaf": 5}, X, y)
+        assert _auc(y, b.predict(X)) > 0.999
+
+    def test_multiclass(self):
+        from sklearn.datasets import load_iris
+        X, y = load_iris(return_X_y=True)
+        b = train({"objective": "multiclass", "num_class": 3,
+                   "num_iterations": 30, "min_data_in_leaf": 5}, X, y)
+        pred = b.predict(X)
+        assert pred.shape == (150, 3)
+        np.testing.assert_allclose(pred.sum(axis=1), 1.0, atol=1e-5)
+        assert (pred.argmax(1) == y).mean() > 0.95
+
+    def test_regression_r2(self):
+        from sklearn.datasets import load_diabetes
+        X, y = load_diabetes(return_X_y=True)
+        b = train({"objective": "regression", "num_iterations": 100,
+                   "min_data_in_leaf": 10}, X, y)
+        p = b.predict(X)
+        assert 1 - ((p - y) ** 2).mean() / y.var() > 0.9
+
+    def test_quantile_coverage(self):
+        from sklearn.datasets import load_diabetes
+        X, y = load_diabetes(return_X_y=True)
+        b = train({"objective": "quantile", "alpha": 0.9,
+                   "num_iterations": 50, "min_data_in_leaf": 10}, X, y)
+        cov = (y <= b.predict(X)).mean()
+        assert 0.85 < cov < 0.95
+
+    def test_tweedie_positive(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 5))
+        y = np.exp(X[:, 0]) * rng.gamma(2.0, 1.0, size=300)
+        b = train({"objective": "tweedie", "num_iterations": 30,
+                   "min_data_in_leaf": 10}, X, y)
+        assert (b.predict(X) > 0).all()
+
+    def test_l1_and_poisson_run(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 3))
+        y_l1 = X[:, 0] * 2 + rng.normal(size=200)
+        b = train({"objective": "l1", "num_iterations": 20,
+                   "min_data_in_leaf": 5}, X, y_l1)
+        assert np.isfinite(b.predict(X)).all()
+        y_pois = rng.poisson(np.exp(0.5 * X[:, 1]))
+        b = train({"objective": "poisson", "num_iterations": 20,
+                   "min_data_in_leaf": 5}, X, y_pois.astype(float))
+        assert (b.predict(X) > 0).all()
+
+    def test_early_stopping(self, breast_cancer):
+        X, y = breast_cancer
+        rng = np.random.default_rng(0)
+        idx = rng.permutation(len(y))
+        tr, te = idx[:350], idx[350:]
+        b = train({"objective": "binary", "num_iterations": 500,
+                   "early_stopping_round": 10},
+                  X[tr], y[tr], valid=(X[te], y[te]))
+        assert 0 < b.best_iteration < 500
+
+    def test_max_depth_respected(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 5,
+                   "max_depth": 3}, X, y)
+        assert max(b.tree_depths) <= 3
+
+    def test_feature_bagging_options(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 20,
+                   "feature_fraction": 0.5, "bagging_fraction": 0.7,
+                   "bagging_freq": 1}, X, y)
+        assert _auc(y, b.predict(X)) > 0.95
+
+    def test_sample_weight_shifts_model(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 2))
+        y = (X[:, 0] > 0).astype(float)
+        w = np.where(y == 1, 10.0, 1.0)
+        b = train({"objective": "binary", "num_iterations": 10}, X, y,
+                  sample_weight=w)
+        bu = train({"objective": "binary", "num_iterations": 10}, X, y)
+        assert b.predict(X).mean() > bu.predict(X).mean()
+
+
+class TestEdgeCases:
+    def test_nan_routing_consistent_train_predict(self):
+        # NaN maps to bin 0 (left) in training; inference must agree
+        rng = np.random.default_rng(0)
+        n = 300
+        X = rng.normal(size=(n, 3))
+        X[:60, 0] = np.nan
+        y = ((np.nan_to_num(X[:, 0], nan=-5.0) > 0)).astype(float)
+        b = train({"objective": "binary", "num_iterations": 20,
+                   "min_data_in_leaf": 5}, X, y)
+        p = b.predict(X)
+        # NaN rows are all label 0; a consistent model predicts them low
+        assert p[:60].max() < 0.5
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, p) > 0.99
+
+    def test_unsplittable_data_predicts_base_score(self):
+        # no split possible (n < 2*min_data_in_leaf): trees are single
+        # leaves; prediction must still reflect accumulated leaf values
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(30, 2))
+        y = np.full(30, 5.17)
+        b = train({"objective": "regression", "num_iterations": 50,
+                   "min_data_in_leaf": 20, "boost_from_average": False},
+                  X, y)
+        # single-leaf trees converge geometrically: 5.17*(1-0.9^50)
+        np.testing.assert_allclose(b.predict(X), np.full(30, 5.17),
+                                   rtol=0.02)
+
+    def test_constant_features_no_crash(self):
+        X = np.ones((100, 3))
+        y = np.random.default_rng(0).random(100)
+        b = train({"objective": "regression", "num_iterations": 3}, X, y)
+        assert np.isfinite(b.predict(X)).all()
+
+
+class TestBoosterSerialization:
+    def test_string_roundtrip(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 10}, X, y)
+        b2 = Booster.from_string(b.model_to_string())
+        np.testing.assert_allclose(b.predict(X), b2.predict(X), atol=1e-6)
+
+    def test_save_native_model(self, breast_cancer, tmp_path):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 5}, X, y)
+        p = str(tmp_path / "model.txt")
+        b.save_native_model(p)
+        b2 = Booster.load_native_model(p)
+        np.testing.assert_allclose(b.predict(X), b2.predict(X), atol=1e-6)
+
+    def test_feature_importance(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 10}, X, y)
+        fi = b.feature_importance("split")
+        assert fi.shape == (X.shape[1],) and fi.sum() > 0
+        fg = b.feature_importance("gain")
+        assert (fg >= 0).all() and fg.sum() > 0
+
+
+class TestDataParallel:
+    """shard_map + psum'd histograms over the 8-device mesh — the analog
+    of the reference's partitions-as-nodes local test
+    (ref: LightGBMUtils.scala:235-249 getNodesFromPartitionsLocal)."""
+
+    def test_dp_matches_serial(self, cpu_mesh_devices):
+        from sklearn.datasets import load_diabetes
+        X, y = load_diabetes(return_X_y=True)
+        mesh = mesh_lib.make_mesh()
+        kw = {"objective": "regression", "num_iterations": 15,
+              "min_data_in_leaf": 10}
+        bd = train({**kw, "parallelism": "data"}, X, y, mesh=mesh)
+        bs = train(kw, X, y)
+        np.testing.assert_allclose(bd.predict(X), bs.predict(X),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_dp_binary(self, breast_cancer, cpu_mesh_devices):
+        X, y = breast_cancer
+        mesh = mesh_lib.make_mesh()
+        b = train({"objective": "binary", "num_iterations": 20,
+                   "parallelism": "data"}, X, y, mesh=mesh)
+        assert _auc(y, b.predict(X)) > 0.99
+
+
+class TestEstimatorStages:
+    def _classification_table(self, X, y):
+        return DataTable({"features": np.asarray(X, dtype=np.float64),
+                          "label": np.asarray(y, dtype=np.float64)})
+
+    def test_classifier_fit_transform(self, breast_cancer):
+        X, y = breast_cancer
+        t = self._classification_table(X, y)
+        clf = TPUBoostClassifier(numIterations=20)
+        model = clf.fit(t)
+        out = model.transform(t)
+        assert {"rawPrediction", "probability", "prediction"} <= \
+            set(out.column_names)
+        prob = out["probability"]
+        assert prob.shape == (len(y), 2)
+        acc = (out["prediction"] == y).mean()
+        assert acc > 0.97
+
+    def test_classifier_save_load(self, breast_cancer, tmp_path):
+        X, y = breast_cancer
+        t = self._classification_table(X[:200], y[:200])
+        model = TPUBoostClassifier(numIterations=5).fit(t)
+        path = str(tmp_path / "clf_model")
+        model.save(path)
+        from mmlspark_tpu.gbdt import TPUBoostClassificationModel
+        m2 = TPUBoostClassificationModel.load(path)
+        np.testing.assert_allclose(m2.transform(t)["probability"],
+                                   model.transform(t)["probability"],
+                                   atol=1e-6)
+
+    def test_regressor_stage(self):
+        from sklearn.datasets import load_diabetes
+        X, y = load_diabetes(return_X_y=True)
+        t = DataTable({"features": X, "label": y})
+        model = TPUBoostRegressor(numIterations=100, minDataInLeaf=10).fit(t)
+        out = model.transform(t)
+        p = out["prediction"]
+        assert 1 - ((p - y) ** 2).mean() / y.var() > 0.8
+
+    def test_rejects_unindexed_labels(self):
+        X = np.random.default_rng(0).normal(size=(50, 2))
+        t = DataTable({"features": X,
+                       "label": np.where(X[:, 0] > 0, 5.0, 7.0)})
+        with pytest.raises(ValueError, match="0..K-1"):
+            TPUBoostClassifier(numIterations=2).fit(t)
+
+    def test_schema_propagation(self, breast_cancer):
+        X, y = breast_cancer
+        t = self._classification_table(X[:50], y[:50])
+        clf = TPUBoostClassifier(numIterations=2)
+        out_schema = clf.transform_schema(t.schema)
+        assert "probability" in out_schema.names
+        assert "prediction" in out_schema.names
