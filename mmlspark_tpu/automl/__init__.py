@@ -1,0 +1,30 @@
+"""AutoML convenience tier.
+
+Parity with the reference's L5 layer (ref: SURVEY.md §2 "L5 AutoML"):
+Featurize/AssembleFeatures, TrainClassifier/TrainRegressor,
+ComputeModelStatistics/ComputePerInstanceStatistics,
+TuneHyperparameters + param spaces, FindBestModel.
+"""
+
+from mmlspark_tpu.automl.featurize import AssembleFeatures, Featurize
+from mmlspark_tpu.automl.train import (
+    TrainClassifier, TrainRegressor,
+    TrainedClassifierModel, TrainedRegressorModel,
+)
+from mmlspark_tpu.automl.statistics import (
+    ComputeModelStatistics, ComputePerInstanceStatistics,
+)
+from mmlspark_tpu.automl.tuning import (
+    DiscreteHyperParam, FindBestModel, GridSpace, HyperparamBuilder,
+    RandomSpace, RangeHyperParam, TuneHyperparameters,
+)
+
+__all__ = [
+    "AssembleFeatures", "Featurize",
+    "TrainClassifier", "TrainRegressor",
+    "TrainedClassifierModel", "TrainedRegressorModel",
+    "ComputeModelStatistics", "ComputePerInstanceStatistics",
+    "TuneHyperparameters", "FindBestModel",
+    "GridSpace", "RandomSpace", "HyperparamBuilder",
+    "DiscreteHyperParam", "RangeHyperParam",
+]

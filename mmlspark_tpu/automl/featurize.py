@@ -1,0 +1,168 @@
+"""Auto-featurization: per-type column pipelines → one features vector.
+
+Analog of the reference's featurize component
+(ref: src/featurize/src/main/scala/Featurize.scala:24-96,
+AssembleFeatures.scala:92-303): numeric columns are imputed and passed
+through, string/categorical columns are indexed (one-hot optionally),
+token-list columns are hash-vectorized, vector columns concatenate
+as-is, and everything is assembled into a single dense ``features``
+column (FastVectorAssembler analog — the assembled matrix is exactly the
+(N, D) array device stages consume, so assembly is one np.concatenate,
+no metadata walk; ref: src/core/spark/.../FastVectorAssembler.scala:23).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    BoolParam, ColParam, IntParam, ListParam, DictParam, StageParam,
+)
+from mmlspark_tpu.core.schema import (
+    Field, Schema, BOOL, F32, F64, I8, I16, I32, I64, LIST, STRING, VECTOR,
+)
+from mmlspark_tpu.core.stage import Estimator, Model
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.stages.text import HashingTF, _stable_hash
+
+_NUMERIC_TAGS = {F32, F64, I8, I16, I32, I64, BOOL}
+
+
+class Featurize(Estimator):
+    """Auto-featurize selected columns into a single vector column
+    (ref: Featurize.scala:24; defaults :13-19 — oneHot off, 262144
+    hashing features for text)."""
+
+    featureColumns = ListParam("input columns (None = all but output)",
+                               default=None)
+    outputCol = ColParam("assembled features column", default="features")
+    oneHotEncodeCategoricals = BoolParam("one-hot index columns",
+                                         default=False)
+    numberOfFeatures = IntParam("hash width for token columns",
+                                default=1 << 18)
+    allowImages = BoolParam("parity param (image passthrough)",
+                            default=False)
+
+    def fit(self, table: DataTable) -> "FeaturizeModel":
+        cols = self.get_or_none("featureColumns")
+        if cols is None:
+            cols = [c for c in table.column_names
+                    if c != self.get("outputCol")]
+        specs: List[Dict[str, Any]] = []
+        for c in cols:
+            f = table.schema[c]
+            if f.tag in _NUMERIC_TAGS:
+                col = np.asarray(table[c], dtype=np.float64)
+                finite = col[np.isfinite(col)]
+                mean = float(finite.mean()) if finite.size else 0.0
+                if f.meta.get("categorical") and \
+                        self.get("oneHotEncodeCategoricals"):
+                    n = len(f.meta.get("levels") or [])
+                    specs.append({"col": c, "kind": "onehot", "size": n})
+                else:
+                    specs.append({"col": c, "kind": "numeric",
+                                  "fill": mean})
+            elif f.tag == STRING:
+                levels = [v for v in table.distinct_values(c)
+                          if v is not None]
+                try:
+                    levels = sorted(levels)
+                except TypeError:
+                    pass
+                if self.get("oneHotEncodeCategoricals"):
+                    specs.append({"col": c, "kind": "string_onehot",
+                                  "levels": levels})
+                else:
+                    specs.append({"col": c, "kind": "string_index",
+                                  "levels": levels})
+            elif f.tag == LIST:
+                specs.append({"col": c, "kind": "hash",
+                              "size": self.get("numberOfFeatures")})
+            elif f.tag == VECTOR:
+                specs.append({"col": c, "kind": "vector"})
+            # other tags (struct/bytes/object) are skipped, like the
+            # reference drops unsupported columns
+        return FeaturizeModel(specs=specs,
+                              outputCol=self.get("outputCol"))
+
+
+class FeaturizeModel(Model):
+    specs = ListParam("per-column featurization specs", default=None)
+    outputCol = ColParam("assembled features column", default="features")
+
+    def transform(self, table: DataTable) -> DataTable:
+        parts: List[np.ndarray] = []
+        n = len(table)
+        for spec in self.get("specs") or []:
+            c = spec["col"]
+            kind = spec["kind"]
+            if kind == "numeric":
+                col = np.asarray(table[c], dtype=np.float64)
+                col = np.where(np.isfinite(col), col, spec["fill"])
+                parts.append(col[:, None])
+            elif kind == "onehot":
+                col = np.asarray(table[c], dtype=np.int64)
+                size = spec["size"]
+                oh = np.zeros((n, size))
+                ok = (col >= 0) & (col < size)
+                oh[np.arange(n)[ok], col[ok]] = 1.0
+                parts.append(oh)
+            elif kind == "string_index":
+                index = {v: i for i, v in enumerate(spec["levels"])}
+                col = np.asarray([float(index.get(v, -1))
+                                  for v in table[c]])
+                parts.append(col[:, None])
+            elif kind == "string_onehot":
+                index = {v: i for i, v in enumerate(spec["levels"])}
+                size = len(spec["levels"])
+                oh = np.zeros((n, size))
+                for i, v in enumerate(table[c]):
+                    j = index.get(v)
+                    if j is not None:
+                        oh[i, j] = 1.0
+                parts.append(oh)
+            elif kind == "hash":
+                m = spec["size"]
+                mat = np.zeros((n, m), dtype=np.float64)
+                for i, toks in enumerate(table[c]):
+                    for t in toks or []:
+                        mat[i, _stable_hash(str(t)) % m] += 1.0
+                parts.append(mat)
+            elif kind == "vector":
+                col = table[c]
+                if isinstance(col, np.ndarray) and col.ndim == 2:
+                    parts.append(np.asarray(col, dtype=np.float64))
+                else:
+                    parts.append(np.stack(
+                        [np.asarray(v, dtype=np.float64) for v in col]))
+        if not parts:
+            raise ValueError("no featurizable columns found")
+        feats = np.concatenate(parts, axis=1)
+        return table.with_column(self.get("outputCol"), feats,
+                                 Field(self.get("outputCol"), VECTOR))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema.add_or_replace(Field(self.get("outputCol"), VECTOR))
+
+
+class AssembleFeatures(Estimator):
+    """Column assembler sharing FeaturizeModel's machinery
+    (ref: AssembleFeatures.scala:92 — the lower-level stage Featurize
+    drives; exposed for parity)."""
+
+    columnsToFeaturize = ListParam("columns to assemble", default=None)
+    featuresCol = ColParam("output features column", default="features")
+    oneHotEncodeCategoricals = BoolParam("one-hot categoricals",
+                                         default=False)
+    numberOfFeatures = IntParam("hash width for token columns",
+                                default=1 << 18)
+
+    def fit(self, table: DataTable) -> FeaturizeModel:
+        feat = Featurize(
+            featureColumns=self.get_or_none("columnsToFeaturize"),
+            outputCol=self.get("featuresCol"),
+            oneHotEncodeCategoricals=self.get("oneHotEncodeCategoricals"),
+            numberOfFeatures=self.get("numberOfFeatures"))
+        return feat.fit(table)

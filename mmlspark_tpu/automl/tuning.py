@@ -1,0 +1,264 @@
+"""Hyperparameter search + model selection.
+
+Analog of tune-hyperparameters / find-best-model
+(ref: src/tune-hyperparameters/.../TuneHyperparameters.scala:33-188,
+ParamSpace.scala:11-40, HyperparamBuilder.scala:11-98,
+src/find-best-model/.../FindBestModel.scala:50,
+EvaluationUtils.scala:13): randomized/grid search over typed param
+spaces with k-fold CV, candidates evaluated in parallel (thread pool —
+the reference uses scala Futures; each fit releases the GIL into XLA),
+and FindBestModel evaluating fitted models on a validation table.
+"""
+
+from __future__ import annotations
+
+import itertools
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.automl.statistics import ComputeModelStatistics
+from mmlspark_tpu.core import metrics as MC
+from mmlspark_tpu.core.params import (
+    BoolParam, EnumParam, IntParam, ListParam, StageParam, StringParam,
+)
+from mmlspark_tpu.core.stage import Estimator, Model, Transformer
+from mmlspark_tpu.core.table import DataTable
+
+# metric -> larger-is-better? (ref: EvaluationUtils.getMetricWithOperator)
+_METRIC_ASCENDING = {
+    MC.MSE: False, MC.RMSE: False, MC.MAE: False, MC.R2: True,
+    MC.AUC: True, MC.ACCURACY: True, MC.PRECISION: True, MC.RECALL: True,
+}
+
+
+class Dist:
+    """A sampling distribution for one hyperparameter
+    (ref: ParamSpace.scala:34 Dist)."""
+
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+    def grid(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class DiscreteHyperParam(Dist):
+    """ref: HyperparamBuilder.scala DiscreteHyperParam."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid(self):
+        return list(self.values)
+
+
+class RangeHyperParam(Dist):
+    """Uniform numeric range; int if both ends are ints
+    (ref: HyperparamBuilder.scala:40-98 typed RangeHyperParams)."""
+
+    def __init__(self, low, high, n_grid: int = 5, log: bool = False):
+        self.low, self.high = low, high
+        self.is_int = isinstance(low, int) and isinstance(high, int)
+        self.n_grid = n_grid
+        self.log = log
+
+    def sample(self, rng):
+        if self.log:
+            v = float(np.exp(rng.uniform(np.log(self.low),
+                                         np.log(self.high))))
+        else:
+            v = float(rng.uniform(self.low, self.high))
+        return int(round(v)) if self.is_int else v
+
+    def grid(self):
+        if self.log:
+            vals = np.exp(np.linspace(np.log(self.low), np.log(self.high),
+                                      self.n_grid))
+        else:
+            vals = np.linspace(self.low, self.high, self.n_grid)
+        return [int(round(v)) if self.is_int else float(v) for v in vals]
+
+
+class HyperparamBuilder:
+    """Collects (param-name -> Dist) pairs (ref:
+    HyperparamBuilder.scala:11)."""
+
+    def __init__(self):
+        self._space: Dict[str, Dist] = {}
+
+    def add_hyperparam(self, name: str, dist: Dist) -> "HyperparamBuilder":
+        self._space[name] = dist
+        return self
+
+    def build(self) -> Dict[str, Dist]:
+        return dict(self._space)
+
+
+class GridSpace:
+    """Exhaustive cartesian grid (ref: ParamSpace.scala:11)."""
+
+    def __init__(self, space: Dict[str, Dist]):
+        self.space = space
+
+    def param_maps(self) -> Iterator[Dict[str, Any]]:
+        names = list(self.space)
+        for combo in itertools.product(
+                *(self.space[n].grid() for n in names)):
+            yield dict(zip(names, combo))
+
+
+class RandomSpace:
+    """Random sampling (ref: ParamSpace.scala:25)."""
+
+    def __init__(self, space: Dict[str, Dist], seed: int = 0):
+        self.space = space
+        self.seed = seed
+
+    def param_maps(self) -> Iterator[Dict[str, Any]]:
+        rng = np.random.default_rng(self.seed)
+        while True:
+            yield {n: d.sample(rng) for n, d in self.space.items()}
+
+
+def _evaluate(model: Model, table: DataTable, metric: str) -> float:
+    scored = model.transform(table)
+    mode = ("regression" if metric in MC.REGRESSION_METRICS
+            else "classification" if metric in MC.CLASSIFICATION_METRICS
+            else "auto")
+    stats = ComputeModelStatistics(evaluationMetric=mode).transform(scored)
+    row = stats.row(0)
+    if metric not in row:
+        raise KeyError(f"metric {metric!r} not computed; have {list(row)}")
+    return float(row[metric])
+
+
+class TuneHyperparameters(Estimator):
+    """Randomized/grid search with k-fold CV over one or more estimators
+    (ref: TuneHyperparameters.scala:112-188)."""
+
+    models = ListParam("candidate estimators", default=None)
+    paramSpace = StageParam("GridSpace or RandomSpace (or list of spaces "
+                            "aligned with models)", default=None)
+    evaluationMetric = StringParam("metric to optimize", default=MC.ACCURACY)
+    numFolds = IntParam("k-fold count", default=3)
+    numRuns = IntParam("sampled configs per model (random spaces)",
+                       default=10)
+    parallelism = IntParam("concurrent evaluations", default=4)
+    seed = IntParam("shuffle seed", default=0)
+
+    def fit(self, table: DataTable) -> "TuneHyperparametersModel":
+        models: List[Estimator] = self.get("models")
+        space = self.get("paramSpace")
+        metric = self.get("evaluationMetric")
+        ascending = _METRIC_ASCENDING.get(metric, True)
+        k = self.get("numFolds")
+        shuffled = table.shuffle(self.get("seed"))
+        folds = shuffled.shards(k)
+
+        candidates: List[Tuple[Estimator, Dict[str, Any]]] = []
+        for est in models:
+            maps = space.param_maps()
+            if isinstance(space, RandomSpace):
+                maps = itertools.islice(maps, self.get("numRuns"))
+            for pm in maps:
+                usable = {n: v for n, v in pm.items()
+                          if _has_param(est, n)}
+                candidates.append((est, usable))
+
+        def eval_candidate(args):
+            est, pm = args
+            scores = []
+            for i in range(k):
+                train_t = DataTable.concat(
+                    [f for j, f in enumerate(folds) if j != i])
+                val_t = folds[i]
+                e = est.copy()
+                for n, v in pm.items():
+                    e.set(n, v)
+                model = e.fit(train_t)
+                scores.append(_evaluate(model, val_t, metric))
+            return float(np.mean(scores))
+
+        with ThreadPoolExecutor(self.get("parallelism")) as pool:
+            results = list(pool.map(eval_candidate, candidates))
+
+        best_i = int(np.argmax(results) if ascending
+                     else np.argmin(results))
+        best_est, best_pm = candidates[best_i]
+        final = best_est.copy()
+        for n, v in best_pm.items():
+            final.set(n, v)
+        best_model = final.fit(table)
+        history = [{"model": type(e).__name__, "params": pm,
+                    "metric": r}
+                   for (e, pm), r in zip(candidates, results)]
+        return TuneHyperparametersModel(
+            bestModel=best_model, bestMetric=results[best_i],
+            bestParams=best_pm, history=history)
+
+
+def _has_param(stage, name: str) -> bool:
+    try:
+        stage.param(name)
+        return True
+    except KeyError:
+        return False
+
+
+class TuneHyperparametersModel(Model):
+    bestModel = StageParam("the winning fitted model", default=None)
+    from mmlspark_tpu.core.params import FloatParam as _FP, DictParam as _DP
+    bestMetric = _FP("winning CV metric", default=0.0)
+    bestParams = _DP("winning param map", default=None)
+    history = ListParam("all (model, params, metric) records", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        return self.get("bestModel").transform(table)
+
+    def get_best_model_info(self) -> str:
+        return (f"{type(self.get('bestModel')).__name__} "
+                f"params={self.get('bestParams')} "
+                f"metric={self.get('bestMetric')}")
+
+
+class FindBestModel(Estimator):
+    """Evaluate fitted models on the given table, keep the best
+    (ref: FindBestModel.scala:50)."""
+
+    models = ListParam("candidate fitted models", default=None)
+    evaluationMetric = StringParam("metric", default=MC.ACCURACY)
+
+    def fit(self, table: DataTable) -> "BestModel":
+        metric = self.get("evaluationMetric")
+        ascending = _METRIC_ASCENDING.get(metric, True)
+        models: List[Model] = self.get("models")
+        scores = [_evaluate(m, table, metric) for m in models]
+        best_i = int(np.argmax(scores) if ascending
+                     else np.argmin(scores))
+        rows = [{"model": type(m).__name__, metric: s}
+                for m, s in zip(models, scores)]
+        # record all-metrics evaluation of the winner (ref: FindBestModel
+        # records ROC/metrics dfs)
+        scored = models[best_i].transform(table)
+        all_metrics = ComputeModelStatistics().transform(scored)
+        return BestModel(bestModel=models[best_i],
+                         bestModelMetrics=all_metrics,
+                         allModelMetrics=DataTable.from_rows(rows))
+
+
+class BestModel(Model):
+    bestModel = StageParam("winning model", default=None)
+    from mmlspark_tpu.core.params import TableParam as _TP
+    bestModelMetrics = _TP("metrics table of the winner", default=None)
+    allModelMetrics = _TP("metric per candidate", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        return self.get("bestModel").transform(table)
+
+    def get_evaluation_results(self) -> DataTable:
+        return self.get("allModelMetrics")

@@ -105,6 +105,15 @@ def _normalize_column(data: Any, n_rows: Optional[int]) -> ColumnData:
     return np.full(n_rows, data)
 
 
+def features_matrix(table: "DataTable", col: str) -> np.ndarray:
+    """Vector column -> dense (N, F) float64 matrix (the shared coercion
+    every model stage uses to feed features to the device)."""
+    c = table.column(col)
+    if isinstance(c, np.ndarray) and c.ndim == 2:
+        return np.asarray(c, dtype=np.float64)
+    return np.stack([np.asarray(v, dtype=np.float64) for v in c])
+
+
 class DataTable:
     """Immutable columnar table."""
 

@@ -297,6 +297,10 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
     best_loss = np.inf
     best_iter = -1
     esr = int(p["early_stopping_round"])
+    # one fixed walk length -> one predict_trees compile for the whole
+    # run (leaves self-loop, extra steps are no-ops)
+    valid_depth = int(p["max_depth"]) if int(p["max_depth"]) > 0 \
+        else int(p["num_leaves"]) - 1
 
     n_iter = int(p["num_iterations"])
     w_iter = w_pad  # current bag persists between resamples
@@ -345,7 +349,7 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
                     jnp.asarray(tree_host["left"][None]),
                     jnp.asarray(tree_host["right"][None]),
                     jnp.asarray(tree_host["value"][None]),
-                    max_depth=max(tree_depths[-1], 1))
+                    max_depth=valid_depth)
                 v_scores[k_cls] += np.asarray(tv)[0]
 
         if has_valid and esr > 0:

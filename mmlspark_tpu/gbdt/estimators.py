@@ -86,10 +86,8 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         }
 
     def _features_matrix(self, table: DataTable) -> np.ndarray:
-        col = table.column(self.get_features_col())
-        if isinstance(col, np.ndarray) and col.ndim == 2:
-            return np.asarray(col, dtype=np.float64)
-        return np.stack([np.asarray(v, dtype=np.float64) for v in col])
+        from mmlspark_tpu.core.table import features_matrix
+        return features_matrix(table, self.get_features_col())
 
     def _fit_arrays(self, table: DataTable):
         X = self._features_matrix(table)

@@ -1,0 +1,363 @@
+"""Data-preparation stages.
+
+Parity set (ref: SURVEY.md §2 "Misc data ops"): ValueIndexer /
+ValueIndexerModel (typed distinct-values dictionary → categorical
+metadata, ref: src/value-indexer/.../ValueIndexer.scala:54),
+CleanMissingData (mean/median/custom impute, ref:
+src/clean-missing-data/.../CleanMissingData.scala:46), DataConversion
+(column casts, ref: src/data-conversion/.../DataConversion.scala:23),
+SummarizeData (ref: src/summarize-data/.../SummarizeData.scala:98),
+PartitionSample (ref: src/partition-sample/.../PartitionSample.scala:24),
+EnsembleByKey (ref: src/ensemble/.../EnsembleByKey.scala:21),
+MultiColumnAdapter (ref: src/multi-column-adapter/.../MultiColumnAdapter.scala:17).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    BoolParam, ColParam, DictParam, EnumParam, FloatParam, HasInputCol,
+    HasOutputCol, IntParam, ListParam, StageParam, StringParam,
+)
+from mmlspark_tpu.core.schema import (
+    Field, Schema, BOOL, F32, F64, I32, I64, STRING, VECTOR,
+)
+from mmlspark_tpu.core.stage import Estimator, Model, Transformer
+from mmlspark_tpu.core.table import DataTable
+
+
+class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
+    """Build a typed distinct-values dictionary and index the column to
+    categorical codes, recording levels in column metadata
+    (ref: ValueIndexer.scala:54; Categoricals.scala metadata)."""
+
+    def fit(self, table: DataTable) -> "ValueIndexerModel":
+        col = table[self.get_input_col()]
+        levels = table.distinct_values(self.get_input_col())
+        # nulls are not levels (ref: ValueIndexer verifies non-null)
+        levels = [v for v in levels if v is not None]
+        try:
+            levels = sorted(levels)
+        except TypeError:
+            pass
+        levels = [v.item() if hasattr(v, "item") else v for v in levels]
+        return (ValueIndexerModel(levels=levels)
+                .set("inputCol", self.get_input_col())
+                .set("outputCol", self.get_output_col()))
+
+
+class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
+    levels = ListParam("ordered category levels", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        levels = self.get("levels") or []
+        index = {v: i for i, v in enumerate(levels)}
+        col = table[self.get_input_col()]
+        out = np.asarray([
+            index.get(v.item() if hasattr(v, "item") else v, -1)
+            for v in col], dtype=np.float64)
+        f = Field(self.get_output_col(), F64,
+                  {"categorical": True, "levels": levels})
+        return table.with_column(self.get_output_col(), out, f)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        levels = self.get("levels") or []
+        return schema.add_or_replace(Field(
+            self.get_output_col(), F64,
+            {"categorical": True, "levels": levels}))
+
+    def unindex(self, table: DataTable, col: Optional[str] = None,
+                out_col: Optional[str] = None) -> DataTable:
+        """Codes -> original values (IndexToValue analog)."""
+        levels = self.get("levels") or []
+        col = col or self.get_output_col()
+        out_col = out_col or self.get_input_col()
+        vals = [levels[int(v)] if 0 <= int(v) < len(levels) else None
+                for v in table[col]]
+        return table.with_column(out_col, vals)
+
+
+class CleanMissingData(Estimator):
+    """Impute missing values: mean/median/custom
+    (ref: CleanMissingData.scala:46)."""
+
+    inputCols = ListParam("columns to clean", default=None)
+    outputCols = ListParam("output columns", default=None)
+    cleaningMode = EnumParam(["Mean", "Median", "Custom"],
+                             "imputation mode", default="Mean")
+    customValue = FloatParam("custom fill value", default=0.0)
+
+    def fit(self, table: DataTable) -> "CleanMissingDataModel":
+        in_cols = self.get("inputCols") or []
+        out_cols = self.get("outputCols") or in_cols
+        mode = self.get("cleaningMode")
+        fills: Dict[str, float] = {}
+        for c in in_cols:
+            col = np.asarray(table[c], dtype=np.float64)
+            finite = col[np.isfinite(col)]
+            if mode == "Mean":
+                fills[c] = float(finite.mean()) if finite.size else 0.0
+            elif mode == "Median":
+                fills[c] = float(np.median(finite)) if finite.size else 0.0
+            else:
+                fills[c] = self.get("customValue")
+        return CleanMissingDataModel(
+            inputCols=list(in_cols), outputCols=list(out_cols),
+            fillValues=fills)
+
+
+class CleanMissingDataModel(Model):
+    inputCols = ListParam("columns to clean", default=None)
+    outputCols = ListParam("output columns", default=None)
+    fillValues = DictParam("column -> fill value", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        fills = self.get("fillValues") or {}
+        out = table
+        for c, oc in zip(self.get("inputCols") or [],
+                         self.get("outputCols") or []):
+            col = np.asarray(table[c], dtype=np.float64)
+            filled = np.where(np.isfinite(col), col, fills.get(c, 0.0))
+            out = out.with_column(oc, filled, Field(oc, F64))
+        return out
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for oc in self.get("outputCols") or []:
+            schema = schema.add_or_replace(Field(oc, F64))
+        return schema
+
+
+_CAST_TABLE = {
+    "boolean": (bool, BOOL), "byte": (np.int8, I32),
+    "short": (np.int16, I32), "integer": (np.int32, I32),
+    "long": (np.int64, I64), "float": (np.float32, F32),
+    "double": (np.float64, F64), "string": (str, STRING),
+}
+
+
+class DataConversion(Transformer):
+    """Cast columns between types; date reformat
+    (ref: DataConversion.scala:23-150)."""
+
+    cols = ListParam("columns to convert", default=None)
+    convertTo = StringParam("target type", default="double")
+    dateTimeFormat = StringParam("strftime format for date conversion",
+                                 default="%Y-%m-%d %H:%M:%S")
+
+    def transform(self, table: DataTable) -> DataTable:
+        target = self.get("convertTo")
+        out = table
+        for c in self.get("cols") or []:
+            col = table[c]
+            if target == "date":
+                import datetime
+                fmt = self.get("dateTimeFormat")
+                vals = [None if v is None else
+                        datetime.datetime.strptime(str(v), fmt)
+                        for v in col]
+                out = out.with_column(c, vals)
+                continue
+            if target == "toCategorical":
+                model = ValueIndexer(inputCol=c, outputCol=c).fit(out)
+                out = model.transform(out)
+                continue
+            if target == "clearCategorical":
+                f = out.schema[c]
+                meta = {k: v for k, v in f.meta.items()
+                        if k not in ("categorical", "levels")}
+                out = out.with_field(Field(c, f.tag, meta, f.fields))
+                continue
+            py_t, tag = _CAST_TABLE[target]
+            if target == "string":
+                vals = [None if v is None else str(v) for v in col]
+                out = out.with_column(c, vals, Field(c, STRING))
+            else:
+                arr = np.asarray(col).astype(py_t)
+                out = out.with_column(c, arr, Field(c, tag))
+        return out
+
+
+class SummarizeData(Transformer):
+    """Summary statistics table: counts / basic / sample / percentiles
+    (ref: SummarizeData.scala:98)."""
+
+    counts = BoolParam("include counts", default=True)
+    basic = BoolParam("include basic stats", default=True)
+    sample = BoolParam("include sample stats", default=True)
+    percentiles = BoolParam("include percentiles", default=True)
+    errorThreshold = FloatParam("percentile error (parity param)",
+                                default=0.0)
+
+    def transform(self, table: DataTable) -> DataTable:
+        rows: List[Dict[str, Any]] = []
+        for name in table.column_names:
+            col = table[name]
+            row: Dict[str, Any] = {"Feature": name}
+            is_num = isinstance(col, np.ndarray) and col.ndim == 1 \
+                and np.issubdtype(col.dtype, np.number)
+            n = len(table)
+            if self.get("counts"):
+                if is_num:
+                    missing = int(np.sum(~np.isfinite(
+                        col.astype(np.float64))))
+                else:
+                    missing = sum(1 for v in col if v is None)
+                try:
+                    unique = float(len(table.distinct_values(name)))
+                except TypeError:  # unhashable (list/struct) values
+                    unique = float("nan")
+                row.update(Count=float(n),
+                           Unique_Value_Count=unique,
+                           Missing_Value_Count=float(missing))
+            if is_num:
+                x = col.astype(np.float64)
+                x = x[np.isfinite(x)]
+                if self.get("basic") and x.size:
+                    row.update(Max=float(x.max()), Min=float(x.min()),
+                               Mean=float(x.mean()),
+                               Range=float(x.max() - x.min()))
+                if self.get("sample") and x.size > 1:
+                    row.update(Sample_Variance=float(x.var(ddof=1)),
+                               Sample_Standard_Deviation=float(
+                                   x.std(ddof=1)),
+                               Sample_Skewness=float(_skew(x)),
+                               Sample_Kurtosis=float(_kurt(x)))
+                if self.get("percentiles") and x.size:
+                    for q, label in ((0.5, "Median"), (0.25, "P25"),
+                                     (0.75, "P75"), (0.05, "P5"),
+                                     (0.95, "P95")):
+                        row[label] = float(np.quantile(x, q))
+            rows.append(row)
+        return DataTable.from_rows(rows)
+
+
+def _skew(x: np.ndarray) -> float:
+    m = x.mean()
+    s = x.std(ddof=1)
+    return float(((x - m) ** 3).mean() / (s ** 3 + 1e-300))
+
+
+def _kurt(x: np.ndarray) -> float:
+    m = x.mean()
+    s = x.std(ddof=1)
+    return float(((x - m) ** 4).mean() / (s ** 4 + 1e-300) - 3.0)
+
+
+class PartitionSample(Transformer):
+    """head / random sample / assign-to-partitions
+    (ref: PartitionSample.scala:24-127)."""
+
+    mode = EnumParam(["Head", "RandomSample", "AssignToPartition"],
+                     "sampling mode", default="RandomSample")
+    count = IntParam("head count", default=1000)
+    percent = FloatParam("sample fraction", default=0.1)
+    rs_seed = IntParam("seed", default=0)
+    numParts = IntParam("partitions for assignment", default=2)
+    newColName = ColParam("partition-id column", default="Partition")
+
+    def transform(self, table: DataTable) -> DataTable:
+        mode = self.get("mode")
+        if mode == "Head":
+            return table.take(self.get("count"))
+        if mode == "RandomSample":
+            return table.sample(self.get("percent"), seed=self.get("rs_seed"))
+        rng = np.random.default_rng(self.get("rs_seed"))
+        parts = rng.integers(0, self.get("numParts"), size=len(table))
+        return table.with_column(self.get("newColName"),
+                                 parts.astype(np.int64))
+
+
+class EnsembleByKey(Transformer):
+    """Group by key column(s), average vector/scalar column(s)
+    (ref: EnsembleByKey.scala:21)."""
+
+    keys = ListParam("grouping key columns", default=None)
+    cols = ListParam("columns to average", default=None)
+    colNames = ListParam("output names (default <col>_avg)", default=None)
+    strategy = EnumParam(["mean"], "ensemble strategy", default="mean")
+    collapseGroup = BoolParam("one row per group", default=True)
+    vectorDims = DictParam("parity param; unused", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        keys = self.get("keys") or []
+        cols = self.get("cols") or []
+        names = self.get("colNames") or [f"{c}_avg" for c in cols]
+        groups: Dict[Any, List[int]] = {}
+        for i, r in enumerate(table.rows()):
+            k = tuple(r[k2] for k2 in keys)
+            groups.setdefault(k, []).append(i)
+        out_rows = []
+        for k, idxs in groups.items():
+            row = {kc: kv for kc, kv in zip(keys, k)}
+            for c, nm in zip(cols, names):
+                col = table[c]
+                vals = [np.asarray(col[i], dtype=np.float64) for i in idxs]
+                row[nm] = np.mean(np.stack(vals), axis=0) \
+                    if vals[0].ndim else float(np.mean(vals))
+            out_rows.append(row)
+        result = DataTable.from_rows(out_rows)
+        if not self.get("collapseGroup"):
+            # broadcast group values back onto original rows
+            key_to_row = {tuple(r[k] for k in keys): r
+                          for r in result.rows()}
+            merged = []
+            for r in table.rows():
+                k = tuple(r[k2] for k2 in keys)
+                nr = dict(r)
+                for c, nm in zip(cols, names):
+                    nr[nm] = key_to_row[k][nm]
+                merged.append(nr)
+            return DataTable.from_rows(merged)
+        return result
+
+
+class MultiColumnAdapter(Estimator):
+    """Apply a unary stage to each of N columns
+    (ref: MultiColumnAdapter.scala:17). fit() fits one copy of the base
+    stage per column and returns a model holding the fitted copies, so
+    estimator state (e.g. ValueIndexer levels) comes from the training
+    table, never the scoring table."""
+
+    baseStage = StageParam("the unary stage to replicate", default=None)
+    inputCols = ListParam("input columns", default=None)
+    outputCols = ListParam("output columns", default=None)
+
+    def fit(self, table: DataTable) -> "MultiColumnAdapterModel":
+        base = self.get("baseStage")
+        fitted: List[Any] = []
+        for ic, oc in zip(self.get("inputCols") or [],
+                          self.get("outputCols") or []):
+            stage = base.copy()
+            stage.uid = f"{base.uid}_{ic}"
+            stage.set("inputCol", ic).set("outputCol", oc)
+            if isinstance(stage, Estimator):
+                stage = stage.fit(table)
+            fitted.append(stage)
+        return MultiColumnAdapterModel(stages=fitted)
+
+    def transform(self, table: DataTable) -> DataTable:
+        """Convenience for pure-Transformer base stages."""
+        base = self.get("baseStage")
+        if isinstance(base, Estimator):
+            raise TypeError(
+                "baseStage is an Estimator; call fit() first so per-column "
+                "state is learned from the training table")
+        return self.fit(table).transform(table)
+
+
+class MultiColumnAdapterModel(Model):
+    stages = ListParam("fitted per-column stages", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = table
+        for stage in self.get("stages") or []:
+            out = stage.transform(out)
+        return out
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for stage in self.get("stages") or []:
+            schema = stage.transform_schema(schema)
+        return schema

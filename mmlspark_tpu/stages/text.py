@@ -1,0 +1,306 @@
+"""Text featurization pipeline.
+
+TPU-native analog of the reference's text-featurizer
+(ref: src/text-featurizer/src/main/scala/TextFeaturizer.scala:179-386):
+a one-call Estimator composing tokenize → stop-word removal → n-grams →
+hashing-TF or count-vectorize → IDF, plus the individual building-block
+stages. Sparse term-frequency vectors are materialized as dense float32
+rows (hashing dims default 2^18 like the reference's 262144) only at the
+boundary where a downstream device stage consumes them; the TF counting
+itself is host-side dict arithmetic.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core.params import (
+    BoolParam, HasInputCol, HasOutputCol, IntParam, ListParam, StringParam,
+)
+from mmlspark_tpu.core.schema import Field, Schema, LIST, VECTOR
+from mmlspark_tpu.core.stage import Estimator, Model, Transformer
+from mmlspark_tpu.core.table import DataTable
+
+# A small default English stop-word list (the reference delegates to
+# SparkML's StopWordsRemover defaults).
+DEFAULT_STOP_WORDS = [
+    "a", "an", "and", "are", "as", "at", "be", "but", "by", "for", "if",
+    "in", "into", "is", "it", "no", "not", "of", "on", "or", "such",
+    "that", "the", "their", "then", "there", "these", "they", "this",
+    "to", "was", "will", "with", "i", "you", "he", "she", "we", "our",
+]
+
+
+class Tokenizer(Transformer, HasInputCol, HasOutputCol):
+    """Regex tokenizer (ref: TextFeaturizer tokenizer step)."""
+
+    pattern = StringParam("token-splitting regex", default=r"\s+")
+    gaps = BoolParam("pattern matches gaps (True) or tokens (False)",
+                     default=True)
+    minTokenLength = IntParam("drop shorter tokens", default=1)
+    toLowercase = BoolParam("lowercase first", default=True)
+
+    def transform(self, table: DataTable) -> DataTable:
+        pat = re.compile(self.get("pattern"))
+        min_len = self.get("minTokenLength")
+        out = []
+        for s in table[self.get_input_col()]:
+            if s is None:
+                out.append([])
+                continue
+            if self.get("toLowercase"):
+                s = s.lower()
+            toks = pat.split(s) if self.get("gaps") else pat.findall(s)
+            out.append([t for t in toks if len(t) >= min_len])
+        return table.with_column(self.get_output_col(), out,
+                                 Field(self.get_output_col(), LIST))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema.add_or_replace(Field(self.get_output_col(), LIST))
+
+
+class StopWordsRemover(Transformer, HasInputCol, HasOutputCol):
+    stopWords = ListParam("words to remove", default=None)
+    caseSensitive = BoolParam("case sensitive matching", default=False)
+
+    def transform(self, table: DataTable) -> DataTable:
+        words = self.get("stopWords") or DEFAULT_STOP_WORDS
+        if not self.get("caseSensitive"):
+            stop = {w.lower() for w in words}
+            pred = lambda t: t.lower() not in stop  # noqa: E731
+        else:
+            stop = set(words)
+            pred = lambda t: t not in stop  # noqa: E731
+        out = [[t for t in toks if pred(t)]
+               for toks in table[self.get_input_col()]]
+        return table.with_column(self.get_output_col(), out,
+                                 Field(self.get_output_col(), LIST))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema.add_or_replace(Field(self.get_output_col(), LIST))
+
+
+class NGram(Transformer, HasInputCol, HasOutputCol):
+    n = IntParam("n-gram length", default=2)
+
+    def transform(self, table: DataTable) -> DataTable:
+        n = self.get("n")
+        out = []
+        for toks in table[self.get_input_col()]:
+            out.append([" ".join(toks[i:i + n])
+                        for i in range(len(toks) - n + 1)])
+        return table.with_column(self.get_output_col(), out,
+                                 Field(self.get_output_col(), LIST))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema.add_or_replace(Field(self.get_output_col(), LIST))
+
+
+class HashingTF(Transformer, HasInputCol, HasOutputCol):
+    """Feature hashing to a fixed-width count vector
+    (ref: TextFeaturizer numFeatures default 262144 / 2^18)."""
+
+    numFeatures = IntParam("hash space size", default=1 << 18)
+    binary = BoolParam("presence instead of counts", default=False)
+
+    def transform(self, table: DataTable) -> DataTable:
+        m = self.get("numFeatures")
+        binary = self.get("binary")
+        rows = []
+        for toks in table[self.get_input_col()]:
+            v = np.zeros(m, dtype=np.float32)
+            for t in toks:
+                idx = _stable_hash(t) % m
+                if binary:
+                    v[idx] = 1.0
+                else:
+                    v[idx] += 1.0
+            rows.append(v)
+        arr = np.stack(rows) if rows else np.zeros((0, m), np.float32)
+        return table.with_column(self.get_output_col(), arr,
+                                 Field(self.get_output_col(), VECTOR))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema.add_or_replace(Field(self.get_output_col(), VECTOR))
+
+
+def _stable_hash(s: str) -> int:
+    """Deterministic across processes (unlike builtin hash)."""
+    h = 2166136261
+    for ch in s.encode("utf-8"):
+        h = ((h ^ ch) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class CountVectorizer(Estimator, HasInputCol, HasOutputCol):
+    """Vocabulary-based term counting (TextFeaturizer's non-hashing
+    path)."""
+
+    vocabSize = IntParam("max vocabulary size", default=1 << 18)
+    minDF = IntParam("min docs containing a term", default=1)
+
+    def fit(self, table: DataTable) -> "CountVectorizerModel":
+        df_counts: Dict[str, int] = {}
+        tf_totals: Dict[str, int] = {}
+        for toks in table[self.get_input_col()]:
+            for t in set(toks):
+                df_counts[t] = df_counts.get(t, 0) + 1
+            for t in toks:
+                tf_totals[t] = tf_totals.get(t, 0) + 1
+        vocab = [t for t, c in df_counts.items() if c >= self.get("minDF")]
+        vocab.sort(key=lambda t: (-tf_totals[t], t))
+        vocab = vocab[:self.get("vocabSize")]
+        return (CountVectorizerModel(vocabulary=vocab)
+                .set("inputCol", self.get_input_col())
+                .set("outputCol", self.get_output_col()))
+
+
+class CountVectorizerModel(Model, HasInputCol, HasOutputCol):
+    vocabulary = ListParam("ordered vocabulary", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        vocab = self.get("vocabulary") or []
+        index = {t: i for i, t in enumerate(vocab)}
+        rows = []
+        for toks in table[self.get_input_col()]:
+            v = np.zeros(len(vocab), dtype=np.float32)
+            for t in toks:
+                i = index.get(t)
+                if i is not None:
+                    v[i] += 1.0
+            rows.append(v)
+        arr = np.stack(rows) if rows else np.zeros((0, len(vocab)),
+                                                   np.float32)
+        return table.with_column(self.get_output_col(), arr,
+                                 Field(self.get_output_col(), VECTOR))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema.add_or_replace(Field(self.get_output_col(), VECTOR))
+
+
+class IDF(Estimator, HasInputCol, HasOutputCol):
+    """Inverse document frequency weighting (ref: TextFeaturizer IDF
+    step)."""
+
+    minDocFreq = IntParam("min doc frequency", default=0)
+
+    def fit(self, table: DataTable) -> "IDFModel":
+        col = table[self.get_input_col()]
+        mat = np.stack([np.asarray(v) for v in col])
+        n_docs = mat.shape[0]
+        doc_freq = (mat > 0).sum(axis=0)
+        idf = np.log((n_docs + 1.0) / (doc_freq + 1.0))
+        idf[doc_freq < self.get("minDocFreq")] = 0.0
+        return (IDFModel(idf=idf.astype(np.float32))
+                .set("inputCol", self.get_input_col())
+                .set("outputCol", self.get_output_col()))
+
+
+class IDFModel(Model, HasInputCol, HasOutputCol):
+    from mmlspark_tpu.core.params import ArrayParam as _AP
+    idf = _AP("idf weight vector", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        idf = np.asarray(self.get("idf"))
+        col = table[self.get_input_col()]
+        mat = np.stack([np.asarray(v) for v in col]) * idf[None, :]
+        return table.with_column(self.get_output_col(),
+                                 mat.astype(np.float32),
+                                 Field(self.get_output_col(), VECTOR))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema.add_or_replace(Field(self.get_output_col(), VECTOR))
+
+
+class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
+    """One-call text → feature-vector pipeline
+    (ref: TextFeaturizer.scala:179 — the param surface mirrors the
+    reference: useTokenizer/useStopWordsRemover/useNGram/useIDF,
+    numFeatures, nGramLength, binary, etc.)."""
+
+    useTokenizer = BoolParam("tokenize strings", default=True)
+    tokenizerPattern = StringParam("token regex", default=r"\s+")
+    tokenizerGaps = BoolParam("regex matches gaps", default=True)
+    minTokenLength = IntParam("min token length", default=1)
+    toLowercase = BoolParam("lowercase", default=True)
+    useStopWordsRemover = BoolParam("remove stop words", default=False)
+    stopWords = ListParam("stop words (None = default list)", default=None)
+    caseSensitiveStopWords = BoolParam("case sensitive", default=False)
+    useNGram = BoolParam("add n-grams", default=False)
+    nGramLength = IntParam("n-gram length", default=2)
+    useHashingTF = BoolParam("hashingTF (True) or countVectorizer",
+                             default=True)
+    numFeatures = IntParam("hash space size", default=1 << 18)
+    binary = BoolParam("binary term counts", default=False)
+    vocabSize = IntParam("count-vectorizer vocab size", default=1 << 18)
+    minDF = IntParam("count-vectorizer min doc freq", default=1)
+    useIDF = BoolParam("apply IDF weighting", default=True)
+    minDocFreq = IntParam("IDF min doc freq", default=1)
+
+    def fit(self, table: DataTable) -> "TextFeaturizerModel":
+        from mmlspark_tpu.core.stage import Pipeline
+        col = self.get_input_col()
+        stages: List[Any] = []
+        cur = col
+        if self.get("useTokenizer"):
+            stages.append(Tokenizer(
+                inputCol=cur, outputCol="_tf_tokens",
+                pattern=self.get("tokenizerPattern"),
+                gaps=self.get("tokenizerGaps"),
+                minTokenLength=self.get("minTokenLength"),
+                toLowercase=self.get("toLowercase")))
+            cur = "_tf_tokens"
+        if self.get("useStopWordsRemover"):
+            stages.append(StopWordsRemover(
+                inputCol=cur, outputCol="_tf_nostop",
+                stopWords=self.get_or_none("stopWords"),
+                caseSensitive=self.get("caseSensitiveStopWords")))
+            cur = "_tf_nostop"
+        if self.get("useNGram"):
+            stages.append(NGram(inputCol=cur, outputCol="_tf_ngrams",
+                                n=self.get("nGramLength")))
+            cur = "_tf_ngrams"
+        if self.get("useHashingTF"):
+            stages.append(HashingTF(
+                inputCol=cur, outputCol="_tf_tf",
+                numFeatures=self.get("numFeatures"),
+                binary=self.get("binary")))
+        else:
+            stages.append(CountVectorizer(
+                inputCol=cur, outputCol="_tf_tf",
+                vocabSize=self.get("vocabSize"), minDF=self.get("minDF")))
+        cur = "_tf_tf"
+        if self.get("useIDF"):
+            stages.append(IDF(inputCol=cur, outputCol=self.get_output_col(),
+                              minDocFreq=self.get("minDocFreq")))
+        else:
+            stages.append(RenameTo(inputCol=cur,
+                                   outputCol=self.get_output_col()))
+        fitted = Pipeline(stages).fit(table)
+        temp = [c for c in ("_tf_tokens", "_tf_nostop", "_tf_ngrams",
+                            "_tf_tf") if c != self.get_output_col()]
+        return TextFeaturizerModel(pipeline=fitted, tempCols=temp)
+
+
+class RenameTo(Transformer, HasInputCol, HasOutputCol):
+    """Internal: copy a column under a new name."""
+
+    def transform(self, table: DataTable) -> DataTable:
+        return table.with_column(self.get_output_col(),
+                                 table[self.get_input_col()])
+
+
+class TextFeaturizerModel(Model):
+    from mmlspark_tpu.core.params import StageParam as _SP
+    pipeline = _SP("fitted internal pipeline", default=None)
+    tempCols = ListParam("intermediate columns to drop", default=None)
+
+    def transform(self, table: DataTable) -> DataTable:
+        out = self.get("pipeline").transform(table)
+        for c in self.get("tempCols") or []:
+            if c in out:
+                out = out.drop(c)
+        return out

@@ -1,0 +1,278 @@
+"""AutoML layer tests (ref: featurize/train-classifier/
+tune-hyperparameters/find-best-model/compute-model-statistics suites)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.automl import (
+    ComputeModelStatistics, ComputePerInstanceStatistics, DiscreteHyperParam,
+    Featurize, FindBestModel, GridSpace, HyperparamBuilder, RandomSpace,
+    RangeHyperParam, TrainClassifier, TrainRegressor, TuneHyperparameters,
+)
+from mmlspark_tpu.core import metrics as MC
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.gbdt import TPUBoostClassifier, TPUBoostRegressor
+from mmlspark_tpu.models.linear import (
+    TPULinearRegression, TPULogisticRegression,
+)
+
+
+@pytest.fixture
+def mixed_table():
+    rng = np.random.default_rng(0)
+    n = 200
+    x1 = rng.normal(size=n)
+    x1[:5] = np.nan
+    color = [["red", "green", "blue"][i % 3] for i in range(n)]
+    y = (np.nan_to_num(x1) + (np.arange(n) % 3 == 0) > 0.3).astype(float)
+    return DataTable({
+        "x1": x1, "color": color,
+        "label": ["pos" if v else "neg" for v in y],
+    }), y
+
+
+class TestFeaturize:
+    def test_mixed_types_assembled(self, mixed_table):
+        t, _ = mixed_table
+        model = Featurize(featureColumns=["x1", "color"]).fit(t)
+        out = model.transform(t)
+        f = out["features"]
+        assert f.shape == (200, 2)  # numeric + string index
+        assert np.isfinite(f).all()  # NaN imputed
+
+    def test_one_hot(self, mixed_table):
+        t, _ = mixed_table
+        model = Featurize(featureColumns=["x1", "color"],
+                          oneHotEncodeCategoricals=True).fit(t)
+        f = model.transform(t)["features"]
+        assert f.shape == (200, 4)  # numeric + 3 one-hot
+
+    def test_token_hashing(self):
+        t = DataTable({"toks": [["a", "b"], ["b"]], "label": [0.0, 1.0]})
+        model = Featurize(featureColumns=["toks"],
+                          numberOfFeatures=16).fit(t)
+        f = model.transform(t)["features"]
+        assert f.shape == (2, 16)
+        assert f[0].sum() == 2.0
+
+    def test_vector_passthrough(self):
+        t = DataTable({"v": np.eye(3), "x": [1.0, 2.0, 3.0]})
+        model = Featurize(featureColumns=["v", "x"]).fit(t)
+        assert model.transform(t)["features"].shape == (3, 4)
+
+
+class TestTrainClassifier:
+    def test_string_labels_roundtrip(self, mixed_table):
+        t, y = mixed_table
+        model = TrainClassifier(
+            labelCol="label",
+            model=TPUBoostClassifier(numIterations=15,
+                                     minDataInLeaf=5)).fit(t)
+        out = model.transform(t)
+        assert set(out["scored_labels"]) <= {"pos", "neg"}
+        acc = np.mean([(s == "pos") == bool(v)
+                       for s, v in zip(out["scored_labels"], y)])
+        assert acc > 0.95
+
+    def test_default_model_is_gbdt(self, mixed_table):
+        t, _ = mixed_table
+        tc = TrainClassifier(labelCol="label")
+        from mmlspark_tpu.gbdt import TPUBoostClassifier as C
+        assert isinstance(tc._get_model(), C)
+
+    def test_logistic_backend(self, mixed_table):
+        t, y = mixed_table
+        model = TrainClassifier(labelCol="label",
+                                model=TPULogisticRegression()).fit(t)
+        out = model.transform(t)
+        acc = np.mean([(s == "pos") == bool(v)
+                       for s, v in zip(out["scored_labels"], y)])
+        assert acc > 0.8
+
+    def test_save_load(self, mixed_table, tmp_path):
+        t, _ = mixed_table
+        model = TrainClassifier(
+            labelCol="label",
+            model=TPUBoostClassifier(numIterations=5,
+                                     minDataInLeaf=5)).fit(t)
+        ref = model.transform(t)["prediction"]
+        model.save(str(tmp_path / "tc"))
+        from mmlspark_tpu.automl import TrainedClassifierModel
+        m2 = TrainedClassifierModel.load(str(tmp_path / "tc"))
+        np.testing.assert_allclose(m2.transform(t)["prediction"], ref)
+
+
+class TestTrainRegressor:
+    def test_fit_predict(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(300, 3))
+        y = X @ np.asarray([1.0, -2.0, 0.5]) + 0.1 * rng.normal(size=300)
+        t = DataTable({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+                       "label": y})
+        model = TrainRegressor(
+            labelCol="label",
+            model=TPUBoostRegressor(numIterations=50,
+                                    minDataInLeaf=5)).fit(t)
+        pred = model.transform(t)["prediction"]
+        assert 1 - ((pred - y) ** 2).mean() / y.var() > 0.8
+
+    def test_linear_backend(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(200, 2))
+        y = X @ np.asarray([2.0, -1.0])
+        t = DataTable({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+        model = TrainRegressor(labelCol="label",
+                               model=TPULinearRegression()).fit(t)
+        pred = model.transform(t)["prediction"]
+        assert 1 - ((pred - y) ** 2).mean() / y.var() > 0.95
+
+
+class TestComputeModelStatistics:
+    def _scored_binary(self, n=200, seed=0):
+        rng = np.random.default_rng(seed)
+        y = (rng.random(n) > 0.5).astype(float)
+        p = np.clip(y * 0.8 + rng.random(n) * 0.3, 0, 1)
+        prob = np.stack([1 - p, p], axis=1)
+        pred = (p > 0.5).astype(float)
+        return DataTable({"label": y, "prediction": pred,
+                          "probability": prob}), y, p
+
+    def test_classification_metrics(self):
+        t, y, p = self._scored_binary()
+        stats = ComputeModelStatistics().transform(t)
+        row = stats.row(0)
+        assert 0.9 < row[MC.ACCURACY] <= 1.0
+        assert 0.9 < row[MC.AUC] <= 1.0
+        assert row[MC.CONFUSION_MATRIX].shape == (2, 2)
+        assert row[MC.CONFUSION_MATRIX].sum() == len(y)
+
+    def test_regression_metrics(self):
+        y = np.asarray([1.0, 2.0, 3.0, 4.0])
+        pred = y + np.asarray([0.1, -0.1, 0.1, -0.1])
+        t = DataTable({"label": y, "prediction": pred})
+        row = ComputeModelStatistics(
+            evaluationMetric="regression").transform(t).row(0)
+        np.testing.assert_allclose(row[MC.MSE], 0.01, atol=1e-9)
+        np.testing.assert_allclose(row[MC.RMSE], 0.1, atol=1e-9)
+        assert row[MC.R2] > 0.99
+        np.testing.assert_allclose(row[MC.MAE], 0.1, atol=1e-9)
+
+    def test_auto_mode_detects_regression(self):
+        rng = np.random.default_rng(0)
+        y = rng.normal(size=300)
+        t = DataTable({"label": y, "prediction": y})
+        row = ComputeModelStatistics().transform(t).row(0)
+        assert MC.MSE in row
+
+    def test_auc_tied_scores_order_independent(self):
+        # regression: tied scores must collapse to one ROC point
+        from mmlspark_tpu.automl.statistics import roc_curve
+        y = np.asarray([0.0, 1.0])
+        s = np.asarray([0.5, 0.5])
+        _, _, auc1 = roc_curve(y, s)
+        _, _, auc2 = roc_curve(y[::-1].copy(), s[::-1].copy())
+        assert auc1 == auc2 == 0.5
+
+    def test_macro_metrics_skip_phantom_classes(self):
+        # regression: labels {1,2} with perfect predictions must give
+        # precision = recall = 1.0 (no phantom class 0 in the average)
+        t = DataTable({"label": [1.0, 1.0, 2.0, 2.0],
+                       "prediction": [1.0, 1.0, 2.0, 2.0]})
+        row = ComputeModelStatistics(
+            evaluationMetric="classification").transform(t).row(0)
+        assert row[MC.PRECISION] == 1.0
+        assert row[MC.RECALL] == 1.0
+
+    def test_negative_labels_rejected(self):
+        t = DataTable({"label": [-1.0, 1.0], "prediction": [1.0, 1.0]})
+        with pytest.raises(ValueError, match="negative"):
+            ComputeModelStatistics(
+                evaluationMetric="classification").transform(t)
+
+    def test_roc_table(self):
+        t, _, _ = self._scored_binary()
+        roc = ComputeModelStatistics(numBins=10).roc_table(t)
+        fpr = roc["false_positive_rate"]
+        tpr = roc["true_positive_rate"]
+        assert fpr[0] == 0.0 and tpr[-1] == 1.0
+        assert (np.diff(fpr) >= 0).all()
+
+    def test_per_instance_log_loss(self):
+        t, y, _ = self._scored_binary()
+        out = ComputePerInstanceStatistics().transform(t)
+        ll = out[MC.LOG_LOSS]
+        assert (ll >= 0).all()
+
+    def test_per_instance_regression(self):
+        y = np.asarray([1.0, 2.0])
+        t = DataTable({"label": y, "prediction": y + 0.5})
+        out = ComputePerInstanceStatistics(
+            evaluationMetric="regression").transform(t)
+        np.testing.assert_allclose(out[MC.L1_LOSS], [0.5, 0.5])
+        np.testing.assert_allclose(out[MC.L2_LOSS], [0.25, 0.25])
+
+
+class TestTuning:
+    def _table(self, n=150, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 3))
+        y = (X[:, 0] + X[:, 1] > 0).astype(float)
+        return DataTable({"features": X, "label": y})
+
+    def test_grid_space_enumerates(self):
+        space = (HyperparamBuilder()
+                 .add_hyperparam("a", DiscreteHyperParam([1, 2]))
+                 .add_hyperparam("b", RangeHyperParam(0.0, 1.0, n_grid=3))
+                 .build())
+        maps = list(GridSpace(space).param_maps())
+        assert len(maps) == 6
+
+    def test_random_space_sampling(self):
+        space = {"lr": RangeHyperParam(0.01, 1.0, log=True)}
+        import itertools
+        maps = list(itertools.islice(
+            RandomSpace(space, seed=1).param_maps(), 5))
+        assert len(maps) == 5
+        assert all(0.01 <= m["lr"] <= 1.0 for m in maps)
+
+    def test_tune_finds_reasonable_model(self):
+        t = self._table()
+        space = (HyperparamBuilder()
+                 .add_hyperparam("numIterations",
+                                 DiscreteHyperParam([5, 20]))
+                 .build())
+        tuned = TuneHyperparameters(
+            models=[TPUBoostClassifier(minDataInLeaf=5)],
+            paramSpace=GridSpace(space), evaluationMetric=MC.ACCURACY,
+            numFolds=3, parallelism=2).fit(t)
+        assert tuned.get("bestMetric") > 0.8
+        assert len(tuned.get("history")) == 2
+        out = tuned.transform(t)
+        assert "prediction" in out.column_names
+
+    def test_int_range_param_stays_int(self):
+        r = RangeHyperParam(2, 10)
+        rng = np.random.default_rng(0)
+        assert isinstance(r.sample(rng), int)
+        assert all(isinstance(v, int) for v in r.grid())
+
+
+class TestFindBestModel:
+    def test_picks_better_model(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(200, 3))
+        y = (X[:, 0] > 0).astype(float)
+        t = DataTable({"features": X, "label": y})
+        good = TPUBoostClassifier(numIterations=30,
+                                  minDataInLeaf=5).fit(t)
+        # shuffled labels -> genuinely uninformative model
+        t_bad = DataTable({"features": t["features"],
+                           "label": np.random.default_rng(1)
+                           .permutation(y)})
+        bad = TPUBoostClassifier(numIterations=5,
+                                 minDataInLeaf=5).fit(t_bad)
+        best = FindBestModel(models=[bad, good],
+                             evaluationMetric=MC.AUC).fit(t)
+        assert best.get("bestModel") is good
+        results = best.get_evaluation_results()
+        assert len(results) == 2

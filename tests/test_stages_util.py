@@ -1,0 +1,265 @@
+"""Utility + data-prep stage tests (ref style: pipeline-stages suites —
+construct stage, transform tiny inline table, assert values/schema)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.stages.basic import (
+    Cacher, CheckpointData, ClassBalancer, DropColumns, Explode, Lambda,
+    RenameColumn, Repartition, SelectColumns, TextPreprocessor, Timer,
+    UDFTransformer,
+)
+from mmlspark_tpu.stages.dataprep import (
+    CleanMissingData, DataConversion, EnsembleByKey, MultiColumnAdapter,
+    PartitionSample, SummarizeData, ValueIndexer,
+)
+
+
+@pytest.fixture
+def basic_table():
+    return DataTable({
+        "a": [1.0, 2.0, np.nan, 4.0],
+        "b": ["x", "y", "x", "z"],
+        "lists": [[1, 2], [3], [4, 5, 6], [7]],
+    })
+
+
+class TestBasicStages:
+    def test_drop_select_rename(self, basic_table):
+        assert DropColumns(cols=["lists"]).transform(
+            basic_table).column_names == ["a", "b"]
+        assert SelectColumns(cols=["b"]).transform(
+            basic_table).column_names == ["b"]
+        out = RenameColumn(inputCol="a", outputCol="alpha").transform(
+            basic_table)
+        assert "alpha" in out.column_names and "a" not in out.column_names
+
+    def test_cacher_identity(self, basic_table):
+        out = Cacher().transform(basic_table)
+        assert out.to_rows()[1]["b"] == "y"
+
+    def test_repartition(self, basic_table):
+        out = Repartition(n=2).transform(basic_table)
+        assert out.num_shards == 2
+        assert len(out.shards()) == 2
+
+    def test_explode(self, basic_table):
+        out = Explode(inputCol="lists", outputCol="item").transform(
+            basic_table)
+        assert len(out) == 7
+        assert out.to_rows()[0]["item"] == 1
+
+    def test_lambda(self, basic_table):
+        stage = Lambda.apply(lambda t: t.filter(
+            np.asarray([True, False, True, False])))
+        assert len(stage.transform(basic_table)) == 2
+
+    def test_udf_transformer_single_and_multi(self, basic_table):
+        out = UDFTransformer(inputCol="b", outputCol="b_up",
+                             udf=str.upper).transform(basic_table)
+        assert list(out["b_up"]) == ["X", "Y", "X", "Z"]
+        out2 = UDFTransformer(
+            inputCols=["a", "b"], outputCol="joined",
+            udf=lambda a, b: f"{b}{a}").transform(basic_table)
+        assert out2["joined"][0] == "x1.0"
+
+    def test_class_balancer(self, basic_table):
+        model = ClassBalancer(inputCol="b").fit(basic_table)
+        w = model.transform(basic_table)["weight"]
+        # 'x' appears twice -> weight 1; 'y'/'z' once -> weight 2
+        np.testing.assert_allclose(w, [1.0, 2.0, 1.0, 2.0])
+
+    def test_text_preprocessor_longest_match(self):
+        t = DataTable({"s": ["abcd", "ab"]})
+        out = TextPreprocessor(
+            inputCol="s", outputCol="s",
+            map={"ab": "1", "abc": "2"}).transform(t)
+        # longest match first: "abcd" -> "2d", not "1cd"
+        assert list(out["s"]) == ["2d", "1"]
+
+    def test_timer_wraps_transformer(self, basic_table):
+        out = Timer(stage=DropColumns(cols=["lists"])).transform(
+            basic_table)
+        assert out.column_names == ["a", "b"]
+
+    def test_timer_wraps_estimator(self, basic_table):
+        timed = Timer(stage=ClassBalancer(inputCol="b"))
+        model = timed.fit(basic_table)
+        assert "weight" in model.transform(basic_table).column_names
+
+    def test_timer_in_pipeline_fits_once(self, basic_table):
+        # regression: Timer must be an Estimator so the pipeline stores
+        # the FITTED inner model, not a refit-on-transform wrapper
+        from mmlspark_tpu.core.stage import Pipeline
+        from mmlspark_tpu.stages.dataprep import ValueIndexer
+        pipe = Pipeline([Timer(stage=ValueIndexer(inputCol="b",
+                                                  outputCol="bi"))])
+        model = pipe.fit(basic_table)
+        test_t = DataTable({"b": ["z", "x"]})  # different level set
+        out = model.transform(test_t)
+        # train levels were x,y,z -> z=2, x=0 (NOT refit on test data)
+        np.testing.assert_allclose(out["bi"], [2.0, 0.0])
+
+    def test_explode_empty_keeps_schema(self):
+        t = DataTable({"lists": [[], []], "k": [1.0, 2.0]})
+        out = Explode(inputCol="lists", outputCol="item").transform(t)
+        assert len(out) == 0
+        assert "k" in out.column_names and "item" in out.column_names
+
+    def test_checkpoint_data(self, basic_table, tmp_path):
+        stage = CheckpointData(diskIncluded=True,
+                               checkpointDir=str(tmp_path))
+        out = stage.transform(basic_table)
+        assert len(out) == 4
+        import os
+        assert any(p.startswith("checkpoint_")
+                   for p in os.listdir(tmp_path))
+
+
+class TestValueIndexer:
+    def test_index_and_metadata(self, basic_table):
+        model = ValueIndexer(inputCol="b", outputCol="b_idx").fit(
+            basic_table)
+        out = model.transform(basic_table)
+        np.testing.assert_allclose(out["b_idx"], [0, 1, 0, 2])
+        assert out.schema["b_idx"].meta["levels"] == ["x", "y", "z"]
+        assert out.schema["b_idx"].meta["categorical"] is True
+
+    def test_unindex_roundtrip(self, basic_table):
+        model = ValueIndexer(inputCol="b", outputCol="b_idx").fit(
+            basic_table)
+        t = model.transform(basic_table)
+        back = model.unindex(t, "b_idx", "b_back")
+        assert list(back["b_back"]) == ["x", "y", "x", "z"]
+
+    def test_unknown_value_maps_negative(self, basic_table):
+        model = ValueIndexer(inputCol="b", outputCol="i").fit(basic_table)
+        t2 = DataTable({"b": ["q"]})
+        assert model.transform(t2)["i"][0] == -1
+
+    def test_save_load(self, basic_table, tmp_path):
+        model = ValueIndexer(inputCol="b", outputCol="i").fit(basic_table)
+        model.save(str(tmp_path / "vi"))
+        from mmlspark_tpu.stages.dataprep import ValueIndexerModel
+        m2 = ValueIndexerModel.load(str(tmp_path / "vi"))
+        assert m2.get("levels") == ["x", "y", "z"]
+
+
+class TestCleanMissingData:
+    def test_mean_impute(self, basic_table):
+        model = CleanMissingData(inputCols=["a"], outputCols=["a"],
+                                 cleaningMode="Mean").fit(basic_table)
+        out = model.transform(basic_table)
+        np.testing.assert_allclose(out["a"][2], (1 + 2 + 4) / 3)
+
+    def test_median_impute(self, basic_table):
+        model = CleanMissingData(inputCols=["a"], outputCols=["a"],
+                                 cleaningMode="Median").fit(basic_table)
+        assert model.transform(basic_table)["a"][2] == 2.0
+
+    def test_custom_impute(self, basic_table):
+        model = CleanMissingData(inputCols=["a"], outputCols=["a_c"],
+                                 cleaningMode="Custom",
+                                 customValue=-1.0).fit(basic_table)
+        out = model.transform(basic_table)
+        assert out["a_c"][2] == -1.0
+        assert np.isnan(out["a"][2])  # original untouched
+
+
+class TestDataConversion:
+    def test_numeric_casts(self):
+        t = DataTable({"x": [1.5, 2.5]})
+        out = DataConversion(cols=["x"], convertTo="integer").transform(t)
+        assert out["x"].dtype == np.int32
+        out = DataConversion(cols=["x"], convertTo="string").transform(t)
+        assert list(out["x"]) == ["1.5", "2.5"]
+
+    def test_to_categorical(self):
+        t = DataTable({"x": ["b", "a", "b"]})
+        out = DataConversion(cols=["x"],
+                             convertTo="toCategorical").transform(t)
+        assert out.schema["x"].meta.get("categorical")
+        np.testing.assert_allclose(out["x"], [1, 0, 1])
+
+    def test_date_parse(self):
+        t = DataTable({"d": ["2026-07-29 10:00:00"]})
+        out = DataConversion(cols=["d"], convertTo="date").transform(t)
+        assert out["d"][0].year == 2026
+
+
+class TestSummarizeData:
+    def test_stats_shape_and_values(self, basic_table):
+        s = SummarizeData().transform(basic_table)
+        assert list(s["Feature"]) == ["a", "b", "lists"]
+        row_a = s.to_rows()[0]
+        assert row_a["Missing_Value_Count"] == 1.0
+        assert row_a["Min"] == 1.0 and row_a["Max"] == 4.0
+        assert "Median" in row_a
+
+    def test_subset_flags(self, basic_table):
+        s = SummarizeData(percentiles=False, sample=False).transform(
+            basic_table)
+        assert "Median" not in s.column_names
+
+
+class TestPartitionSample:
+    def test_head(self, basic_table):
+        assert len(PartitionSample(mode="Head", count=2).transform(
+            basic_table)) == 2
+
+    def test_random_sample_fraction(self):
+        t = DataTable({"x": np.arange(1000).astype(float)})
+        out = PartitionSample(mode="RandomSample", percent=0.3,
+                              rs_seed=1).transform(t)
+        assert 200 < len(out) < 400
+
+    def test_assign_to_partition(self, basic_table):
+        out = PartitionSample(mode="AssignToPartition",
+                              numParts=2).transform(basic_table)
+        assert set(np.unique(out["Partition"])) <= {0, 1}
+
+
+class TestEnsembleByKey:
+    def test_scalar_mean_collapse(self):
+        t = DataTable({"k": ["a", "a", "b"], "v": [1.0, 3.0, 5.0]})
+        out = EnsembleByKey(keys=["k"], cols=["v"]).transform(t)
+        rows = {r["k"]: r["v_avg"] for r in out.to_rows()}
+        assert rows == {"a": 2.0, "b": 5.0}
+
+    def test_vector_mean_no_collapse(self):
+        t = DataTable({"k": ["a", "a"],
+                       "v": np.asarray([[1.0, 2.0], [3.0, 4.0]])})
+        out = EnsembleByKey(keys=["k"], cols=["v"],
+                            collapseGroup=False).transform(t)
+        assert len(out) == 2
+        np.testing.assert_allclose(out.to_rows()[0]["v_avg"], [2.0, 3.0])
+
+
+class TestMultiColumnAdapter:
+    def test_applies_stage_per_column(self):
+        from mmlspark_tpu.stages.text import Tokenizer
+        t = DataTable({"s1": ["a b", "c d"], "s2": ["e f", "g h"]})
+        out = MultiColumnAdapter(
+            baseStage=Tokenizer(), inputCols=["s1", "s2"],
+            outputCols=["t1", "t2"]).transform(t)
+        assert out["t1"][0] == ["a", "b"]
+        assert out["t2"][1] == ["g", "h"]
+
+    def test_estimator_base_keeps_train_state(self):
+        # regression: fitted per-column state must come from fit()'s
+        # table, not the scoring table (train/serve skew)
+        train_t = DataTable({"c": ["a", "b", "c", "a"]})
+        model = MultiColumnAdapter(
+            baseStage=ValueIndexer(), inputCols=["c"],
+            outputCols=["ci"]).fit(train_t)
+        test_t = DataTable({"c": ["c", "c", "b", "x"]})
+        out = model.transform(test_t)
+        np.testing.assert_allclose(out["ci"], [2, 2, 1, -1])
+
+    def test_estimator_base_transform_without_fit_raises(self):
+        t = DataTable({"c": ["a"]})
+        with pytest.raises(TypeError, match="fit"):
+            MultiColumnAdapter(baseStage=ValueIndexer(),
+                               inputCols=["c"],
+                               outputCols=["ci"]).transform(t)

@@ -1,0 +1,112 @@
+"""Text featurization tests (ref: text-featurizer suites)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.stages.text import (
+    CountVectorizer, HashingTF, IDF, NGram, StopWordsRemover,
+    TextFeaturizer, Tokenizer, _stable_hash,
+)
+
+
+@pytest.fixture
+def docs():
+    return DataTable({
+        "text": ["The quick brown fox", "lazy dogs sleep all day",
+                 "quick quick fox runs"],
+        "label": [0.0, 1.0, 0.0],
+    })
+
+
+class TestBuildingBlocks:
+    def test_tokenizer(self, docs):
+        out = Tokenizer(inputCol="text", outputCol="toks").transform(docs)
+        assert out["toks"][0] == ["the", "quick", "brown", "fox"]
+
+    def test_tokenizer_no_lowercase_min_len(self, docs):
+        out = Tokenizer(inputCol="text", outputCol="toks",
+                        toLowercase=False,
+                        minTokenLength=4).transform(docs)
+        assert out["toks"][0] == ["quick", "brown"]
+
+    def test_stopwords(self, docs):
+        t = Tokenizer(inputCol="text", outputCol="toks").transform(docs)
+        out = StopWordsRemover(inputCol="toks",
+                               outputCol="clean").transform(t)
+        assert "the" not in out["clean"][0]
+        assert "quick" in out["clean"][0]
+
+    def test_ngram(self, docs):
+        t = Tokenizer(inputCol="text", outputCol="toks").transform(docs)
+        out = NGram(inputCol="toks", outputCol="bi", n=2).transform(t)
+        assert out["bi"][0] == ["the quick", "quick brown", "brown fox"]
+
+    def test_hashing_tf_counts(self, docs):
+        t = Tokenizer(inputCol="text", outputCol="toks").transform(docs)
+        out = HashingTF(inputCol="toks", outputCol="tf",
+                        numFeatures=32).transform(t)
+        # doc 2 has 'quick' twice
+        v = out["tf"][2]
+        assert v[_stable_hash("quick") % 32] == 2.0
+
+    def test_stable_hash_deterministic(self):
+        assert _stable_hash("token") == _stable_hash("token")
+        assert _stable_hash("a") != _stable_hash("b")
+
+    def test_count_vectorizer_vocab_order(self, docs):
+        t = Tokenizer(inputCol="text", outputCol="toks").transform(docs)
+        model = CountVectorizer(inputCol="toks", outputCol="cv").fit(t)
+        vocab = model.get("vocabulary")
+        assert vocab[0] == "quick"  # most frequent first
+        out = model.transform(t)
+        assert out["cv"][2][0] == 2.0
+
+    def test_idf_downweights_common_terms(self, docs):
+        t = Tokenizer(inputCol="text", outputCol="toks").transform(docs)
+        cv = CountVectorizer(inputCol="toks", outputCol="cv").fit(t)
+        tt = cv.transform(t)
+        idf_model = IDF(inputCol="cv", outputCol="tfidf").fit(tt)
+        idf = np.asarray(idf_model.get("idf"))
+        vocab = cv.get("vocabulary")
+        # 'quick' (2 docs) must weigh less than 'lazy' (1 doc)
+        assert idf[vocab.index("quick")] < idf[vocab.index("lazy")]
+
+
+class TestTextFeaturizer:
+    def test_default_pipeline(self, docs):
+        model = TextFeaturizer(inputCol="text", outputCol="feats",
+                               numFeatures=64).fit(docs)
+        out = model.transform(docs)
+        assert out["feats"].shape == (3, 64)
+        assert "_tf_tokens" not in out.column_names  # temps dropped
+
+    def test_count_vectorizer_path(self, docs):
+        model = TextFeaturizer(inputCol="text", outputCol="feats",
+                               useHashingTF=False, useIDF=False).fit(docs)
+        out = model.transform(docs)
+        assert out["feats"].shape[0] == 3
+
+    def test_ngram_path(self, docs):
+        model = TextFeaturizer(inputCol="text", outputCol="feats",
+                               useNGram=True, nGramLength=2,
+                               numFeatures=128).fit(docs)
+        assert model.transform(docs)["feats"].shape == (3, 128)
+
+    def test_features_discriminate(self, docs):
+        model = TextFeaturizer(inputCol="text", outputCol="feats",
+                               numFeatures=256).fit(docs)
+        f = model.transform(docs)["feats"]
+        # docs 0 and 2 share words; doc 1 is disjoint
+        sim02 = float(f[0] @ f[2])
+        sim01 = float(f[0] @ f[1])
+        assert sim02 > sim01
+
+    def test_save_load(self, docs, tmp_path):
+        model = TextFeaturizer(inputCol="text", outputCol="feats",
+                               numFeatures=64).fit(docs)
+        ref = model.transform(docs)["feats"]
+        model.save(str(tmp_path / "tf"))
+        from mmlspark_tpu.stages.text import TextFeaturizerModel
+        m2 = TextFeaturizerModel.load(str(tmp_path / "tf"))
+        np.testing.assert_allclose(m2.transform(docs)["feats"], ref)
