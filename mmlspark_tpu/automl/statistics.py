@@ -139,6 +139,13 @@ class ComputeModelStatistics(Transformer):
                           "true_positive_rate": tpr[idx]})
 
     def transform_schema(self, schema: Schema) -> Schema:
+        mode = self.get("evaluationMetric")
+        if mode in ("auto", MC.ALL_METRICS):
+            # data-dependent; promise only the universally-present rows
+            return Schema([])
+        if mode == "regression":
+            return Schema([Field(m, F64) for m in
+                           (MC.MSE, MC.RMSE, MC.R2, MC.MAE)])
         return Schema([Field(MC.ACCURACY, F64), Field(MC.PRECISION, F64),
                        Field(MC.RECALL, F64)])
 
@@ -178,4 +185,10 @@ class ComputePerInstanceStatistics(Transformer):
                                  Field(MC.LOG_LOSS, F64))
 
     def transform_schema(self, schema: Schema) -> Schema:
-        return schema.add_or_replace(Field(MC.LOG_LOSS, F64))
+        mode = self.get("evaluationMetric")
+        if mode == "regression":
+            return (schema.add_or_replace(Field(MC.L1_LOSS, F64))
+                    .add_or_replace(Field(MC.L2_LOSS, F64)))
+        if mode == "classification":
+            return schema.add_or_replace(Field(MC.LOG_LOSS, F64))
+        return schema  # auto: data-dependent

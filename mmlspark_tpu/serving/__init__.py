@@ -1,0 +1,12 @@
+"""Streaming HTTP serving.
+
+Analog of Spark Serving (ref: src/io/http/src/main/scala/HTTPSource.scala,
+DistributedHTTPSource.scala, ServingImplicits.scala).
+"""
+
+from mmlspark_tpu.serving.server import (
+    HTTPSource, ServingEngine, SharedSingleton, SharedVariable, serve_model,
+)
+
+__all__ = ["HTTPSource", "ServingEngine", "SharedSingleton",
+           "SharedVariable", "serve_model"]

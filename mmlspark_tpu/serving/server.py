@@ -1,0 +1,284 @@
+"""Serving engine: HTTP source/sink with reply-by-uuid routing.
+
+TPU-native re-creation of Spark Serving
+(ref: src/io/http/src/main/scala/HTTPSource.scala:48-178 single-node
+source/sink; DistributedHTTPSource.scala:33-472 per-executor
+JVMSharedServer with batch-indexed request routing and reply-by-uuid;
+PartitionConsolidator.scala:17).
+
+Design: each serving host runs one threaded HTTP server (the
+JVMSharedServer analog). Accepted requests park their connection and
+enqueue (uuid, request-struct); the serving engine drains the queue into
+DataTable micro-batches, runs the user pipeline (whose heavy stages are
+jitted/sharded on the TPU mesh), and the sink answers each row back
+through the SAME host's held connection — the reply-routing invariant of
+the reference (replies must flow through the host that accepted the
+request, DistributedHTTPSource.scala:188-192). On a multi-host mesh, run
+one ServingEngine per host behind any TCP load balancer; model state is
+replicated by jax, no cross-host reply routing is ever needed.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import uuid as uuid_lib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.io.http import HTTPSchema, _jsonable as _to_jsonable
+
+log = get_logger("serving")
+
+
+class SharedVariable:
+    """Process-wide lazily-initialized shared value
+    (ref: io/http SharedVariable.scala double-checked lazy singleton)."""
+
+    def __init__(self, factory: Callable[[], Any]):
+        self._factory = factory
+        self._value = None
+        self._have = False
+        self._lock = threading.Lock()
+
+    def get(self) -> Any:
+        if not self._have:
+            with self._lock:
+                if not self._have:
+                    self._value = self._factory()
+                    self._have = True
+        return self._value
+
+
+class SharedSingleton:
+    """Keyed process-wide singletons (ref: SharedSingleton.scala)."""
+
+    _instances: Dict[str, Any] = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def get_or_create(cls, key: str, factory: Callable[[], Any]) -> Any:
+        with cls._lock:
+            if key not in cls._instances:
+                cls._instances[key] = factory()
+            return cls._instances[key]
+
+
+class _ParkedRequest:
+    """A request whose connection is held open until respond()."""
+
+    def __init__(self, rid: str, request_struct: Dict[str, Any]):
+        self.id = rid
+        self.request = request_struct
+        self._event = threading.Event()
+        self.response: Optional[Dict[str, Any]] = None
+
+    def respond(self, response: Dict[str, Any]) -> None:
+        self.response = response
+        self._event.set()
+
+    def wait(self, timeout: float) -> Optional[Dict[str, Any]]:
+        if self._event.wait(timeout):
+            return self.response
+        return None
+
+
+class HTTPSource:
+    """One host's HTTP server + request queue
+    (ref: HTTPSource.scala:48-138; JVMSharedServer
+    DistributedHTTPSource.scala:96-246 incl. port scanning and
+    requestsSeen/Accepted counters)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8899,
+                 api_path: str = "/", max_queue: int = 10_000,
+                 reply_timeout: float = 60.0, port_scan: int = 20):
+        self.api_path = api_path
+        self.queue: "queue.Queue[_ParkedRequest]" = queue.Queue(max_queue)
+        self.requests_seen = 0
+        self.requests_accepted = 0
+        self.requests_answered = 0
+        self._pending: Dict[str, _ParkedRequest] = {}
+        self._lock = threading.Lock()
+        source = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                source.requests_seen += 1
+                if source.api_path not in ("/", "") and \
+                        self.path.rstrip("/") != source.api_path.rstrip("/"):
+                    self.send_error(404, f"unknown path {self.path}")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                req = HTTPSchema.request(
+                    self.path, "POST", body,
+                    {k: v for k, v in self.headers.items()})
+                parked = _ParkedRequest(uuid_lib.uuid4().hex, req)
+                with source._lock:
+                    source._pending[parked.id] = parked
+                try:
+                    source.queue.put_nowait(parked)
+                    source.requests_accepted += 1
+                except queue.Full:
+                    with source._lock:
+                        source._pending.pop(parked.id, None)
+                    self.send_error(503, "queue full")
+                    return
+                resp = parked.wait(reply_timeout)
+                with source._lock:
+                    source._pending.pop(parked.id, None)
+                if resp is None:
+                    self.send_error(504, "serving timeout")
+                    return
+                code = resp["statusLine"]["statusCode"]
+                entity = resp.get("entity") or b""
+                if isinstance(entity, str):
+                    entity = entity.encode("utf-8")
+                self.send_response(code)
+                for k, v in (resp.get("headers") or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(entity)))
+                self.end_headers()
+                self.wfile.write(entity)
+                source.requests_answered += 1
+
+            def log_message(self, *a):  # silence default stderr logging
+                pass
+
+        class Server(ThreadingHTTPServer):
+            request_queue_size = 128  # listen backlog for bursty clients
+            daemon_threads = True
+
+        last_err: Optional[Exception] = None
+        for p in range(port, port + port_scan):
+            try:
+                self.server = Server((host, p), Handler)
+                self.port = p
+                break
+            except OSError as e:  # port taken — scan upward (ref :234)
+                last_err = e
+        else:
+            raise OSError(f"no free port in [{port}, {port+port_scan}): "
+                          f"{last_err}")
+        self.address = f"http://{host}:{self.port}"
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        log.info("serving source listening on %s", self.address)
+
+    def get_batch(self, max_rows: int = 64,
+                  wait_s: float = 0.05) -> Tuple[DataTable, List[str]]:
+        """Drain up to max_rows parked requests into a table
+        (ref: HTTPSource.getBatch)."""
+        parked: List[_ParkedRequest] = []
+        deadline = time.time() + wait_s
+        while len(parked) < max_rows:
+            remaining = deadline - time.time()
+            if remaining <= 0 and parked:
+                break
+            try:
+                parked.append(self.queue.get(
+                    timeout=max(remaining, 0.001)))
+            except queue.Empty:
+                break
+        if not parked:
+            return DataTable({"id": [], "request": []}), []
+        return (DataTable({"id": [p.id for p in parked],
+                           "request": [p.request for p in parked]}),
+                [p.id for p in parked])
+
+    def respond(self, rid: str, response: Dict[str, Any]) -> bool:
+        """Reply through the held connection (ref:
+        DistributedHTTPSource.scala:188 server.respond(batch, uuid, …))."""
+        with self._lock:
+            parked = self._pending.get(rid)
+        if parked is None:
+            return False
+        parked.respond(response)
+        return True
+
+    def close(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class ServingEngine:
+    """The streaming loop: source → user pipeline → sink
+    (the structured-streaming query of ref: ServingImplicits.scala:10-50
+    ``readStream.server()…writeStream.server()``)."""
+
+    def __init__(self, source: HTTPSource, pipeline: Transformer,
+                 reply_col: str = "reply", id_col: str = "id",
+                 batch_size: int = 64,
+                 content_type: str = "application/json"):
+        self.source = source
+        self.pipeline = pipeline
+        self.reply_col = reply_col
+        self.id_col = id_col
+        self.batch_size = batch_size
+        self.content_type = content_type
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.batches_processed = 0
+
+    def process_one_batch(self, wait_s: float = 0.05) -> int:
+        table, ids = self.source.get_batch(self.batch_size, wait_s)
+        if not ids:
+            return 0
+        try:
+            out = self.pipeline.transform(table)
+            replies = out[self.reply_col]
+            out_ids = out[self.id_col]
+        except Exception as e:  # noqa: BLE001 — errors become 500s
+            log.warning("serving pipeline failed: %s", e)
+            for rid in ids:
+                self.source.respond(rid, HTTPSchema.response(
+                    500, f"pipeline error: {e}", None))
+            return len(ids)
+        answered = set()
+        for rid, rep in zip(out_ids, replies):
+            body = rep if isinstance(rep, (bytes, str)) \
+                else json.dumps(_to_jsonable(rep))
+            self.source.respond(rid, HTTPSchema.response(
+                200, "OK", body if isinstance(body, bytes)
+                else body.encode("utf-8"),
+                {"Content-Type": self.content_type}))
+            answered.add(rid)
+        for rid in ids:
+            if rid not in answered:
+                self.source.respond(rid, HTTPSchema.response(
+                    500, "row dropped by pipeline", None))
+        self.batches_processed += 1
+        return len(ids)
+
+    def start(self) -> "ServingEngine":
+        def loop():
+            while not self._stop.is_set():
+                if self.process_one_batch() == 0:
+                    time.sleep(0.005)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.source.close()
+
+
+def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
+                port: int = 8899, batch_size: int = 64,
+                reply_col: str = "reply") -> ServingEngine:
+    """One-call serving: the ``.server()`` DSL analog
+    (ref: ServingImplicits.scala:10-50)."""
+    source = HTTPSource(host=host, port=port)
+    return ServingEngine(source, pipeline, reply_col=reply_col,
+                         batch_size=batch_size).start()

@@ -191,6 +191,14 @@ class SummarizeData(Transformer):
     errorThreshold = FloatParam("percentile error (parity param)",
                                 default=0.0)
 
+    def transform_schema(self, schema: Schema) -> Schema:
+        fields = [Field("Feature", STRING)]
+        if self.get("counts"):
+            fields += [Field(n, F64) for n in
+                       ("Count", "Unique_Value_Count",
+                        "Missing_Value_Count")]
+        return Schema(fields)
+
     def transform(self, table: DataTable) -> DataTable:
         rows: List[Dict[str, Any]] = []
         for name in table.column_names:
@@ -281,6 +289,20 @@ class EnsembleByKey(Transformer):
     collapseGroup = BoolParam("one row per group", default=True)
     vectorDims = DictParam("parity param; unused", default=None)
 
+    def transform_schema(self, schema: Schema) -> Schema:
+        keys = self.get("keys") or []
+        cols = self.get("cols") or []
+        names = self.get("colNames") or [f"{c}_avg" for c in cols]
+        # averaging always yields f64 scalars; vectors stay vectors
+        avg_fields = [Field(n, VECTOR if schema[c].tag == VECTOR else F64)
+                      for n, c in zip(names, cols)]
+        if self.get("collapseGroup"):
+            return Schema([schema[k] for k in keys] + avg_fields)
+        out = schema
+        for f in avg_fields:
+            out = out.add_or_replace(f)
+        return out
+
     def transform(self, table: DataTable) -> DataTable:
         keys = self.get("keys") or []
         cols = self.get("cols") or []
@@ -349,7 +371,8 @@ class MultiColumnAdapter(Estimator):
 
 
 class MultiColumnAdapterModel(Model):
-    stages = ListParam("fitted per-column stages", default=None)
+    from mmlspark_tpu.core.params import ComplexParam as _CxP
+    stages = _CxP("fitted per-column stages", default=None)
 
     def transform(self, table: DataTable) -> DataTable:
         out = table

@@ -1,0 +1,385 @@
+"""Structural fuzzing coverage — every registered stage must have a
+TestObject, and every TestObject passes experiment / serialization /
+schema fuzzing.
+
+This reproduces the reference's reflection-driven coverage enforcement
+(ref: src/core/test/fuzzing/src/test/scala/FuzzingTest.scala:13-80 —
+enumerate every PipelineStage in the jars, assert each has an experiment
+fuzzer and a serialization fuzzer, with an explicit exemption list
+:26-35). Here the registry is ``STAGE_REGISTRY`` (populated by
+``__init_subclass__``) and the exemption list documents WHY each stage
+is excluded.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+# import every stage-defining module so STAGE_REGISTRY is complete
+import mmlspark_tpu.automl  # noqa: F401
+import mmlspark_tpu.gbdt  # noqa: F401
+import mmlspark_tpu.io.http  # noqa: F401
+import mmlspark_tpu.io.minibatch  # noqa: F401
+import mmlspark_tpu.models.learner  # noqa: F401
+import mmlspark_tpu.models.linear  # noqa: F401
+import mmlspark_tpu.models.tpu_model  # noqa: F401
+import mmlspark_tpu.stages  # noqa: F401
+
+from mmlspark_tpu.core.schema import ImageSchema
+from mmlspark_tpu.core.stage import (
+    Estimator, Model, Pipeline, PipelineModel, STAGE_REGISTRY, Transformer,
+)
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.testing.fuzzing import (
+    FUZZING_REGISTRY, TestObject, register_test_object,
+    run_experiment_fuzzing, run_schema_fuzzing, run_serialization_fuzzing,
+)
+
+# ---------------------------------------------------------------------------
+# exemptions (ref: FuzzingTest.scala:26-35) — each with a reason
+# ---------------------------------------------------------------------------
+
+EXEMPT = {
+    # abstract bases / containers (fuzzed through concrete stages)
+    "Transformer": "abstract base",
+    "Estimator": "abstract base",
+    "Model": "abstract base",
+    "Pipeline": "container; fuzzed via composed stages",
+    "PipelineModel": "container; fuzzed via composed stages",
+    # network-dependent stages: fuzzed against live servers in
+    # tests/test_http_serving.py
+    "HTTPTransformer": "needs live server (test_http_serving)",
+    "SimpleHTTPTransformer": "needs live server (test_http_serving)",
+    # internal helper stage of TextFeaturizer
+    "RenameTo": "internal to TextFeaturizerModel",
+}
+# fitted models are covered through their estimator's fuzzers
+MODEL_EXEMPT_REASON = "Model subclass; fuzzed via its estimator"
+
+
+# ---------------------------------------------------------------------------
+# shared tiny tables
+# ---------------------------------------------------------------------------
+
+
+def _num_table(n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 3))
+    return DataTable({
+        "features": X,
+        "label": (X[:, 0] > 0).astype(float),
+        "num": X[:, 1],
+        "cat": [["a", "b"][i % 2] for i in range(n)],
+        "text": ["quick brown fox" if i % 2 else "lazy dog" or ""
+                 for i in range(n)],
+        "toks": [["quick", "fox"] if i % 2 else ["lazy"]
+                 for i in range(n)],
+        "lists": [[float(i), float(i + 1)] for i in range(n)],
+    })
+
+
+def _img_table(n=4):
+    rng = np.random.default_rng(0)
+    rows = [ImageSchema.make_row(
+        f"img{i}", rng.integers(0, 255, (16, 16, 3)).astype(np.uint8),
+        "RGB") for i in range(n)]
+    return DataTable({"image": rows, "label": [float(i % 2)
+                                               for i in range(n)]})
+
+
+# module-level functions so pickle-based serialization works
+def _double(v):
+    return v * 2
+
+
+def _identity_table(t):
+    return t
+
+
+def _req_from_value(v):
+    from mmlspark_tpu.io.http import HTTPSchema
+    return HTTPSchema.request("http://example.invalid", "POST",
+                              json.dumps({"v": float(v)}).encode())
+
+
+def _resp_to_code(r):
+    return r["statusLine"]["statusCode"]
+
+
+# ---------------------------------------------------------------------------
+# TestObject registrations
+# ---------------------------------------------------------------------------
+
+
+def _register_all():
+    from mmlspark_tpu.automl import (
+        AssembleFeatures, ComputeModelStatistics,
+        ComputePerInstanceStatistics, DiscreteHyperParam, Featurize,
+        FindBestModel, GridSpace, HyperparamBuilder, TrainClassifier,
+        TrainRegressor, TuneHyperparameters,
+    )
+    from mmlspark_tpu.gbdt import TPUBoostClassifier, TPUBoostRegressor
+    from mmlspark_tpu.io.http import (
+        CustomInputParser, CustomOutputParser, HTTPSchema, JSONInputParser,
+        JSONOutputParser,
+    )
+    from mmlspark_tpu.io.minibatch import (
+        DynamicMiniBatchTransformer, FixedMiniBatchTransformer,
+        FlattenBatch, TimeIntervalMiniBatchTransformer,
+    )
+    from mmlspark_tpu.models.learner import TPULearner
+    from mmlspark_tpu.models.linear import (
+        TPULinearRegression, TPULogisticRegression,
+    )
+    from mmlspark_tpu.stages import (
+        Cacher, CheckpointData, ClassBalancer, CleanMissingData,
+        CountVectorizer, DataConversion, DropColumns, EnsembleByKey,
+        Explode, HashingTF, IDF, ImageFeaturizer, ImageSetAugmenter,
+        ImageTransformer, Lambda, MultiColumnAdapter, NGram,
+        PartitionSample, RenameColumn, Repartition, SelectColumns,
+        StopWordsRemover, SummarizeData, TextFeaturizer, TextPreprocessor,
+        Timer, Tokenizer, UDFTransformer, UnrollImage, ValueIndexer,
+    )
+
+    T = _num_table()
+    reg = register_test_object
+
+    # utility stages
+    reg(lambda: TestObject(Cacher(), transform_table=_num_table()))
+    reg(lambda: TestObject(DropColumns(cols=["num"]),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(SelectColumns(cols=["num", "label"]),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(RenameColumn(inputCol="num", outputCol="n2"),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(Repartition(n=2), transform_table=_num_table()))
+    reg(lambda: TestObject(Explode(inputCol="lists", outputCol="item"),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(Lambda(transformFunc=_identity_table),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(
+        UDFTransformer(inputCol="num", outputCol="num2", udf=_double),
+        transform_table=_num_table()))
+    reg(lambda: TestObject(ClassBalancer(inputCol="cat"),
+                           fit_table=_num_table()))
+    reg(lambda: TestObject(
+        TextPreprocessor(inputCol="text", outputCol="text2",
+                         map={"quick": "slow"}),
+        transform_table=_num_table()))
+    reg(lambda: TestObject(Timer(stage=ClassBalancer(inputCol="cat")),
+                           fit_table=_num_table()))
+    reg(lambda: TestObject(CheckpointData(), transform_table=_num_table()))
+
+    # data prep
+    reg(lambda: TestObject(ValueIndexer(inputCol="cat", outputCol="ci"),
+                           fit_table=_num_table()))
+    reg(lambda: TestObject(
+        CleanMissingData(inputCols=["num"], outputCols=["numc"]),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(DataConversion(cols=["num"],
+                                          convertTo="float"),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(SummarizeData(),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(PartitionSample(mode="Head", count=5),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(EnsembleByKey(keys=["cat"], cols=["num"]),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(
+        MultiColumnAdapter(baseStage=Tokenizer(), inputCols=["text"],
+                           outputCols=["text_toks"]),
+        fit_table=_num_table()))
+
+    # text
+    reg(lambda: TestObject(Tokenizer(inputCol="text", outputCol="tk"),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(
+        StopWordsRemover(inputCol="toks", outputCol="ns"),
+        transform_table=_num_table()))
+    reg(lambda: TestObject(NGram(inputCol="toks", outputCol="ng"),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(
+        HashingTF(inputCol="toks", outputCol="tf", numFeatures=16),
+        transform_table=_num_table()))
+    reg(lambda: TestObject(
+        CountVectorizer(inputCol="toks", outputCol="cv"),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(
+        IDF(inputCol="features", outputCol="idf"),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(
+        TextFeaturizer(inputCol="text", outputCol="tfeat",
+                       numFeatures=32),
+        fit_table=_num_table()))
+
+    # image
+    reg(lambda: TestObject(
+        ImageTransformer(inputCol="image", outputCol="image").resize(8, 8),
+        transform_table=_img_table()))
+    reg(lambda: TestObject(UnrollImage(inputCol="image"),
+                           transform_table=_img_table()))
+    reg(lambda: TestObject(ImageSetAugmenter(inputCol="image"),
+                           transform_table=_img_table()))
+    reg(lambda: TestObject(
+        ImageFeaturizer(networkSpec=_CONV_SPEC,
+                        weights=_conv_weights(), inputHeight=16,
+                        inputWidth=16, cutOutputLayers=1),
+        transform_table=_img_table(), tol=1e-3))
+
+    # minibatch
+    reg(lambda: TestObject(FixedMiniBatchTransformer(batchSize=4),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(DynamicMiniBatchTransformer(),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(TimeIntervalMiniBatchTransformer(),
+                           transform_table=_num_table()))
+    reg(lambda: TestObject(
+        FlattenBatch(),
+        transform_table=FixedMiniBatchTransformer(batchSize=4).transform(
+            _num_table())))
+
+    # http parsers (no network needed)
+    reg(lambda: TestObject(
+        JSONInputParser(url="http://example.invalid", inputCol="num",
+                        outputCol="req"),
+        transform_table=_num_table()))
+    reg(lambda: TestObject(
+        CustomInputParser(inputCol="num", outputCol="req",
+                          udf=_req_from_value),
+        transform_table=_num_table()))
+    reg(lambda: TestObject(
+        JSONOutputParser(inputCol="resp", outputCol="out"),
+        transform_table=_resp_table()))
+    reg(lambda: TestObject(
+        CustomOutputParser(inputCol="resp", outputCol="out",
+                           udf=_resp_to_code),
+        transform_table=_resp_table()))
+
+    # ML estimators
+    reg(lambda: TestObject(
+        TPUBoostClassifier(numIterations=3, minDataInLeaf=2),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(
+        TPUBoostRegressor(numIterations=3, minDataInLeaf=2,
+                          labelCol="num"),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(TPULogisticRegression(maxIter=20),
+                           fit_table=_num_table()))
+    reg(lambda: TestObject(
+        TPULinearRegression(maxIter=20, labelCol="num"),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(
+        TPULearner(networkSpec={"type": "mlp", "features": [8],
+                                "num_classes": 2},
+                   epochs=1, batchSize=8, computeDtype="float32",
+                   checkpointDir=""),
+        fit_table=_num_table(), tol=1e-2))
+
+    # automl
+    reg(lambda: TestObject(Featurize(featureColumns=["num", "cat"]),
+                           fit_table=_num_table()))
+    reg(lambda: TestObject(
+        AssembleFeatures(columnsToFeaturize=["num", "cat"]),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(
+        TrainClassifier(labelCol="label",
+                        featureColumns=["num", "cat"],
+                        model=TPUBoostClassifier(numIterations=3,
+                                                 minDataInLeaf=2)),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(
+        TrainRegressor(labelCol="num", featureColumns=["features"],
+                       model=TPUBoostRegressor(numIterations=3,
+                                               minDataInLeaf=2)),
+        fit_table=_num_table()))
+    reg(lambda: TestObject(ComputeModelStatistics(
+        evaluationMetric="regression", scoresCol="num",
+        labelCol="num"), transform_table=_num_table()))
+    reg(lambda: TestObject(ComputePerInstanceStatistics(
+        evaluationMetric="regression", scoresCol="num",
+        labelCol="num"), transform_table=_num_table()))
+    reg(lambda: TestObject(
+        TuneHyperparameters(
+            models=[TPUBoostClassifier(numIterations=2,
+                                       minDataInLeaf=2)],
+            paramSpace=GridSpace(
+                HyperparamBuilder().add_hyperparam(
+                    "numLeaves", DiscreteHyperParam([4])).build()),
+            numFolds=2, parallelism=1),
+        fit_table=_num_table(), skip_serialization=True))
+    reg(lambda: TestObject(
+        FindBestModel(models=[
+            TPUBoostClassifier(numIterations=2, minDataInLeaf=2).fit(
+                _num_table())]),
+        fit_table=_num_table(), skip_serialization=True))
+
+
+_CONV_SPEC = {"type": "convnet", "conv_features": [4],
+              "dense_features": [8], "num_classes": 2}
+
+
+def _conv_weights():
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.networks import build_network
+    mod = build_network(_CONV_SPEC)
+    return mod.init(jax.random.PRNGKey(0), jnp.zeros((1, 16, 16, 3)))
+
+
+def _resp_table():
+    from mmlspark_tpu.io.http import HTTPSchema
+    return DataTable({"resp": [
+        HTTPSchema.response(200, "OK", b'{"a": 1}'),
+        HTTPSchema.response(500, "ERR", None)]})
+
+
+_register_all()
+
+
+# ---------------------------------------------------------------------------
+# the coverage test itself (ref: FuzzingTest.scala assertions)
+# ---------------------------------------------------------------------------
+
+
+def test_every_stage_has_fuzzer_or_exemption():
+    missing = []
+    for name, cls in sorted(STAGE_REGISTRY.items()):
+        if name in EXEMPT:
+            continue
+        if issubclass(cls, Model) and name not in FUZZING_REGISTRY:
+            continue  # MODEL_EXEMPT_REASON
+        if name not in FUZZING_REGISTRY:
+            missing.append(name)
+    assert not missing, (
+        f"stages without TestObjects (add one in tests/test_fuzzing.py "
+        f"or document an exemption): {missing}")
+
+
+def test_exemptions_are_not_stale():
+    stale = [n for n in EXEMPT if n not in STAGE_REGISTRY]
+    assert not stale, f"exempted stages no longer exist: {stale}"
+
+
+def _all_objects():
+    for name, factories in sorted(FUZZING_REGISTRY.items()):
+        for i, f in enumerate(factories):
+            yield pytest.param(f, id=f"{name}_{i}")
+
+
+@pytest.mark.parametrize("factory", list(_all_objects()))
+def test_experiment_fuzzing(factory):
+    run_experiment_fuzzing(factory())
+
+
+@pytest.mark.parametrize("factory", list(_all_objects()))
+def test_serialization_fuzzing(factory):
+    obj = factory()
+    if obj.skip_serialization:
+        pytest.skip("TestObject opted out of serialization fuzzing")
+    run_serialization_fuzzing(obj)
+
+
+@pytest.mark.parametrize("factory", list(_all_objects()))
+def test_schema_fuzzing(factory):
+    run_schema_fuzzing(factory())

@@ -1,0 +1,226 @@
+"""HTTP client + serving tests.
+
+Reference strategy: HTTPSuite / DistributedHTTPSuite start real servers
+and POST to them (ref: SURVEY.md §4 "Streaming/serving tests"); we do the
+same with the threaded serving engine.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.io.http import (
+    CustomInputParser, CustomOutputParser, HTTPSchema, HTTPTransformer,
+    JSONInputParser, JSONOutputParser, SimpleHTTPTransformer,
+)
+from mmlspark_tpu.io.minibatch import (
+    DynamicMiniBatchTransformer, FixedMiniBatchTransformer, FlattenBatch,
+    TimeIntervalMiniBatchTransformer,
+)
+from mmlspark_tpu.serving import (
+    HTTPSource, ServingEngine, SharedSingleton, SharedVariable, serve_model,
+)
+from mmlspark_tpu.stages.basic import Lambda
+
+
+@pytest.fixture(scope="module")
+def echo_server():
+    """A serving engine that echoes {'x': v} -> {'doubled': 2v}."""
+    def handle(table):
+        replies = []
+        for req in table["request"]:
+            body = json.loads(req["entity"].decode())
+            replies.append({"doubled": body["x"] * 2})
+        return table.with_column("reply", replies)
+
+    engine = serve_model(Lambda.apply(handle), port=18950, batch_size=8)
+    yield engine
+    engine.stop()
+
+
+def _post(addr, payload, timeout=10):
+    req = urllib.request.Request(
+        addr, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestServing:
+    def test_single_request(self, echo_server):
+        status, body = _post(echo_server.source.address, {"x": 21})
+        assert status == 200
+        assert body == {"doubled": 42}
+
+    def test_concurrent_requests_route_correctly(self, echo_server):
+        results = {}
+        def client(i):
+            _, body = _post(echo_server.source.address, {"x": i})
+            results[i] = body["doubled"]
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: 2 * i for i in range(24)}
+
+    def test_counters(self, echo_server):
+        before = echo_server.source.requests_answered
+        _post(echo_server.source.address, {"x": 1})
+        assert echo_server.source.requests_answered == before + 1
+
+    def test_pipeline_error_returns_500(self):
+        def boom(table):
+            raise RuntimeError("kaboom")
+        engine = serve_model(Lambda.apply(boom), port=18980, batch_size=4)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                _post(engine.source.address, {"x": 1})
+            assert exc_info.value.code == 500
+        finally:
+            engine.stop()
+
+    def test_port_scan_on_conflict(self, echo_server):
+        # same base port: must scan to the next free one
+        src2 = HTTPSource(port=echo_server.source.port)
+        try:
+            assert src2.port != echo_server.source.port
+        finally:
+            src2.close()
+
+    def test_shared_variable_and_singleton(self):
+        calls = []
+        sv = SharedVariable(lambda: calls.append(1) or "v")
+        assert sv.get() == "v" and sv.get() == "v"
+        assert len(calls) == 1
+        a = SharedSingleton.get_or_create("k1", lambda: object())
+        b = SharedSingleton.get_or_create("k1", lambda: object())
+        assert a is b
+
+
+class TestHTTPClient:
+    def test_http_transformer_roundtrip(self, echo_server):
+        addr = echo_server.source.address
+        reqs = [HTTPSchema.request(
+            addr, "POST", json.dumps({"x": v}).encode(),
+            {"Content-Type": "application/json"}) for v in (3, 4)]
+        t = DataTable({"req": reqs})
+        out = HTTPTransformer(inputCol="req", outputCol="resp",
+                              concurrency=2).transform(t)
+        bodies = [json.loads(r["entity"]) for r in out["resp"]]
+        assert bodies == [{"doubled": 6}, {"doubled": 8}]
+
+    def test_connection_error_becomes_row(self):
+        t = DataTable({"req": [HTTPSchema.request(
+            "http://127.0.0.1:1/nothing", "POST", b"{}")]})
+        out = HTTPTransformer(inputCol="req", outputCol="resp",
+                              handlingStrategy="basic").transform(t)
+        assert out["resp"][0]["statusLine"]["statusCode"] == 0
+
+    def test_simple_http_transformer(self, echo_server):
+        t = DataTable({"x": [{"x": 1}, {"x": 2}]})
+        out = SimpleHTTPTransformer(
+            inputCol="x", outputCol="parsed",
+            url=echo_server.source.address).transform(t)
+        assert list(out["parsed"]) == [{"doubled": 2}, {"doubled": 4}]
+        assert all(e is None for e in out["HTTPTransformer_errors"])
+
+    def test_simple_http_transformer_error_col(self):
+        t = DataTable({"x": [{"x": 1}]})
+        out = SimpleHTTPTransformer(
+            inputCol="x", outputCol="parsed", timeout=2.0,
+            url="http://127.0.0.1:1/none").transform(t)
+        assert out["HTTPTransformer_errors"][0] is not None
+
+    def test_custom_parsers(self, echo_server):
+        addr = echo_server.source.address
+        t = DataTable({"x": [7.0]})
+        inp = CustomInputParser(udf=lambda v: HTTPSchema.request(
+            addr, "POST", json.dumps({"x": v}).encode(),
+            {"Content-Type": "application/json"}))
+        outp = CustomOutputParser(
+            udf=lambda r: json.loads(r["entity"])["doubled"])
+        out = SimpleHTTPTransformer(
+            inputCol="x", outputCol="y", inputParser=inp,
+            outputParser=outp).transform(t)
+        assert out["y"][0] == 14.0
+
+    def test_json_parsers_standalone(self):
+        t = DataTable({"v": [{"a": 1}]})
+        reqs = JSONInputParser(url="http://example.invalid",
+                               inputCol="v",
+                               outputCol="req").transform(t)
+        assert json.loads(reqs["req"][0]["entity"]) == {"a": 1}
+        resp_t = DataTable({"resp": [HTTPSchema.response(
+            200, "OK", b'{"b": 2}')]})
+        parsed = JSONOutputParser(inputCol="resp",
+                                  outputCol="out").transform(resp_t)
+        assert parsed["out"][0] == {"b": 2}
+
+
+class TestMiniBatch:
+    def test_fixed_roundtrip(self):
+        t = DataTable({"a": np.arange(7).astype(float),
+                       "s": [f"r{i}" for i in range(7)]})
+        batched = FixedMiniBatchTransformer(batchSize=3).transform(t)
+        assert len(batched) == 3
+        assert [len(b) for b in batched["a"]] == [3, 3, 1]
+        flat = FlattenBatch().transform(batched)
+        np.testing.assert_allclose(list(flat["a"]),
+                                   np.arange(7).astype(float))
+        assert list(flat["s"]) == [f"r{i}" for i in range(7)]
+
+    def test_dynamic_respects_shards(self):
+        t = DataTable({"a": np.arange(8).astype(float)}).repartition(4)
+        batched = DynamicMiniBatchTransformer().transform(t)
+        assert len(batched) == 4
+
+    def test_time_interval_windows(self):
+        t = DataTable({"ts": np.asarray([0, 10, 2000, 2010, 9000]),
+                       "v": np.arange(5).astype(float)})
+        batched = TimeIntervalMiniBatchTransformer(
+            millisToWait=500, timestampCol="ts").transform(t)
+        assert [len(b) for b in batched["v"]] == [2, 2, 1]
+
+    def test_flatten_broadcasts_scalar_columns(self):
+        # regression: a per-batch scalar (e.g. error struct) must be
+        # broadcast to every exploded row, not erased to None
+        t = DataTable({"vals": [[1.0, 2.0], [3.0]],
+                       "err": ["batch0_err", None]})
+        flat = FlattenBatch().transform(t)
+        assert list(flat["err"]) == ["batch0_err", "batch0_err", None]
+
+    def test_batched_simple_http_keeps_errors(self):
+        from mmlspark_tpu.io.http import SimpleHTTPTransformer
+        from mmlspark_tpu.io.minibatch import FixedMiniBatchTransformer
+        t = DataTable({"x": [{"x": 1}, {"x": 2}]})
+        sh = SimpleHTTPTransformer(
+            inputCol="x", outputCol="parsed", timeout=2.0,
+            url="http://127.0.0.1:1/none")
+        sh.set_mini_batcher(FixedMiniBatchTransformer(batchSize=2))
+        out = sh.transform(t)
+        # every flattened row must carry the batch's error
+        assert all(e is not None for e in out["HTTPTransformer_errors"])
+
+    def test_api_path_routing(self):
+        src = HTTPSource(port=19040, api_path="/score")
+        try:
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(f"http://127.0.0.1:{src.port}/other", {"x": 1},
+                      timeout=5)
+            assert ei.value.code == 404
+        finally:
+            src.close()
+
+    def test_flatten_empty(self):
+        t = DataTable({"a": np.asarray([]), "b": []})
+        batched = FixedMiniBatchTransformer(batchSize=2).transform(t)
+        flat = FlattenBatch().transform(batched)
+        assert len(flat) == 0
+        assert "a" in flat.column_names
