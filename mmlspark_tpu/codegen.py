@@ -91,6 +91,11 @@ def stage_manifest() -> Dict[str, Any]:
     for name, cls in sorted(load_all_stages().items()):
         if name in ("Transformer", "Estimator", "Model"):
             continue
+        # only the framework's own stages — user/test-defined subclasses
+        # register too (for load-time resolution) but aren't part of the
+        # generated API surface (the reference scans only its own jars)
+        if not cls.__module__.startswith("mmlspark_tpu."):
+            continue
         stages[name] = {
             "kind": stage_kind(cls),
             "module": cls.__module__,

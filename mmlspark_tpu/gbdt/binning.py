@@ -54,8 +54,20 @@ class BinMapper:
         return BinMapper(bounds, max_bin)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
-        """Raw features -> int32 bin indices, shape (N, F)."""
+        """Raw features -> int32 bin indices, shape (N, F).
+
+        Uses the native OpenMP binning kernel when available (the
+        LightGBM dataset-construction analog, native/mml_native.cpp
+        mml_apply_bins), falling back to vectorized numpy."""
         X = np.asarray(X, dtype=np.float64)
+        try:
+            from mmlspark_tpu.native import loader as native
+            if native.available():
+                out = native.apply_bins(X, self.upper_bounds)
+                if out is not None:
+                    return out
+        except Exception:  # noqa: BLE001 — native is only an accelerator
+            pass
         out = np.empty(X.shape, dtype=np.int32)
         for j, ub in enumerate(self.upper_bounds):
             col = X[:, j]

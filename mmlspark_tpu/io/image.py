@@ -24,7 +24,19 @@ IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif",
 
 def decode_image(data: bytes) -> Optional[np.ndarray]:
     """bytes -> BGR HWC uint8 array, or None on failure
-    (ref: Image.scala:47-75 decode semantics: undecodable -> null row)."""
+    (ref: Image.scala:47-75 decode semantics: undecodable -> null row).
+
+    Decode order: our native C++ codec (libjpeg/libpng via
+    native/mml_native.cpp — the OpenCV-imgcodecs analog), then cv2, then
+    PIL."""
+    try:
+        from mmlspark_tpu.native import loader as native
+        if native.available():
+            rgb = native.decode_image(data)
+            if rgb is not None:
+                return rgb[:, :, ::-1].copy()  # RGB -> BGR convention
+    except Exception:  # noqa: BLE001 — never let native break decode
+        pass
     try:
         import cv2
         arr = np.frombuffer(data, dtype=np.uint8)

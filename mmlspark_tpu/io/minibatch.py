@@ -91,6 +91,8 @@ class TimeIntervalMiniBatchTransformer(Transformer):
         if ts_col is None or ts_col not in table:
             bounds = [0, n] if n else [0]
             return _batch_rows(table, bounds)
+        if n == 0:
+            return _batch_rows(table, [0])
         ts = np.asarray(table[ts_col], dtype=np.int64)
         order = np.argsort(ts, kind="stable")
         sorted_t = table._take_indices(order)

@@ -1,0 +1,266 @@
+// Native host-side runtime ops.
+//
+// The reference ships its hot host-side paths as prebuilt C++ inside jars
+// (OpenCV imgcodecs/imgproc for image decode+transform, LightGBM's dataset
+// binning — loaded through NativeLoader,
+// ref: src/core/env/src/main/scala/NativeLoader.java:28). This library is
+// the TPU build's equivalent: the host data path (image decode, resize,
+// layout unroll, feature binning) runs native, while all FLOP-heavy math
+// stays in XLA on the TPU.
+//
+// C ABI only — consumed via ctypes (no pybind11 in the image).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>  // jpeglib.h needs FILE declared first
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+#include <png.h>
+#include <csetjmp>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// memory
+// ---------------------------------------------------------------------------
+
+void mml_free(void* p) { std::free(p); }
+
+// ---------------------------------------------------------------------------
+// image decode (OpenCV imgcodecs analog)
+// ---------------------------------------------------------------------------
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+static void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// decode JPEG bytes -> RGB8 buffer (caller frees with mml_free)
+static int decode_jpeg(const uint8_t* data, int len, uint8_t** out,
+                       int* h, int* w, int* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  uint8_t* buf = nullptr;  // declared before setjmp so the error path
+                           // can free a buffer allocated mid-decode
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jump)) {
+    std::free(buf);
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int H = cinfo.output_height, W = cinfo.output_width;
+  const int C = cinfo.output_components;  // 3 for JCS_RGB
+  buf = static_cast<uint8_t*>(
+      std::malloc(static_cast<size_t>(H) * W * C));
+  if (!buf) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t* row = buf + static_cast<size_t>(cinfo.output_scanline) * W * C;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  *out = buf;
+  *h = H;
+  *w = W;
+  *c = C;
+  return 0;
+}
+
+// decode PNG bytes -> RGB8 (libpng simplified API)
+static int decode_png(const uint8_t* data, int len, uint8_t** out,
+                      int* h, int* w, int* c) {
+  png_image image;
+  std::memset(&image, 0, sizeof(image));
+  image.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&image, data,
+                                        static_cast<size_t>(len))) {
+    return -1;
+  }
+  image.format = PNG_FORMAT_RGB;
+  const size_t size = PNG_IMAGE_SIZE(image);
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(size));
+  if (!buf) {
+    png_image_free(&image);
+    return -2;
+  }
+  if (!png_image_finish_read(&image, nullptr, buf, 0, nullptr)) {
+    std::free(buf);
+    png_image_free(&image);
+    return -1;
+  }
+  *out = buf;
+  *h = static_cast<int>(image.height);
+  *w = static_cast<int>(image.width);
+  *c = 3;
+  return 0;
+}
+
+// sniff magic bytes, decode jpeg/png -> RGB8
+int mml_decode_image(const uint8_t* data, int len, uint8_t** out,
+                     int* h, int* w, int* c) {
+  if (len >= 3 && data[0] == 0xFF && data[1] == 0xD8 && data[2] == 0xFF) {
+    return decode_jpeg(data, len, out, h, w, c);
+  }
+  if (len >= 8 && data[0] == 0x89 && data[1] == 'P' && data[2] == 'N' &&
+      data[3] == 'G') {
+    return decode_png(data, len, out, h, w, c);
+  }
+  return -3;  // unknown format
+}
+
+// ---------------------------------------------------------------------------
+// image transforms (OpenCV imgproc analog; uint8 HWC buffers)
+// ---------------------------------------------------------------------------
+
+// Separable antialiased triangle-kernel resize, matching
+// jax.image.resize(method="bilinear", antialias=True) so the native host
+// path and the XLA device path produce identical pixels
+// (ops/image_ops.resize_host uses jax.image.resize).
+int mml_resize_bilinear_u8(const uint8_t* src, int h, int w, int c,
+                           uint8_t* dst, int oh, int ow) {
+  if (h <= 0 || w <= 0 || oh <= 0 || ow <= 0 || c <= 0) return -1;
+  const long n_in = static_cast<long>(h) * w * c;
+  double* f64 = static_cast<double*>(std::malloc(sizeof(double) * n_in));
+  double* mid = static_cast<double*>(
+      std::malloc(sizeof(double) * static_cast<long>(oh) * w * c));
+  double* out = static_cast<double*>(
+      std::malloc(sizeof(double) * static_cast<long>(oh) * ow * c));
+  if (!f64 || !mid || !out) {
+    std::free(f64);
+    std::free(mid);
+    std::free(out);
+    return -2;
+  }
+  for (long i = 0; i < n_in; ++i) f64[i] = src[i];
+
+  // pass 1: H -> OH. Treat src as [h][w*c]; vertical stride = w*c.
+  {
+    const double scale = static_cast<double>(h) / oh;
+    const double s = std::max(scale, 1.0);
+    const long row = static_cast<long>(w) * c;
+    for (int y = 0; y < oh; ++y) {
+      const double center = (y + 0.5) * scale - 0.5;
+      const int lo = static_cast<int>(std::ceil(center - s));
+      const int hi = static_cast<int>(std::floor(center + s));
+      // jax.image.resize drops out-of-range taps and renormalizes
+      // over the in-range weight sum (no edge clamping)
+      double wsum = 0.0;
+      std::vector<double> wgt(hi - lo + 1);
+      for (size_t j = 0; j < wgt.size(); ++j) {
+        const int idx = lo + static_cast<int>(j);
+        const double t = std::abs((idx - center) / s);
+        wgt[j] = (idx >= 0 && idx < h && t < 1.0) ? 1.0 - t : 0.0;
+        wsum += wgt[j];
+      }
+      for (long x = 0; x < row; ++x) {
+        double acc = 0.0;
+        for (size_t j = 0; j < wgt.size(); ++j) {
+          if (wgt[j] == 0.0) continue;
+          const int idx = lo + static_cast<int>(j);
+          acc += wgt[j] * f64[static_cast<long>(idx) * row + x];
+        }
+        mid[static_cast<long>(y) * row + x] = acc / wsum;
+      }
+    }
+  }
+  // pass 2: W -> OW. mid is [oh][w][c].
+  {
+    const double scale = static_cast<double>(w) / ow;
+    const double s = std::max(scale, 1.0);
+    for (int x = 0; x < ow; ++x) {
+      const double center = (x + 0.5) * scale - 0.5;
+      const int lo = static_cast<int>(std::ceil(center - s));
+      const int hi = static_cast<int>(std::floor(center + s));
+      double wsum = 0.0;
+      std::vector<double> wgt(hi - lo + 1);
+      for (size_t j = 0; j < wgt.size(); ++j) {
+        const int idx = lo + static_cast<int>(j);
+        const double t = std::abs((idx - center) / s);
+        wgt[j] = (idx >= 0 && idx < w && t < 1.0) ? 1.0 - t : 0.0;
+        wsum += wgt[j];
+      }
+      for (int y = 0; y < oh; ++y) {
+        for (int ch = 0; ch < c; ++ch) {
+          double acc = 0.0;
+          for (size_t j = 0; j < wgt.size(); ++j) {
+            if (wgt[j] == 0.0) continue;
+            const int idx = lo + static_cast<int>(j);
+            acc += wgt[j] *
+                   mid[(static_cast<long>(y) * w + idx) * c + ch];
+          }
+          out[(static_cast<long>(y) * ow + x) * c + ch] = acc / wsum;
+        }
+      }
+    }
+  }
+  const long n_out = static_cast<long>(oh) * ow * c;
+  for (long i = 0; i < n_out; ++i) {
+    dst[i] = static_cast<uint8_t>(
+        std::lround(std::min(255.0, std::max(0.0, out[i]))));
+  }
+  std::free(f64);
+  std::free(mid);
+  std::free(out);
+  return 0;
+}
+
+// HWC uint8 -> CHW float64 unroll (UnrollImage hot path,
+// ref: UnrollImage.scala:18-43; matches
+// ops/image_ops.unroll_host's transpose(2,0,1).ravel() order)
+int mml_unroll_chw(const uint8_t* src, int h, int w, int c, double* dst) {
+  size_t i = 0;
+  for (int ch = 0; ch < c; ++ch)
+    for (int y = 0; y < h; ++y)
+      for (int x = 0; x < w; ++x)
+        dst[i++] = src[(static_cast<size_t>(y) * w + x) * c + ch];
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// GBDT host binning (LightGBM dataset-construction analog)
+// ---------------------------------------------------------------------------
+
+// per-feature searchsorted: bounds is the concatenation of each feature's
+// ascending boundaries; offsets[f]..offsets[f+1] delimit feature f.
+// NaN maps to bin 0, matching gbdt/binning.py.
+int mml_apply_bins(const double* X, long n, int f, const double* bounds,
+                   const long* offsets, int32_t* out) {
+#pragma omp parallel for schedule(static)
+  for (long i = 0; i < n; ++i) {
+    for (int j = 0; j < f; ++j) {
+      const double v = X[i * f + j];
+      const double* lo = bounds + offsets[j];
+      const double* hi = bounds + offsets[j + 1];
+      if (std::isnan(v)) {
+        out[i * f + j] = 0;
+        continue;
+      }
+      out[i * f + j] =
+          static_cast<int32_t>(std::lower_bound(lo, hi, v) - lo);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -28,10 +28,21 @@ import jax.numpy as jnp
 
 
 def resize_host(img: np.ndarray, height: int, width: int) -> np.ndarray:
-    """Bilinear resize. Uses the same jax.image.resize as the batched
-    device path so host and device pipelines produce identical pixels."""
+    """Bilinear resize. uint8 images take the native C++ kernel (bit-
+    matched to jax.image.resize's antialiased triangle filter, see
+    native/mml_native.cpp); other dtypes use jax.image.resize itself, so
+    host and device pipelines produce identical pixels either way."""
     if img.ndim == 2:
         img = img[:, :, None]
+    if img.dtype == np.uint8:
+        try:
+            from mmlspark_tpu.native import loader as native
+            if native.available():
+                out = native.resize_u8(img, height, width)
+                if out is not None:
+                    return out
+        except Exception:  # noqa: BLE001 — native is only an accelerator
+            pass
     arr = jax.image.resize(
         jnp.asarray(img, jnp.float32), (height, width, img.shape[2]),
         method="bilinear")
@@ -258,9 +269,19 @@ def threshold_batch(imgs: jnp.ndarray, threshold: float, max_val: float,
 
 
 def unroll_host(img: np.ndarray) -> np.ndarray:
-    """HWC uint8 -> CHW-flattened float64 vector, reference byte order."""
+    """HWC uint8 -> CHW-flattened float64 vector, reference byte order.
+    Native fast path in native/mml_native.cpp (mml_unroll_chw)."""
     if img.ndim == 2:
         img = img[:, :, None]
+    if img.dtype == np.uint8:
+        try:
+            from mmlspark_tpu.native import loader as native
+            if native.available():
+                out = native.unroll_chw(img)
+                if out is not None:
+                    return out
+        except Exception:  # noqa: BLE001
+            pass
     return img.transpose(2, 0, 1).astype(np.float64).ravel()
 
 

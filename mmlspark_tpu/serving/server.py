@@ -110,10 +110,12 @@ class HTTPSource:
 
         class Handler(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 (http.server API)
-                source.requests_seen += 1
+                with source._lock:
+                    source.requests_seen += 1
+                path_only = self.path.split("?", 1)[0]
                 if source.api_path not in ("/", "") and \
-                        self.path.rstrip("/") != source.api_path.rstrip("/"):
-                    self.send_error(404, f"unknown path {self.path}")
+                        path_only.rstrip("/") != source.api_path.rstrip("/"):
+                    self.send_error(404, f"unknown path {path_only}")
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
@@ -125,7 +127,8 @@ class HTTPSource:
                     source._pending[parked.id] = parked
                 try:
                     source.queue.put_nowait(parked)
-                    source.requests_accepted += 1
+                    with source._lock:
+                        source.requests_accepted += 1
                 except queue.Full:
                     with source._lock:
                         source._pending.pop(parked.id, None)
@@ -147,7 +150,8 @@ class HTTPSource:
                 self.send_header("Content-Length", str(len(entity)))
                 self.end_headers()
                 self.wfile.write(entity)
-                source.requests_answered += 1
+                with source._lock:
+                    source.requests_answered += 1
 
             def log_message(self, *a):  # silence default stderr logging
                 pass

@@ -345,6 +345,8 @@ _register_all()
 def test_every_stage_has_fuzzer_or_exemption():
     missing = []
     for name, cls in sorted(STAGE_REGISTRY.items()):
+        if not cls.__module__.startswith("mmlspark_tpu."):
+            continue  # test-/user-defined stages aren't framework API
         if name in EXEMPT:
             continue
         if issubclass(cls, Model) and name not in FUZZING_REGISTRY:
