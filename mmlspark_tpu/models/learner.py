@@ -229,7 +229,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
 
         rng = jax.random.PRNGKey(self.get("seed"))
         sample_in = jnp.asarray(x[:1])
-        if module.__class__.__name__ == "BiLSTMTagger":
+        if getattr(module, "int_input", False):
             sample_in = sample_in.astype(jnp.int32)
         variables = module.init(rng, sample_in, train=False)
         params = variables["params"]
@@ -265,7 +265,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
         }
 
         loss_kind = self.get("loss")
-        is_int_input = module.__class__.__name__ == "BiLSTMTagger"
+        is_int_input = bool(getattr(module, "int_input", False))
         dropout_seed = self.get("seed") + 1
 
         def train_step(st, batch):

@@ -157,6 +157,8 @@ class BiLSTMTagger(nn.Module):
     compiles to a single fused loop on TPU.
     """
 
+    int_input = True  # consumes token ids, not float features
+
     vocab_size: int = 10000
     embed_dim: int = 128
     hidden: int = 128
@@ -180,6 +182,118 @@ class BiLSTMTagger(nn.Module):
         return ["lstm"]
 
 
+class TransformerBlock(nn.Module):
+    """Pre-LN decoder block; attention is pluggable so the same weights
+    run dense (single chip) or ring/Ulysses (seq-sharded under
+    shard_map via ``seq_axis``)."""
+
+    dim: int
+    heads: int
+    mlp_ratio: int = 4
+    causal: bool = True
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from mmlspark_tpu.parallel import ring_attention as ra
+        b, l, _ = x.shape
+        h = self.heads
+        hd = self.dim // h
+        y = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, l, h, hd)
+        k = k.reshape(b, l, h, hd)
+        v = v.reshape(b, l, h, hd)
+        if self.seq_axis is not None:
+            fn = (ra.ring_attention if self.seq_impl == "ring"
+                  else ra.ulysses_attention)
+            attn = fn(q, k, v, axis_name=self.seq_axis, causal=self.causal)
+        else:
+            attn = ra.attention(q, k, v, causal=self.causal)
+        attn = attn.reshape(b, l, self.dim)
+        x = x + nn.Dense(self.dim, dtype=self.dtype, name="proj")(attn)
+        y = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        y = nn.Dense(self.mlp_ratio * self.dim, dtype=self.dtype,
+                     name="mlp_up")(y)
+        y = nn.gelu(y)
+        x = x + nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(y)
+        return x
+
+
+class Transformer(nn.Module):
+    """Decoder-only transformer LM / sequence classifier.
+
+    Long-context first-class: set ``seq_axis`` and apply under shard_map
+    with the sequence dimension sharded on that mesh axis — attention
+    runs as ring (ppermute) or Ulysses (all_to_all) collectives and the
+    positional embedding uses each shard's global offset.
+    """
+
+    int_input = True  # consumes token ids, not float features
+
+    vocab_size: int = 32000
+    dim: int = 256
+    depth: int = 4
+    heads: int = 8
+    max_len: int = 2048
+    num_classes: int = 0     # 0 -> LM head over vocab
+    causal: bool = True
+    seq_axis: Optional[str] = None
+    seq_impl: str = "ring"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False,
+                 capture: Optional[str] = None):
+        from jax import lax as _lax
+        b, l = tokens.shape
+        x = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype,
+                     name="embed")(tokens)
+        pos_table = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_len, self.dim))
+        if self.seq_axis is not None:
+            n_shards = _lax.psum(1, self.seq_axis)  # static under shard_map
+            if n_shards * l > self.max_len:
+                raise ValueError(
+                    f"global sequence {n_shards * l} exceeds "
+                    f"max_len={self.max_len} (dynamic_slice would "
+                    f"silently clamp positional embeddings)")
+            offset = _lax.axis_index(self.seq_axis) * l
+            pos = _lax.dynamic_slice_in_dim(pos_table, offset, l, axis=0)
+        else:
+            if l > self.max_len:
+                raise ValueError(
+                    f"sequence {l} exceeds max_len={self.max_len}")
+            pos = pos_table[:l]
+        x = x + pos[None].astype(self.dtype)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                self.dim, self.heads, causal=self.causal,
+                seq_axis=self.seq_axis, seq_impl=self.seq_impl,
+                dtype=self.dtype, name=f"block_{i}")(x)
+            if capture == f"block_{i}":
+                return x
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        if capture == "final":
+            return x
+        if self.num_classes > 0:
+            # classify from the mean token representation
+            pooled = jnp.mean(x, axis=1)
+            if self.seq_axis is not None:
+                pooled = _lax.pmean(pooled, self.seq_axis)
+            return nn.Dense(self.num_classes, dtype=jnp.float32,
+                            name="head")(pooled)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32,
+                        name="lm_head")(x)
+
+    def feature_layers(self) -> List[str]:
+        return [f"block_{i}" for i in range(self.depth)] + ["final"]
+
+
 # ---------------------------------------------------------------------------
 # registry + spec construction (BrainScriptBuilder analog)
 # ---------------------------------------------------------------------------
@@ -189,6 +303,7 @@ NETWORK_REGISTRY: Dict[str, Callable[..., nn.Module]] = {
     "convnet": ConvNet,
     "resnet": ResNet,
     "bilstm": BiLSTMTagger,
+    "transformer": Transformer,
 }
 
 

@@ -1,0 +1,264 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no long-context machinery (ref: SURVEY.md §5
+"Long-context / sequence parallelism: absent"), but this framework treats
+it as first-class: sequences too long for one chip's HBM shard over the
+mesh ``seq`` axis and attention runs as a collective program.
+
+Two standard schemes, both built on XLA collectives inside ``shard_map``
+(scaling-book style — annotate shardings, let XLA move bytes over ICI):
+
+- **Ring attention** (blockwise + ppermute): each device holds a Q shard
+  and streams K/V shards around the ring, accumulating exact softmax
+  online (flash-attention statistics m/l/o). Comm is overlapped by XLA;
+  memory is O(L/n) per device.
+- **Ulysses** (all-to-all): scatter heads / gather sequence, run full
+  attention on each device's head subset, all-to-all back. Best when
+  heads >= devices.
+
+Pure-JAX reference implementations; the blockwise inner product is MXU
+matmuls already, so XLA fuses each ring step into one kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    # q: (B, Lq, H, D), k: (B, Lk, H, D) -> (B, H, Lq, Lk)
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _online_update(m_prev, l_prev, o_prev, s, v):
+    """Online-softmax accumulation of one K/V block.
+
+    m/l: (B, H, Lq); o: (B, Lq, H, D); s: (B, H, Lq, Lk); v: (B, Lk, H, D).
+    """
+    m_blk = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_blk)
+    # renormalize previous accumulators
+    corr = jnp.exp(m_prev - m_new)                     # (B, H, Lq)
+    p = jnp.exp(s - m_new[..., None])                  # (B, H, Lq, Lk)
+    l_new = l_prev * corr + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                    preferred_element_type=jnp.float32)
+    o_new = o_prev * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def _finalize(m, l, o):
+    l_safe = jnp.where(l > 0, l, 1.0)
+    return o / l_safe.transpose(0, 2, 1)[..., None]
+
+
+def attention(q, k, v, causal: bool = False,
+              q_offset: int = 0, k_offset: int = 0) -> jnp.ndarray:
+    """Plain (single-device) attention, the numerics reference.
+
+    q (B, Lq, H, D); k/v (B, Lk, H, D). Offsets give global positions for
+    causal masking of sequence shards."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = _block_scores(q.astype(jnp.float32), k.astype(jnp.float32), scale)
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False
+                   ) -> jnp.ndarray:
+    """Exact attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside shard_map with ``axis_name`` in the mesh. Each
+    device holds (B, L_local, H, D) shards of q/k/v in sequence order
+    (shard i = positions [i*L_local, (i+1)*L_local)). K/V blocks rotate
+    around the ring via ppermute; softmax is accumulated online so the
+    result is bitwise-independent of the ring schedule up to float
+    reassociation.
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+
+    qf = q.astype(jnp.float32)
+    q_pos = my * lq + jnp.arange(lq)
+
+    def step(t, carry):
+        m, l, o, k_cur, v_cur = carry
+        src = (my - t) % n          # whose shard we hold at step t
+        s = _block_scores(qf, k_cur.astype(jnp.float32), scale)
+        if causal:
+            k_pos = src * lk + jnp.arange(lk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m, l, o = _online_update(m, l, o, s, v_cur.astype(jnp.float32))
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def rotate(kv):
+            return (lax.ppermute(kv[0], axis_name, perm),
+                    lax.ppermute(kv[1], axis_name, perm))
+
+        # the last step's blocks are never used again — skip that hop
+        k_nxt, v_nxt = lax.cond(t < n - 1, rotate, lambda kv: kv,
+                                (k_cur, v_cur))
+        return m, l, o, k_nxt, v_nxt
+
+    m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, lq), jnp.float32)
+    o0 = jnp.zeros((b, lq, h, d), jnp.float32)
+    m, l, o, _, _ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
+    return _finalize(m, l, o).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False
+                      ) -> jnp.ndarray:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses scheme).
+
+    Inside shard_map with sequence sharded on ``axis_name``: all_to_all
+    converts seq-sharded/head-full tensors to seq-full/head-sharded, runs
+    dense attention per head subset, and converts back. Requires
+    H % axis_size == 0.
+    """
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"heads {h} not divisible by axis size {n}")
+
+    def scatter_heads(x):
+        # (B, L/n, H, D) -> (B, L, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(x):
+        # (B, L, H/n, D) -> (B, L/n, H, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg = scatter_heads(q)
+    kg = scatter_heads(k)
+    vg = scatter_heads(v)
+    out = attention(qg, kg, vg, causal=causal)
+    return gather_heads(out)
+
+
+_SP_APPLY_CACHE: dict = {}
+
+
+def seq_parallel_apply(module, variables, tokens, mesh, axis: str = "seq"):
+    """Run a seq-axis-aware module (e.g. networks.Transformer with
+    ``seq_axis=axis``) over GLOBAL token ids, sharding the sequence
+    dimension across ``mesh``'s ``axis``. Weights are replicated; the
+    only cross-shard traffic is the attention collective itself.
+    The compiled program is cached per (module, mesh, axis), so repeated
+    calls hit the jit cache."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    key = (module, mesh, axis)
+    run = _SP_APPLY_CACHE.get(key)
+    if run is None:
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(), P(None, axis)),
+            out_specs=(P(None, axis) if module.num_classes == 0 else P()),
+            check_vma=False)
+        def run(vars_, toks):
+            return module.apply(vars_, toks)
+
+        _SP_APPLY_CACHE[key] = run
+    return run(variables, tokens)
+
+
+def make_seq_parallel_train_step(module, mesh, optimizer,
+                                 data_axis: str = "data",
+                                 seq_axis: str = "seq"):
+    """Build a jitted LM training step over a (data x seq) mesh.
+
+    ``module`` is a networks.Transformer with ``seq_axis=seq_axis``.
+    Encapsulates the SPMD autodiff discipline that makes gradients exact
+    under shard_map: the per-device loss is purely LOCAL (its implicit
+    sum across devices is the global mean — no psum/pmean inside the
+    differentiated function, whose transpose would double-count), and
+    the replicated parameter gradients are psum'd across both axes
+    afterwards. Verified bit-accurate against dense single-device
+    attention in tests/test_ring_attention.py.
+
+    Returns ``step(params, opt_state, tokens, targets) ->
+    (params, opt_state, loss)`` taking GLOBAL arrays; tokens/targets
+    (B, L) shard as (data, seq).
+    """
+    import optax
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    axes = (data_axis, seq_axis)
+
+    def local_loss(params, toks, tgts, n_global_tokens):
+        logits = module.apply(params, toks)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tgts)
+        return losses.sum() / n_global_tokens
+
+    def local_step(params, opt_state, toks, tgts, n_tok):
+        loss, grads = jax.value_and_grad(local_loss)(
+            params, toks, tgts, n_tok)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axes), grads)
+        loss = lax.psum(loss, axes)  # outside the grad: safe
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    mapped = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(P(), P(), P(data_axis, seq_axis),
+                  P(data_axis, seq_axis), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        n_tok = jnp.asarray(tokens.shape[0] * tokens.shape[1],
+                            jnp.float32)
+        return mapped(params, opt_state, tokens, targets, n_tok)
+
+    return step
+
+
+def make_seq_parallel_attention(mesh, kind: str = "ring",
+                                axis: str = "seq", causal: bool = True):
+    """Build a (q, k, v) -> out function that runs seq-parallel attention
+    over ``mesh``'s ``axis``, taking/returning GLOBAL (unsharded) arrays.
+    Convenience wrapper used by tests and single-call users; training
+    loops instead call ring_attention directly inside their own
+    shard_map."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    fn = ring_attention if kind == "ring" else ulysses_attention
+
+    @jax.jit
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis), check_vma=False)
+    def run(q, k, v):
+        return fn(q, k, v, axis_name=axis, causal=causal)
+
+    return run
