@@ -42,6 +42,11 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     if method == "onehot":
         hist = _hist_onehot(bins, grad, hess, weight, leaf_of_row,
                             num_leaves, num_bins)
+    elif method == "pallas":
+        from mmlspark_tpu.gbdt.pallas_hist import hist_pallas
+        hist = hist_pallas(
+            bins, grad, hess, weight, leaf_of_row, num_leaves, num_bins,
+            interpret=jax.default_backend() not in ("tpu", "axon"))
     else:
         hist = _hist_scatter(bins, grad, hess, weight, leaf_of_row,
                              num_leaves, num_bins)

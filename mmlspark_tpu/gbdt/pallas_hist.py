@@ -1,0 +1,115 @@
+"""Pallas TPU kernel for GBDT histogram building.
+
+The histogram is the GBDT hot op (the reference spends its training time
+inside LightGBM's native C++ histogram loop, ref: TrainUtils.scala:82-89).
+On TPU the scatter-free formulation is histogram-by-matmul: for a chunk
+of rows, build the bin one-hot (C, Fc*B) and the leaf-weighted stats
+matrix (3L, C) in VMEM, then one MXU matmul accumulates all (leaf,
+feature, bin) cells of the chunk at once. The grid tiles (feature-chunk,
+row-chunk); row-chunks accumulate into the same output block, which is
+safe because TPU grid iterations execute sequentially on a core.
+
+Numerics match the scatter/segment-sum path to float32 tolerance; on
+non-TPU backends the kernel runs in interpret mode (tests) and the
+booster defaults to the scatter path instead.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+# conservative defaults: VMEM per block ~ C*Fc*B*4 bytes (1 MB at
+# 256*16*256) plus the (3L, Fc*B) accumulator
+ROW_CHUNK = 256
+FEAT_CHUNK = 16
+
+
+def _hist_kernel(bins_ref, stats_ref, leaf_ref, out_ref, *,
+                 num_leaves: int, num_bins: int):
+    r = pl.program_id(1)
+
+    bins_blk = bins_ref[:]                         # (C, Fc) int32
+    stats_blk = stats_ref[:]                       # (C, 3) f32
+    leaf_blk = leaf_ref[:]                         # (C, 1) int32
+    c, fc = bins_blk.shape
+
+    # bin one-hot: (C, Fc, B) -> (C, Fc*B)
+    bin_ids = lax.broadcasted_iota(jnp.int32, (c, fc, num_bins), 2)
+    onehot = (bins_blk[:, :, None] == bin_ids).astype(jnp.float32)
+    onehot = onehot.reshape(c, fc * num_bins)
+
+    # leaf-weighted stats: (3L, C)
+    leaf_ids = lax.broadcasted_iota(jnp.int32, (c, num_leaves), 1)
+    leaf_oh = (leaf_blk == leaf_ids).astype(jnp.float32)   # (C, L)
+    lhs = (stats_blk.T[:, None, :] * leaf_oh.T[None, :, :])  # (3, L, C)
+    lhs = lhs.reshape(3 * num_leaves, c)
+
+    contrib = jnp.dot(lhs, onehot,
+                      preferred_element_type=jnp.float32)  # (3L, Fc*B)
+
+    @pl.when(r == 0)
+    def _():
+        out_ref[:] = contrib
+
+    @pl.when(r > 0)
+    def _():
+        out_ref[:] = out_ref[:] + contrib
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_leaves", "num_bins", "interpret"))
+def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+                weight: jnp.ndarray, leaf_of_row: jnp.ndarray,
+                num_leaves: int, num_bins: int,
+                interpret: bool = False) -> jnp.ndarray:
+    """(3, L, F, B) float32 histogram via the Pallas MXU kernel.
+
+    Same contract as histogram.build_histogram's other methods; rows
+    with weight 0 (padding/bagging) contribute nothing.
+    """
+    n, f = bins.shape
+    c = min(ROW_CHUNK, max(8, n))
+    fc = min(FEAT_CHUNK, f)
+
+    pad_rows = (-n) % c
+    pad_feats = (-f) % fc
+    if pad_rows:
+        bins = jnp.pad(bins, ((0, pad_rows), (0, 0)))
+        grad = jnp.pad(grad, (0, pad_rows))
+        hess = jnp.pad(hess, (0, pad_rows))
+        weight = jnp.pad(weight, (0, pad_rows))   # 0-weight padding
+        leaf_of_row = jnp.pad(leaf_of_row, (0, pad_rows))
+    if pad_feats:
+        bins = jnp.pad(bins, ((0, 0), (0, pad_feats)))
+    n_p, f_p = bins.shape
+
+    stats = jnp.stack([grad * weight, hess * weight, weight],
+                      axis=1).astype(jnp.float32)       # (N, 3)
+    leaf2 = leaf_of_row.astype(jnp.int32)[:, None]       # (N, 1)
+
+    grid = (f_p // fc, n_p // c)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_leaves=num_leaves,
+                          num_bins=num_bins),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((c, fc), lambda fi, ri: (ri, fi)),
+            pl.BlockSpec((c, 3), lambda fi, ri: (ri, 0)),
+            pl.BlockSpec((c, 1), lambda fi, ri: (ri, 0)),
+        ],
+        out_specs=pl.BlockSpec((3 * num_leaves, fc * num_bins),
+                               lambda fi, ri: (0, fi)),
+        out_shape=jax.ShapeDtypeStruct(
+            (3 * num_leaves, f_p * num_bins), jnp.float32),
+        interpret=interpret,
+    )(bins, stats, leaf2)
+
+    hist = out.reshape(3, num_leaves, f_p, num_bins)
+    if pad_feats:
+        hist = hist[:, :, :f, :]
+    return hist
