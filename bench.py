@@ -11,7 +11,6 @@ Runs on whatever jax.devices() provides (the real TPU chip under axon).
 """
 
 import json
-import time
 
 import numpy as np
 
@@ -20,9 +19,8 @@ import numpy as np
 # BASELINE.md).
 BASELINE_IMGS_PER_SEC_PER_CHIP = 1000.0
 
-BATCH = 256
+BATCH = 512
 STEPS_TARGET = 60
-WARMUP_FRACTION = 0.3
 
 
 def main():
@@ -50,20 +48,15 @@ def main():
                      "dense_features": [256], "num_classes": 10},
         inputShape=[32, 32, 3],
         batchSize=BATCH, learningRate=0.1, computeDtype="bfloat16",
-        epochs=epochs, logEvery=1)
+        epochs=epochs, logEvery=1000)
     learner.set_mesh(mesh)
 
     learner.fit(table)
 
-    # steady-state throughput from per-step timestamps, skipping warmup
-    times = [h["time"] for h in learner.history]
-    n_steps = len(times)
-    skip = max(1, int(n_steps * WARMUP_FRACTION))
-    steady = times[skip:]
-    dt = steady[-1] - steady[0]
-    steps = len(steady) - 1
-    imgs_per_sec = steps * BATCH / dt
-    per_chip = imgs_per_sec / n_chips
+    # steady-state throughput measured by the learner itself: device-synced
+    # at the first-step boundary (after compile) and at the final state, so
+    # async dispatch can't inflate or deflate the number
+    per_chip = learner.timing["examples_per_sec"] / n_chips
 
     print(json.dumps({
         "metric": "cifar10_convnet_train_imgs_per_sec_per_chip",

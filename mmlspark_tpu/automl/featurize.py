@@ -40,8 +40,12 @@ class Featurize(Estimator):
     outputCol = ColParam("assembled features column", default="features")
     oneHotEncodeCategoricals = BoolParam("one-hot index columns",
                                          default=False)
+    # The reference defaults to 262144 (Featurize.scala:13-19) but keeps
+    # hashing-TF output *sparse*; this build materializes dense rows at the
+    # device boundary (~2 MB/row float64 at 2^18 — an OOM footgun), so the
+    # default is 2^12. Set it higher explicitly if you can afford N x width.
     numberOfFeatures = IntParam("hash width for token columns",
-                                default=1 << 18)
+                                default=1 << 12)
     allowImages = BoolParam("parity param (image passthrough)",
                             default=False)
 
@@ -93,31 +97,34 @@ class FeaturizeModel(Model):
     outputCol = ColParam("assembled features column", default="features")
 
     def transform(self, table: DataTable) -> DataTable:
+        # all parts float32: device stages consume f32/bf16 anyway, and a
+        # single float64 part would upcast the whole concatenate (doubling
+        # the wide hashed block's footprint)
         parts: List[np.ndarray] = []
         n = len(table)
         for spec in self.get("specs") or []:
             c = spec["col"]
             kind = spec["kind"]
             if kind == "numeric":
-                col = np.asarray(table[c], dtype=np.float64)
-                col = np.where(np.isfinite(col), col, spec["fill"])
+                col = np.asarray(table[c], dtype=np.float32)
+                col = np.where(np.isfinite(col), col, np.float32(spec["fill"]))
                 parts.append(col[:, None])
             elif kind == "onehot":
                 col = np.asarray(table[c], dtype=np.int64)
                 size = spec["size"]
-                oh = np.zeros((n, size))
+                oh = np.zeros((n, size), dtype=np.float32)
                 ok = (col >= 0) & (col < size)
                 oh[np.arange(n)[ok], col[ok]] = 1.0
                 parts.append(oh)
             elif kind == "string_index":
                 index = {v: i for i, v in enumerate(spec["levels"])}
-                col = np.asarray([float(index.get(v, -1))
-                                  for v in table[c]])
+                col = np.asarray([index.get(v, -1) for v in table[c]],
+                                 dtype=np.float32)
                 parts.append(col[:, None])
             elif kind == "string_onehot":
                 index = {v: i for i, v in enumerate(spec["levels"])}
                 size = len(spec["levels"])
-                oh = np.zeros((n, size))
+                oh = np.zeros((n, size), dtype=np.float32)
                 for i, v in enumerate(table[c]):
                     j = index.get(v)
                     if j is not None:
@@ -125,7 +132,9 @@ class FeaturizeModel(Model):
                 parts.append(oh)
             elif kind == "hash":
                 m = spec["size"]
-                mat = np.zeros((n, m), dtype=np.float64)
+                # float32 halves the dense-materialization footprint; TF
+                # counts are small integers so no precision is lost
+                mat = np.zeros((n, m), dtype=np.float32)
                 for i, toks in enumerate(table[c]):
                     for t in toks or []:
                         mat[i, _stable_hash(str(t)) % m] += 1.0
@@ -133,10 +142,10 @@ class FeaturizeModel(Model):
             elif kind == "vector":
                 col = table[c]
                 if isinstance(col, np.ndarray) and col.ndim == 2:
-                    parts.append(np.asarray(col, dtype=np.float64))
+                    parts.append(np.asarray(col, dtype=np.float32))
                 else:
                     parts.append(np.stack(
-                        [np.asarray(v, dtype=np.float64) for v in col]))
+                        [np.asarray(v, dtype=np.float32) for v in col]))
         if not parts:
             raise ValueError("no featurizable columns found")
         feats = np.concatenate(parts, axis=1)
@@ -157,7 +166,7 @@ class AssembleFeatures(Estimator):
     oneHotEncodeCategoricals = BoolParam("one-hot categoricals",
                                          default=False)
     numberOfFeatures = IntParam("hash width for token columns",
-                                default=1 << 18)
+                                default=1 << 12)  # see Featurize note
 
     def fit(self, table: DataTable) -> FeaturizeModel:
         feat = Featurize(

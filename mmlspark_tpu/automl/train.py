@@ -33,7 +33,8 @@ class TrainClassifier(Estimator, HasLabelCol):
     model = StageParam("underlying classifier estimator", default=None)
     featureColumns = ListParam("columns to featurize (None = all)",
                                default=None)
-    numFeatures = IntParam("hash width for token columns", default=1 << 18)
+    numFeatures = IntParam("hash width for token columns",
+                           default=1 << 12)  # see Featurize note on 2^18
     oneHotEncodeCategoricals = BoolParam("one-hot categoricals",
                                          default=False)
     reindexLabel = BoolParam("index the label column", default=True)
@@ -117,7 +118,8 @@ class TrainRegressor(Estimator, HasLabelCol):
     model = StageParam("underlying regressor estimator", default=None)
     featureColumns = ListParam("columns to featurize (None = all)",
                                default=None)
-    numFeatures = IntParam("hash width for token columns", default=1 << 18)
+    numFeatures = IntParam("hash width for token columns",
+                           default=1 << 12)  # see Featurize note on 2^18
     oneHotEncodeCategoricals = BoolParam("one-hot categoricals",
                                          default=False)
 

@@ -81,10 +81,13 @@ class BinMapper:
         the upper boundary of that bin. Rows with value <= this boundary
         land in bins [0..bin_idx]."""
         ub = self.upper_bounds[feature]
-        if len(ub) == 0:
+        if len(ub) == 0 or int(bin_idx) >= len(ub):
+            # Split at (or past) a feature's top bin: every value goes left
+            # during binned training, so the raw-value threshold must be +inf
+            # to keep train/predict consistent (a finite ub[-1] would send
+            # values > ub[-1] right at inference only).
             return np.inf
-        bin_idx = min(int(bin_idx), len(ub) - 1)
-        return float(ub[bin_idx])
+        return float(ub[int(bin_idx)])
 
     # -- persistence --------------------------------------------------------
 

@@ -330,39 +330,90 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     host_state, state_sharding)
                 logger.info("resumed from %s (step %d)", latest, start_step)
 
-        # training loop
+        # training loop. Input feed: a background thread slices/pads the
+        # next minibatch and device_puts it while the current step runs on
+        # the MXU (the CNTK out-of-band reader analog — see utils/prefetch).
+        # Logging NEVER syncs the device on the hot path: logged losses stay
+        # on device and are flushed one logEvery-interval late, by which
+        # time they are ready and float() is free.
+        import time as _time
+        from mmlspark_tpu.utils.prefetch import ThreadedPrefetcher
+
         self.history = []
+        self.timing: Dict[str, float] = {}
         np_rng = np.random.default_rng(self.get("seed"))
-        global_step = 0
-        for epoch in range(self.get("epochs")):
-            order = np_rng.permutation(n)
-            for bstart in range(0, n, batch_size):
-                idx = order[bstart:bstart + batch_size]
-                global_step += 1
-                if global_step <= start_step:
-                    continue  # fast-forward after resume (keeps rng stream)
-                bx, true_len = mesh_lib.pad_to_multiple(
-                    x[idx], batch_size, axis=0)
-                by, _ = mesh_lib.pad_to_multiple(y[idx], batch_size, axis=0)
-                w = (np.arange(batch_size) < true_len).astype(np.float32)
-                batch = {
-                    "x": jax.device_put(bx, data_sharding["x"]),
-                    "y": jax.device_put(by, data_sharding["y"]),
-                    "w": jax.device_put(w, data_sharding["w"]),
-                }
+        log_every = self.get("logEvery")
+        ckpt_every = self.get("checkpointEvery")
+        epochs = self.get("epochs")
+
+        def index_stream():
+            step = 0
+            for epoch in range(epochs):
+                order = np_rng.permutation(n)
+                for bstart in range(0, n, batch_size):
+                    step += 1
+                    if step <= start_step:
+                        continue  # fast-forward after resume (keeps rng)
+                    yield epoch, step, order[bstart:bstart + batch_size]
+
+        def make_batch(item):
+            epoch, step, idx = item
+            bx, true_len = mesh_lib.pad_to_multiple(
+                x[idx], batch_size, axis=0)
+            by, _ = mesh_lib.pad_to_multiple(y[idx], batch_size, axis=0)
+            w = (np.arange(batch_size) < true_len).astype(np.float32)
+            return epoch, step, {
+                "x": jax.device_put(bx, data_sharding["x"]),
+                "y": jax.device_put(by, data_sharding["y"]),
+                "w": jax.device_put(w, data_sharding["w"]),
+            }
+
+        pending: List[Tuple[int, int, Any, float]] = []  # deferred log queue
+
+        def flush_logs(final: bool = False) -> None:
+            # flush entries whose device value is (almost surely) ready:
+            # everything but the newest, or everything when final
+            keep = 0 if final else 1
+            while len(pending) > keep:
+                step_, epoch_, dev_loss, t = pending.pop(0)
+                lv = float(dev_loss)
+                self.history.append({"step": step_, "loss": lv,
+                                     "epoch": epoch_, "time": t})
+                logger.info("step %d/%d loss %.4f", step_, total_steps, lv)
+
+        global_step = start_step
+        t_first = None
+        feed = ThreadedPrefetcher(index_stream(), make_batch, depth=2)
+        try:
+            for epoch, global_step, batch in feed:
                 state, loss = jit_step(state, batch)
-                if global_step % self.get("logEvery") == 0 or (
-                        global_step == total_steps):
-                    lv = float(loss)  # device sync point
-                    import time as _time
-                    self.history.append(
-                        {"step": global_step, "loss": lv, "epoch": epoch,
-                         "time": _time.time()})
-                    logger.info("step %d/%d loss %.4f",
-                                global_step, total_steps, lv)
-                if ckpt_dir and (global_step % self.get("checkpointEvery")
-                                 == 0):
+                if t_first is None:
+                    # block on the compile+first step so steady-state
+                    # timing starts after warmup
+                    loss.block_until_ready()
+                    t_first = _time.time()
+                    first_timed_step = global_step
+                if global_step % log_every == 0 or \
+                        global_step == total_steps:
+                    pending.append((global_step, epoch, loss, _time.time()))
+                    flush_logs()
+                if ckpt_dir and global_step % ckpt_every == 0:
                     _save_checkpoint(ckpt_dir, global_step, state)
+        finally:
+            # abnormal exit must not leave the worker blocked in put()
+            # pinning prefetched batches in HBM
+            feed.close()
+        state = jax.block_until_ready(state)
+        t_end = _time.time()
+        flush_logs(final=True)
+        steps_timed = global_step - (first_timed_step if t_first else 0)
+        if t_first is not None and steps_timed > 0:
+            self.timing = {
+                "steps_timed": steps_timed,
+                "wall_s": t_end - t_first,
+                "examples_per_sec":
+                    steps_timed * batch_size / max(t_end - t_first, 1e-9),
+            }
         if ckpt_dir:
             _save_checkpoint(ckpt_dir, global_step, state)
 

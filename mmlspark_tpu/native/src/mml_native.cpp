@@ -49,8 +49,9 @@ static int decode_jpeg(const uint8_t* data, int len, uint8_t** out,
                        int* h, int* w, int* c) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
-  uint8_t* buf = nullptr;  // declared before setjmp so the error path
-                           // can free a buffer allocated mid-decode
+  // volatile: modified between setjmp and longjmp; without it the value
+  // read in the error path is indeterminate (UB) on malformed input
+  uint8_t* volatile buf = nullptr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
   if (setjmp(jerr.jump)) {

@@ -145,8 +145,13 @@ class HTTPSource:
                 if isinstance(entity, str):
                     entity = entity.encode("utf-8")
                 self.send_response(code)
+                # framing/hop-by-hop headers are computed by this server;
+                # forwarding pipeline-supplied ones would duplicate/conflict
+                _framing = {"content-length", "transfer-encoding",
+                            "connection"}
                 for k, v in (resp.get("headers") or {}).items():
-                    self.send_header(k, v)
+                    if k.lower() not in _framing:
+                        self.send_header(k, v)
                 self.send_header("Content-Length", str(len(entity)))
                 self.end_headers()
                 self.wfile.write(entity)

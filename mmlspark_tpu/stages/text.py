@@ -5,9 +5,10 @@ TPU-native analog of the reference's text-featurizer
 a one-call Estimator composing tokenize → stop-word removal → n-grams →
 hashing-TF or count-vectorize → IDF, plus the individual building-block
 stages. Sparse term-frequency vectors are materialized as dense float32
-rows (hashing dims default 2^18 like the reference's 262144) only at the
-boundary where a downstream device stage consumes them; the TF counting
-itself is host-side dict arithmetic.
+rows only at the boundary where a downstream device stage consumes them;
+the TF counting itself is host-side dict arithmetic. Hash width defaults
+to 2^12 (the reference's 262144 assumed Spark sparse vectors; dense rows
+at that width are an OOM footgun — set numFeatures explicitly to match).
 """
 
 from __future__ import annotations
@@ -101,9 +102,12 @@ class NGram(Transformer, HasInputCol, HasOutputCol):
 
 class HashingTF(Transformer, HasInputCol, HasOutputCol):
     """Feature hashing to a fixed-width count vector
-    (ref: TextFeaturizer numFeatures default 262144 / 2^18)."""
+    (ref: TextFeaturizer numFeatures default 262144 / 2^18; lowered here
+    to 2^12 because this build materializes dense float32 rows — 2^18
+    dense is ~1 MB/row, an OOM footgun the reference's sparse vectors
+    never hit. Set numFeatures explicitly for reference-width hashing)."""
 
-    numFeatures = IntParam("hash space size", default=1 << 18)
+    numFeatures = IntParam("hash space size", default=1 << 12)
     binary = BoolParam("presence instead of counts", default=False)
 
     def transform(self, table: DataTable) -> DataTable:
@@ -139,7 +143,7 @@ class CountVectorizer(Estimator, HasInputCol, HasOutputCol):
     """Vocabulary-based term counting (TextFeaturizer's non-hashing
     path)."""
 
-    vocabSize = IntParam("max vocabulary size", default=1 << 18)
+    vocabSize = IntParam("max vocabulary size", default=1 << 12)
     minDF = IntParam("min docs containing a term", default=1)
 
     def fit(self, table: DataTable) -> "CountVectorizerModel":
@@ -233,9 +237,9 @@ class TextFeaturizer(Estimator, HasInputCol, HasOutputCol):
     nGramLength = IntParam("n-gram length", default=2)
     useHashingTF = BoolParam("hashingTF (True) or countVectorizer",
                              default=True)
-    numFeatures = IntParam("hash space size", default=1 << 18)
+    numFeatures = IntParam("hash space size", default=1 << 12)
     binary = BoolParam("binary term counts", default=False)
-    vocabSize = IntParam("count-vectorizer vocab size", default=1 << 18)
+    vocabSize = IntParam("count-vectorizer vocab size", default=1 << 12)
     minDF = IntParam("count-vectorizer min doc freq", default=1)
     useIDF = BoolParam("apply IDF weighting", default=True)
     minDocFreq = IntParam("IDF min doc freq", default=1)

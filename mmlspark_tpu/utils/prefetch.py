@@ -1,0 +1,69 @@
+"""Threaded host→device input prefetching.
+
+The reference's training path streams data to the accelerator out-of-band
+(CNTK readers consume CNTKTextFormat files the Spark job staged to local
+disk/HDFS while native SGD runs — ref: src/cntk-train/src/main/scala/
+DataConversion.scala:88-160, CommandBuilders.scala:207-229). The TPU-native
+equivalent: a background thread builds the next minibatch (slice, pad,
+``jax.device_put``) while the current step runs on the MXU, so HBM fills
+overlap compute instead of serializing with it. ``jax.device_put`` is
+async, so depth=2 is enough to keep the device queue non-empty.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+_SENTINEL = object()
+
+
+class ThreadedPrefetcher:
+    """Wrap an iterable, applying ``prepare`` in a background thread and
+    buffering up to ``depth`` prepared items ahead of the consumer.
+
+    ``prepare`` typically does host-side batch assembly + device_put.
+    Exceptions in the worker are re-raised at the consuming ``__next__``.
+    """
+
+    def __init__(self, source: Iterable[Any],
+                 prepare: Callable[[Any], Any], depth: int = 2):
+        self._q: "queue.Queue[Any]" = queue.Queue(maxsize=max(depth, 1))
+        self._err: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+        def worker():
+            try:
+                for item in source:
+                    if self._stop.is_set():
+                        return
+                    self._q.put(prepare(item))
+            except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+                self._err = e
+            finally:
+                self._q.put(_SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        item = self._q.get()
+        if item is _SENTINEL:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        """Stop the worker and drain (for early exit)."""
+        self._stop.set()
+        while True:
+            try:
+                if self._q.get_nowait() is _SENTINEL:
+                    break
+            except queue.Empty:
+                break
