@@ -1,16 +1,29 @@
-"""Flagship benchmark: CIFAR-10 ConvNet training throughput (imgs/sec/chip).
+"""Flagship benchmarks: CIFAR-10 ConvNet training throughput (the
+cntk-train headline path) + HIGGS-shaped GBDT training wall-clock (the
+lightgbm headline path). BASELINE.json names exactly these two.
 
-This is the cntk-train headline path (ref: notebooks/gpu/401 — BrainScript
-ConvNet on 32x32x3 CIFAR-10, parallelTrain on a 4-GPU Azure N-series VM).
-BASELINE.md: the reference publishes no absolute numbers, so the baseline
-constant below is the commonly-reported single-K80 CNTK ConvNet throughput
-for that hardware class, ~1000 imgs/sec.
+CIFAR (ref: notebooks/gpu/401 — BrainScript ConvNet on 32x32x3 CIFAR-10,
+parallelTrain on a 4-GPU Azure N-series VM). The reference publishes no
+absolute numbers, so the baseline constant is the commonly-reported
+single-K80 CNTK ConvNet throughput for that hardware class, ~1000
+imgs/sec.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Runs on whatever jax.devices() provides (the real TPU chip under axon).
+GBDT (ref: docs/lightgbm.md:16-18 — LightGBM-on-Spark "10-30% faster"
+than SparkML GBT on HIGGS, no absolute number). Config mirrors the
+LightGBM HIGGS benchmark shape: 1M rows x 28 features, binary objective,
+63 leaves, 63 bins, 40 iterations. Baseline constant: native LightGBM on
+a 16-core CPU node runs this config in ~35 s wall-clock (the
+order-of-magnitude from LightGBM's published experiments, scaled to 1M
+rows); no lightgbm binary exists in this image to re-measure. Wall-clock
+vs_baseline is baseline/ours, so >= 1.0 means we are faster.
+
+Prints ONE JSON line: the CIFAR headline with the GBDT result under
+"secondary". Runs on whatever jax.devices() provides (the real TPU chip
+under axon).
 """
 
 import json
+import time
 
 import numpy as np
 
@@ -19,11 +32,19 @@ import numpy as np
 # BASELINE.md).
 BASELINE_IMGS_PER_SEC_PER_CHIP = 1000.0
 
+# native LightGBM, 16-core CPU node, 1M x 28 HIGGS subsample, 63 leaves /
+# 63 bins / 40 iters (docs/lightgbm.md publishes no absolute number; see
+# module docstring)
+BASELINE_HIGGS_WALL_S = 35.0
+
 BATCH = 512
 STEPS_TARGET = 60
 
+HIGGS_N, HIGGS_F = 1_000_000, 28
+HIGGS_VALID_N = 100_000
 
-def main():
+
+def bench_cifar():
     import jax
 
     from mmlspark_tpu.core.table import DataTable
@@ -56,13 +77,54 @@ def main():
     # steady-state throughput measured by the learner itself: device-synced
     # at the first-step boundary (after compile) and at the final state, so
     # async dispatch can't inflate or deflate the number
-    per_chip = learner.timing["examples_per_sec"] / n_chips
+    return learner.timing["examples_per_sec"] / n_chips
+
+
+def bench_higgs_gbdt():
+    from sklearn.metrics import roc_auc_score
+
+    from mmlspark_tpu.gbdt.booster import train
+
+    rng = np.random.default_rng(0)
+    n = HIGGS_N + HIGGS_VALID_N
+    X = rng.normal(size=(n, HIGGS_F)).astype(np.float32)
+    logit = (X[:, 0] * 1.5 + X[:, 1] * X[:, 2]
+             + 0.5 * np.sin(3 * X[:, 3])
+             + rng.normal(scale=0.5, size=n))
+    y = (logit > 0).astype(np.float64)
+    Xtr, ytr = X[:HIGGS_N], y[:HIGGS_N]
+    Xte, yte = X[HIGGS_N:], y[HIGGS_N:]
+
+    params = {"objective": "binary", "num_iterations": 40,
+              "num_leaves": 63, "max_bin": 63, "min_data_in_leaf": 50}
+    # one-iteration warmup at the FULL training shape isolates XLA
+    # compile from the measured train (jit caches are shape-keyed)
+    train({**params, "num_iterations": 1}, Xtr, ytr)
+    t0 = time.time()
+    booster = train(params, Xtr, ytr)
+    wall = time.time() - t0
+    auc = roc_auc_score(yte, booster.predict(Xte))
+    return wall, auc, booster.params["hist_method"]
+
+
+def main():
+    per_chip = bench_cifar()
+    higgs_wall, higgs_auc, hist_method = bench_higgs_gbdt()
 
     print(json.dumps({
         "metric": "cifar10_convnet_train_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+        "secondary": {
+            "metric": "higgs1m_gbdt_train_wall_clock",
+            "value": round(higgs_wall, 1),
+            "unit": "s",
+            "vs_baseline": round(BASELINE_HIGGS_WALL_S / higgs_wall, 3),
+            "holdout_auc": round(higgs_auc, 4),
+            "hist_method": hist_method,
+            "config": f"{HIGGS_N}x{HIGGS_F}, 63 leaves, 63 bins, 40 iters",
+        },
     }))
 
 

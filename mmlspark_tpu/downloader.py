@@ -157,6 +157,50 @@ class LocalRepo:
         return schema
 
 
+class HTTPRepo:
+    """HTTP(S)-backed model repo — the remote half of the reference's
+    downloader (ref: ModelDownloader.scala:54-124 HDFSRepo/DefaultModelRepo:
+    remote URI fetch, sha256 verify, retry with backoff). Expects the same
+    layout LocalRepo publishes: ``<base>/index.json`` + ``<name>.msgpack``.
+    """
+
+    def __init__(self, base_url: str, retries: int = 3):
+        self.base_url = base_url.rstrip("/")
+        self._fs = None
+        self.retries = retries
+
+    def _fetch(self, rel: str) -> bytes:
+        # retry policy lives in ONE layer — the HTTP filesystem — so
+        # downloader-level wrapping doesn't multiply attempts
+        from mmlspark_tpu.utils.filesystem import HTTPFileSystem
+        if self._fs is None:
+            self._fs = HTTPFileSystem(retries=self.retries)
+        return self._fs.read_bytes(f"{self.base_url}/{rel}")
+
+    def _load_index(self) -> Dict[str, Dict[str, Any]]:
+        return json.loads(self._fetch("index.json").decode())
+
+    def list_schemas(self) -> Iterator[ModelSchema]:
+        for d in self._load_index().values():
+            yield ModelSchema.from_json(d)
+
+    def get_schema(self, name: str) -> ModelSchema:
+        idx = self._load_index()
+        if name not in idx:
+            raise KeyError(
+                f"model {name!r} not in repo {self.base_url}; "
+                f"have {sorted(idx)}")
+        return ModelSchema.from_json(idx[name])
+
+    def read_blob(self, schema: ModelSchema, verify: bool = True) -> bytes:
+        blob = self._fetch(f"{schema.name}.msgpack")
+        if verify and hashlib.sha256(blob).hexdigest() != schema.sha256:
+            raise IOError(
+                f"sha256 mismatch for {schema.name} fetched from "
+                f"{self.base_url} (corrupt or tampered download)")
+        return blob
+
+
 class ModelDownloader:
     """Fetch models from a repo into a local cache, verifying hashes
     (ref: ModelDownloader.scala:209-280 — downloadModel/downloadByName,
@@ -183,7 +227,9 @@ class ModelDownloader:
             raise KeyError(
                 f"model {name!r} not cached and no remote repo configured")
         schema = self.repo.get_schema(name)
-        blob = retry_with_backoff(lambda: self.repo.read_blob(schema))
+        # each repo owns its retry policy (HTTPRepo retries in its
+        # filesystem layer); wrapping again here would multiply attempts
+        blob = self.repo.read_blob(schema)
         return self.local.publish(
             name, schema.network_spec, blob=blob,
             dataset=schema.dataset, model_type=schema.model_type,

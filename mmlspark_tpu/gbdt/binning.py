@@ -89,6 +89,17 @@ class BinMapper:
             return np.inf
         return float(ub[int(bin_idx)])
 
+    def threshold_matrix(self, num_bins: int) -> np.ndarray:
+        """(F, num_bins) lookup of bin_threshold_value for every (feature,
+        bin) pair — lets the booster convert a whole stacked forest's bin
+        thresholds to raw-value thresholds in one vectorized gather instead
+        of a per-node Python loop."""
+        out = np.full((self.num_features, num_bins), np.inf)
+        for j, ub in enumerate(self.upper_bounds):
+            k = min(len(ub), num_bins)
+            out[j, :k] = ub[:k]
+        return out
+
     # -- persistence --------------------------------------------------------
 
     def to_json(self) -> dict:

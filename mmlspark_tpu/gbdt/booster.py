@@ -15,6 +15,7 @@ over the mesh's data axis with psum'd histograms — the ICI equivalent of
 
 from __future__ import annotations
 
+import functools
 import json
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -52,7 +53,7 @@ DEFAULTS: Dict[str, Any] = {
     "seed": 0,
     "alpha": 0.9,                      # quantile / huber
     "tweedie_variance_power": 1.5,
-    "hist_method": "scatter",          # 'scatter' | 'onehot' (MXU)
+    "hist_method": "auto",  # 'auto' | 'scatter' | 'onehot' | 'pallas'
     "parallelism": "serial",           # 'serial' | 'data'
 }
 
@@ -210,6 +211,14 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
     analog, ref: TrainParams.scala:26)."""
     p = dict(DEFAULTS)
     p.update(params or {})
+    if p["hist_method"] == "auto":
+        # the Pallas MXU kernel is the TPU production path (the analog of
+        # the reference's native histogram loop, TrainUtils.scala:82-89);
+        # on CPU it would run in slow interpret mode, so fall back to
+        # scatter (segment_sum) there.
+        p["hist_method"] = ("pallas"
+                            if jax.default_backend() in ("tpu", "axon")
+                            else "scatter")
 
     X = np.asarray(X, dtype=np.float64)
     y = np.asarray(y, dtype=np.float64)
@@ -264,7 +273,13 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
         hist_method=p["hist_method"])
     lr = float(p["learning_rate"])
 
-    step_fn = _make_step(objective, gp, lr, K, axis_name, mesh)
+    # jitted-step cache: keyed by objective config (not instance) so
+    # repeated train() calls at the same shapes reuse the compiled
+    # executable instead of re-tracing a fresh closure every time
+    step_fn = _make_step(
+        (p["objective"], K, float(p["alpha"]),
+         float(p["tweedie_variance_power"])),
+        gp, lr, K, axis_name, mesh)
 
     if data_parallel:
         shard = mesh_lib.data_sharding(mesh)
@@ -283,20 +298,24 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
             (K, n_padded))
 
     rng = np.random.default_rng(p["seed"])
-    trees_acc: List[Dict[str, np.ndarray]] = []
-    tree_depths: List[int] = []
+    trees_dev: List[Tree] = []   # stays on device until the final stack
 
-    # validation state (incremental scoring — one tree per update)
-    has_valid = valid is not None
-    if has_valid:
-        Xv = np.asarray(valid[0], dtype=np.float32)
+    # validation state — device-resident; the held-out set is scored
+    # through the *binned* feature view (same comparisons training uses)
+    # so the loop never converts a tree to host. The only per-iteration
+    # device sync is the scalar early-stopping loss read.
+    esr = int(p["early_stopping_round"])
+    use_valid = valid is not None and esr > 0
+    if use_valid:
+        bins_v = jnp.asarray(
+            mapper.transform(np.asarray(valid[0], dtype=np.float64))
+            .astype(np.float32))
         yv = jnp.asarray(np.asarray(valid[1], dtype=np.float32))
-        v_scores = np.broadcast_to(
-            np.asarray(init_score, np.float32)[:, None],
-            (K, Xv.shape[0])).copy()
+        v_scores = jnp.broadcast_to(
+            jnp.asarray(init_score, jnp.float32)[:, None],
+            (K, bins_v.shape[0]))
     best_loss = np.inf
     best_iter = -1
-    esr = int(p["early_stopping_round"])
     # one fixed walk length -> one predict_trees compile for the whole
     # run (leaves self-loop, extra steps are no-ops)
     valid_depth = int(p["max_depth"]) if int(p["max_depth"]) > 0 \
@@ -325,43 +344,42 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
         fmask = jnp.asarray(fmask_np)
 
         scores, class_trees = step_fn(bins_d, scores, y_d, w_d, fmask)
+        trees_dev.extend(class_trees)
 
-        for k_cls in range(K):
-            tree_host = {name: np.asarray(arr)
-                         for name, arr in class_trees[k_cls]._asdict().items()}
-            # bin threshold -> raw value threshold for inference
-            thr = np.asarray([
-                mapper.bin_threshold_value(int(ft), int(bt))
-                if not leaf else 0.0
-                for ft, bt, leaf in zip(tree_host["feature"],
-                                        tree_host["bin_threshold"],
-                                        tree_host["is_leaf"])],
-                dtype=np.float32)
-            tree_host["threshold"] = thr
-            tree_host["value"] = tree_host["value"] * lr  # bake shrinkage
-            trees_acc.append(tree_host)
-            tree_depths.append(_tree_depth(tree_host))
-            if has_valid:
+        if use_valid:
+            for k_cls in range(K):
+                t = class_trees[k_cls]
                 tv = predict_trees(
-                    jnp.asarray(Xv),
-                    jnp.asarray(tree_host["feature"][None]),
-                    jnp.asarray(tree_host["threshold"][None]),
-                    jnp.asarray(tree_host["left"][None]),
-                    jnp.asarray(tree_host["right"][None]),
-                    jnp.asarray(tree_host["value"][None]),
+                    bins_v, t.feature[None],
+                    t.bin_threshold.astype(jnp.float32)[None],
+                    t.left[None], t.right[None], t.value[None],
                     max_depth=valid_depth)
-                v_scores[k_cls] += np.asarray(tv)[0]
-
-        if has_valid and esr > 0:
-            vs = jnp.asarray(v_scores[0] if K == 1 else v_scores)
+                v_scores = v_scores.at[k_cls].add(lr * tv[0])
+            vs = v_scores[0] if K == 1 else v_scores
             cur = float(objective.loss(vs, yv))
             if cur < best_loss - 1e-12:
                 best_loss, best_iter = cur, it + 1
             elif it + 1 - best_iter >= esr:
                 break
 
-    stacked = {key: np.stack([t[key] for t in trees_acc])
-               for key in trees_acc[0]} if trees_acc else {}
+    if trees_dev:
+        # one device->host transfer for the whole forest
+        stacked_d = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                           *trees_dev)
+        stacked = {name: np.asarray(arr)
+                   for name, arr in stacked_d._asdict().items()}
+        # bin threshold -> raw value threshold, one vectorized gather
+        thr_lut = mapper.threshold_matrix(num_bins)          # (F, B)
+        thr = thr_lut[stacked["feature"], stacked["bin_threshold"]]
+        stacked["threshold"] = np.where(stacked["is_leaf"], 0.0, thr) \
+            .astype(np.float32)
+        stacked["value"] = stacked["value"] * lr  # bake shrinkage
+        tree_depths = [
+            _tree_depth({k: v[t] for k, v in stacked.items()})
+            for t in range(stacked["feature"].shape[0])]
+    else:
+        stacked = {}
+        tree_depths = []
     return Booster(objective, stacked, init_score, K, feature_names, p,
                    best_iteration=best_iter if esr > 0 else -1,
                    tree_depths=tree_depths)
@@ -389,11 +407,17 @@ def _tree_depth(tree_host: Dict[str, np.ndarray]) -> int:
     return max(depth, 1)
 
 
-def _make_step(objective: Objective, gp: GrowParams, lr: float, K: int,
-               axis_name: Optional[str], mesh: Optional[Mesh]):
+@functools.lru_cache(maxsize=64)
+def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
+               lr: float, K: int, axis_name: Optional[str],
+               mesh: Optional[Mesh]):
     """Build the per-iteration jitted step:
     gradients → K trees → score update. Returns
-    (new_scores, tuple_of_K_trees)."""
+    (new_scores, tuple_of_K_trees). lru_cached so a second train() with
+    the same config hits the XLA compile cache."""
+    name, num_class, alpha, rho = obj_key
+    objective = get_objective(name, num_class=num_class, alpha=alpha,
+                              tweedie_variance_power=rho)
 
     def step(bins, scores, y, w, fmask):
         score_in = scores[0] if K == 1 else scores

@@ -54,8 +54,10 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
     boostFromAverage = BoolParam("start from average score", default=True)
     seed = IntParam("random seed", default=0)
     weightCol = ColParam("optional row-weight column", default=None)
-    histMethod = EnumParam(["scatter", "onehot"],
-                           "device histogram strategy", default="scatter")
+    histMethod = EnumParam(
+        ["auto", "scatter", "onehot", "pallas"],
+        "device histogram strategy ('auto' = pallas MXU kernel on TPU, "
+        "scatter elsewhere)", default="auto")
     parallelism = EnumParam(
         ["serial", "data"],
         "tree learner parallelism (ref: TrainParams.scala:26)",

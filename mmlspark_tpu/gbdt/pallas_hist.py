@@ -3,11 +3,20 @@
 The histogram is the GBDT hot op (the reference spends its training time
 inside LightGBM's native C++ histogram loop, ref: TrainUtils.scala:82-89).
 On TPU the scatter-free formulation is histogram-by-matmul: for a chunk
-of rows, build the bin one-hot (C, Fc*B) and the leaf-weighted stats
-matrix (3L, C) in VMEM, then one MXU matmul accumulates all (leaf,
-feature, bin) cells of the chunk at once. The grid tiles (feature-chunk,
-row-chunk); row-chunks accumulate into the same output block, which is
-safe because TPU grid iterations execute sequentially on a core.
+of rows, build the bin one-hot in VMEM and contract it against the
+per-row stats with one MXU matmul, accumulating all (feature, bin, leaf)
+cells of the chunk at once. Scatter/segment_sum is hundreds of times
+slower on TPU (serialized scatter units), and the XLA onehot path
+round-trips the one-hot through HBM; this kernel keeps it in VMEM.
+
+Memory layout is chosen for Mosaic's tiling rules (last two block dims
+divisible by (8, 128) or equal to the full array dims):
+  - bins are passed transposed, (F_p, N_p) int32, blocked (fc, C);
+  - per-row stats [g*w, h*w, w] are (N_p, 3), blocked (C, 3) — the last
+    dim spans the full array;
+  - the output is (F_p*B, 3L), blocked (fc*B, 3L): row-chunk grid steps
+    accumulate into the same block, which is safe because TPU grid
+    iterations execute sequentially on a core.
 
 Numerics match the scatter/segment-sum path to float32 tolerance; on
 non-TPU backends the kernel runs in interpret mode (tests) and the
@@ -23,34 +32,34 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-# conservative defaults: VMEM per block ~ C*Fc*B*4 bytes (1 MB at
-# 256*16*256) plus the (3L, Fc*B) accumulator
-ROW_CHUNK = 256
-FEAT_CHUNK = 16
+ROW_CHUNK = 512           # multiple of 128 (lane dim of the bins block)
+VMEM_ONEHOT_ELEMS = 2048  # fc*B budget: onehot block = fc*B*C*4 bytes
 
 
 def _hist_kernel(bins_ref, stats_ref, leaf_ref, out_ref, *,
                  num_leaves: int, num_bins: int):
     r = pl.program_id(1)
 
-    bins_blk = bins_ref[:]                         # (C, Fc) int32
+    bins_blk = bins_ref[:]                         # (fc, C) int32
     stats_blk = stats_ref[:]                       # (C, 3) f32
-    leaf_blk = leaf_ref[:]                         # (C, 1) int32
-    c, fc = bins_blk.shape
+    fc, c = bins_blk.shape
 
-    # bin one-hot: (C, Fc, B) -> (C, Fc*B)
-    bin_ids = lax.broadcasted_iota(jnp.int32, (c, fc, num_bins), 2)
-    onehot = (bins_blk[:, :, None] == bin_ids).astype(jnp.float32)
-    onehot = onehot.reshape(c, fc * num_bins)
+    # bin one-hot, features-major: (fc, B, C) -> (fc*B, C)
+    bin_ids = lax.broadcasted_iota(jnp.int32, (num_bins, c), 0)
+    onehot = (bins_blk[:, None, :] == bin_ids[None, :, :]) \
+        .astype(jnp.float32).reshape(fc * num_bins, c)
 
-    # leaf-weighted stats: (3L, C)
-    leaf_ids = lax.broadcasted_iota(jnp.int32, (c, num_leaves), 1)
-    leaf_oh = (leaf_blk == leaf_ids).astype(jnp.float32)   # (C, L)
-    lhs = (stats_blk.T[:, None, :] * leaf_oh.T[None, :, :])  # (3, L, C)
-    lhs = lhs.reshape(3 * num_leaves, c)
+    if num_leaves == 1:
+        rhs = stats_blk                            # (C, 3)
+    else:
+        leaf_blk = leaf_ref[:]                     # (C, 1) int32
+        leaf_ids = lax.broadcasted_iota(jnp.int32, (c, num_leaves), 1)
+        leaf_oh = (leaf_blk == leaf_ids).astype(jnp.float32)   # (C, L)
+        rhs = (leaf_oh[:, :, None] * stats_blk[:, None, :]) \
+            .reshape(c, num_leaves * 3)            # (C, 3L)
 
-    contrib = jnp.dot(lhs, onehot,
-                      preferred_element_type=jnp.float32)  # (3L, Fc*B)
+    contrib = jnp.dot(onehot, rhs,
+                      preferred_element_type=jnp.float32)  # (fc*B, 3L)
 
     @pl.when(r == 0)
     def _():
@@ -73,11 +82,19 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     with weight 0 (padding/bagging) contribute nothing.
     """
     n, f = bins.shape
-    c = min(ROW_CHUNK, max(8, n))
-    fc = min(FEAT_CHUNK, f)
 
+    # row chunk: one full chunk for small inputs, else ROW_CHUNK slices
+    if n >= ROW_CHUNK:
+        c = ROW_CHUNK
+    else:
+        c = n + ((-n) % 8)          # single chunk, sublane-aligned
     pad_rows = (-n) % c
+
+    # feature chunk: bounded so the VMEM one-hot block stays ~4 MB
+    fc = max(8, (VMEM_ONEHOT_ELEMS // max(num_bins, 1)) // 8 * 8)
+    fc = min(fc, f + ((-f) % 8))
     pad_feats = (-f) % fc
+
     if pad_rows:
         bins = jnp.pad(bins, ((0, pad_rows), (0, 0)))
         grad = jnp.pad(grad, (0, pad_rows))
@@ -88,9 +105,10 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         bins = jnp.pad(bins, ((0, 0), (0, pad_feats)))
     n_p, f_p = bins.shape
 
+    bins_t = bins.T                                      # (F_p, N_p)
     stats = jnp.stack([grad * weight, hess * weight, weight],
-                      axis=1).astype(jnp.float32)       # (N, 3)
-    leaf2 = leaf_of_row.astype(jnp.int32)[:, None]       # (N, 1)
+                      axis=1).astype(jnp.float32)        # (N_p, 3)
+    leaf2 = leaf_of_row.astype(jnp.int32)[:, None]       # (N_p, 1)
 
     grid = (f_p // fc, n_p // c)
     out = pl.pallas_call(
@@ -98,18 +116,19 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                           num_bins=num_bins),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((c, fc), lambda fi, ri: (ri, fi)),
+            pl.BlockSpec((fc, c), lambda fi, ri: (fi, ri)),
             pl.BlockSpec((c, 3), lambda fi, ri: (ri, 0)),
             pl.BlockSpec((c, 1), lambda fi, ri: (ri, 0)),
         ],
-        out_specs=pl.BlockSpec((3 * num_leaves, fc * num_bins),
-                               lambda fi, ri: (0, fi)),
+        out_specs=pl.BlockSpec((fc * num_bins, 3 * num_leaves),
+                               lambda fi, ri: (fi, 0)),
         out_shape=jax.ShapeDtypeStruct(
-            (3 * num_leaves, f_p * num_bins), jnp.float32),
+            (f_p * num_bins, 3 * num_leaves), jnp.float32),
         interpret=interpret,
-    )(bins, stats, leaf2)
+    )(bins_t, stats, leaf2)
 
-    hist = out.reshape(3, num_leaves, f_p, num_bins)
+    # (F_p*B, 3L) -> (3, L, F, B)
+    hist = out.reshape(f_p, num_bins, num_leaves, 3).transpose(3, 2, 0, 1)
     if pad_feats:
         hist = hist[:, :, :f, :]
     return hist

@@ -1,0 +1,16 @@
+"""Pretrained-graph ingestion.
+
+The reference's inference story rests on loading *externally trained*
+graphs — CNTKModel deserializes a trained CNTK Function
+(ref: src/cntk-model/src/main/scala/CNTKModel.scala:147,
+SerializableFunction.scala:85) and ModelDownloader fetches CNN zoo models
+(ref: src/downloader/src/main/scala/ModelDownloader.scala:209). The
+TPU-native equivalent ingests torch checkpoints (state_dicts) into flax
+variable pytrees for the zoo network specs.
+"""
+
+from mmlspark_tpu.importers.torch_import import (
+    import_torch_checkpoint, load_torch_file,
+)
+
+__all__ = ["import_torch_checkpoint", "load_torch_file"]

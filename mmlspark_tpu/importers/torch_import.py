@@ -1,0 +1,252 @@
+"""torch state_dict -> flax variables importer.
+
+The TPU-native analog of the reference's pretrained-graph ingestion
+(ref: src/cntk-model/.../CNTKModel.scala:147 deserializes a trained CNTK
+Function; ModelDownloader.scala:209 fetches zoo CNNs): weights trained
+*outside* this framework become flax variable pytrees for the zoo network
+specs (models/networks.build_network), after which TPUModel /
+ImageFeaturizer serve them like any native model.
+
+Layout conversions (torch -> flax):
+  - Conv2d weight  (O, I, kH, kW) -> kernel (kH, kW, I, O)
+  - Linear weight  (O, I)         -> kernel (I, O)
+  - BatchNorm weight/bias         -> scale/bias params;
+    running_mean/running_var      -> batch_stats mean/var
+  - Embedding weight              -> embedding (unchanged)
+
+Name conventions accepted per family:
+  - resnet: torchvision CIFAR-ResNet style — ``conv1``/``bn1`` stem,
+    ``layer{s+1}.{b}.conv1/bn1/conv2/bn2[/downsample.0/.1]``, ``fc`` head.
+  - convnet: ``conv{i}``, ``dense{i}``, ``head``.
+  - mlp: ``dense{i}``, ``head``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _to_numpy(t: Any) -> np.ndarray:
+    if hasattr(t, "detach"):          # torch tensor
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def _conv_kernel(t: Any) -> np.ndarray:
+    """torch OIHW -> flax HWIO."""
+    return np.transpose(_to_numpy(t), (2, 3, 1, 0))
+
+
+def _linear_kernel(t: Any) -> np.ndarray:
+    """torch (out, in) -> flax (in, out)."""
+    return np.transpose(_to_numpy(t))
+
+
+def load_torch_file(path: str) -> Dict[str, Any]:
+    """Load a .pt/.pth checkpoint to a flat state_dict (CPU tensors)."""
+    import torch
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    return obj
+
+
+class _TreeBuilder:
+    """Accumulates nested params/batch_stats trees and tracks which
+    state_dict keys were consumed (unused keys are an import error —
+    silent drops are how weight-porting bugs hide)."""
+
+    def __init__(self, sd: Dict[str, Any]):
+        self.sd = dict(sd)
+        self.used: set = set()
+        self.params: Dict[str, Any] = {}
+        self.stats: Dict[str, Any] = {}
+
+    def take(self, key: str) -> Any:
+        if key not in self.sd:
+            raise KeyError(
+                f"torch checkpoint is missing {key!r}; available keys "
+                f"include {sorted(self.sd)[:8]}...")
+        self.used.add(key)
+        return self.sd[key]
+
+    def has(self, key: str) -> bool:
+        return key in self.sd
+
+    def _set(self, tree: Dict[str, Any], path: List[str], val: np.ndarray):
+        node = tree
+        for part in path[:-1]:
+            node = node.setdefault(part, {})
+        node[path[-1]] = val
+
+    def conv(self, flax_path: List[str], torch_name: str,
+             bias: bool = False):
+        self._set(self.params, flax_path + ["kernel"],
+                  _conv_kernel(self.take(f"{torch_name}.weight")))
+        if bias:
+            self._set(self.params, flax_path + ["bias"],
+                      _to_numpy(self.take(f"{torch_name}.bias")))
+
+    def linear(self, flax_path: List[str], torch_name: str):
+        self._set(self.params, flax_path + ["kernel"],
+                  _linear_kernel(self.take(f"{torch_name}.weight")))
+        self._set(self.params, flax_path + ["bias"],
+                  _to_numpy(self.take(f"{torch_name}.bias")))
+
+    def batchnorm(self, flax_path: List[str], torch_name: str):
+        self._set(self.params, flax_path + ["scale"],
+                  _to_numpy(self.take(f"{torch_name}.weight")))
+        self._set(self.params, flax_path + ["bias"],
+                  _to_numpy(self.take(f"{torch_name}.bias")))
+        self._set(self.stats, flax_path + ["mean"],
+                  _to_numpy(self.take(f"{torch_name}.running_mean")))
+        self._set(self.stats, flax_path + ["var"],
+                  _to_numpy(self.take(f"{torch_name}.running_var")))
+
+    def finish(self, strict: bool = True) -> Dict[str, Any]:
+        if strict:
+            unused = [k for k in self.sd
+                      if k not in self.used
+                      and not k.endswith("num_batches_tracked")]
+            if unused:
+                raise ValueError(
+                    f"torch checkpoint keys not consumed by the import "
+                    f"(shape/name mismatch?): {sorted(unused)}")
+        out: Dict[str, Any] = {"params": self.params}
+        if self.stats:
+            out["batch_stats"] = self.stats
+        return out
+
+
+def _import_resnet(sd: Dict[str, Any], spec: Dict[str, Any],
+                   strict: bool,
+                   input_shape: Optional[List[int]]) -> Dict[str, Any]:
+    b = _TreeBuilder(sd)
+    b.conv(["stem"], "conv1")
+    b.batchnorm(["BatchNorm_0"], "bn1")
+    stage_sizes = list(spec.get("stage_sizes", (3, 3, 3)))
+    for s, n_blocks in enumerate(stage_sizes):
+        for blk in range(n_blocks):
+            t = f"layer{s + 1}.{blk}"
+            fx = f"stage{s}_block{blk}"
+            b.conv([fx, "Conv_0"], f"{t}.conv1")
+            b.batchnorm([fx, "BatchNorm_0"], f"{t}.bn1")
+            b.conv([fx, "Conv_1"], f"{t}.conv2")
+            b.batchnorm([fx, "BatchNorm_1"], f"{t}.bn2")
+            if b.has(f"{t}.downsample.0.weight"):
+                b.conv([fx, "proj"], f"{t}.downsample.0")
+                b.batchnorm([fx, "BatchNorm_2"], f"{t}.downsample.1")
+    b.linear(["head"], "fc")
+    return b.finish(strict)
+
+
+def _import_convnet(sd: Dict[str, Any], spec: Dict[str, Any],
+                    strict: bool,
+                    input_shape: Optional[List[int]]) -> Dict[str, Any]:
+    b = _TreeBuilder(sd)
+    conv_features = list(spec.get("conv_features", (64, 64, 64)))
+    pool_every = int(spec.get("pool_every", 1))
+    for i in range(len(conv_features)):
+        b.conv([f"conv_{i}"], f"conv{i}", bias=True)
+    for i in range(len(spec.get("dense_features", (256,)))):
+        b.linear([f"dense_{i}"], f"dense{i}")
+    b.linear(["head"], "head")
+    out = b.finish(strict)
+
+    # flatten-boundary fix: torch flattens NCHW (C,H,W order), flax
+    # flattens NHWC (H,W,C order) — permute the input dim of the first
+    # Dense after the flatten (dense_0, or the head when there are no
+    # dense layers). Needs the conv-stack output spatial shape, so
+    # input_shape is mandatory for convnet imports: skipping the
+    # permutation would load cleanly and predict garbage.
+    if input_shape is None:
+        raise ValueError(
+            "convnet imports require validate_input_shape (e.g. "
+            "[32, 32, 3]): the flatten-boundary NCHW->NHWC kernel "
+            "permutation needs the conv-stack output shape")
+    h, w, _ = input_shape
+    for i in range(len(conv_features)):
+        if (i + 1) % pool_every == 0:
+            h, w = h // 2, w // 2
+    c = conv_features[-1]
+    first_dense = "dense_0" if "dense_0" in out["params"] else "head"
+    k = out["params"][first_dense]["kernel"]          # (C*H*W, O)
+    if k.shape[0] != c * h * w:
+        raise ValueError(
+            f"{first_dense} kernel input dim {k.shape[0]} != "
+            f"C*H*W={c * h * w} from input_shape {input_shape}")
+    k = k.reshape(c, h, w, -1).transpose(1, 2, 0, 3).reshape(h * w * c, -1)
+    out["params"][first_dense]["kernel"] = k
+    return out
+
+
+def _import_mlp(sd: Dict[str, Any], spec: Dict[str, Any],
+                strict: bool,
+                input_shape: Optional[List[int]]) -> Dict[str, Any]:
+    b = _TreeBuilder(sd)
+    for i in range(len(spec.get("features", (256, 128)))):
+        b.linear([f"dense_{i}"], f"dense{i}")
+    b.linear(["head"], "head")
+    return b.finish(strict)
+
+
+_IMPORTERS = {
+    "resnet": _import_resnet,
+    "convnet": _import_convnet,
+    "mlp": _import_mlp,
+}
+
+
+def import_torch_checkpoint(state_dict: Any, network_spec: Dict[str, Any],
+                            strict: bool = True,
+                            validate_input_shape: Optional[List[int]] = None
+                            ) -> Dict[str, Any]:
+    """Convert a torch ``state_dict`` (dict or .pt path) to flax variables
+    for ``network_spec`` (a models/networks.build_network spec).
+
+    strict: fail on unconsumed checkpoint keys.
+    validate_input_shape: when given (e.g. [32, 32, 3]), init the flax
+    module on a dummy input and verify every imported array matches the
+    module's expected tree structure and shapes. Also required for
+    convnet imports (the flatten-boundary NCHW->NHWC permutation of the
+    first dense kernel needs the conv-stack output shape).
+    """
+    if isinstance(state_dict, str):
+        state_dict = load_torch_file(state_dict)
+    kind = network_spec.get("type")
+    if kind not in _IMPORTERS:
+        raise NotImplementedError(
+            f"no torch importer for network type {kind!r}; "
+            f"have {sorted(_IMPORTERS)}")
+    variables = _IMPORTERS[kind](state_dict, network_spec, strict,
+                                 validate_input_shape)
+
+    if validate_input_shape is not None:
+        _validate(variables, network_spec, validate_input_shape)
+    return variables
+
+
+def _validate(variables: Dict[str, Any], network_spec: Dict[str, Any],
+              input_shape: List[int]) -> None:
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models.networks import build_network
+    module = build_network(network_spec)
+    target = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros([1] + list(input_shape)))
+    t_paths = {tuple(str(p.key) for p in path): leaf.shape
+               for path, leaf in jax.tree_util.tree_leaves_with_path(target)}
+    v_paths = {tuple(str(p.key) for p in path): leaf.shape
+               for path, leaf in
+               jax.tree_util.tree_leaves_with_path(variables)}
+    missing = sorted(set(t_paths) - set(v_paths))
+    extra = sorted(set(v_paths) - set(t_paths))
+    bad = [(p, v_paths[p], t_paths[p]) for p in v_paths
+           if p in t_paths and tuple(v_paths[p]) != tuple(t_paths[p])]
+    if missing or extra or bad:
+        raise ValueError(
+            f"imported variables do not match module structure:\n"
+            f"  missing: {missing}\n  extra: {extra}\n"
+            f"  shape mismatches (path, got, want): {bad}")

@@ -15,6 +15,23 @@ from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.utils.file_utils import iter_binary_files
 
 
+def _iter_source(path: str, pattern=None, recursive=True, inspect_zip=True,
+                 sample_ratio=1.0, seed=0):
+    """Local dirs use the zip-inspecting iterator; remote schemes go
+    through the pluggable filesystem registry (ref: HadoopUtils /
+    HDFSRepo remote reads, ModelDownloader.scala:54-124)."""
+    from mmlspark_tpu.utils import filesystem as fslib
+    if fslib.scheme_of(path) == "file":
+        yield from iter_binary_files(
+            path if not path.startswith("file://") else path[7:],
+            pattern=pattern, recursive=recursive, inspect_zip=inspect_zip,
+            sample_ratio=sample_ratio, seed=seed)
+    else:
+        yield from fslib.iter_remote_binary_files(
+            path, pattern=pattern, recursive=recursive,
+            sample_ratio=sample_ratio, seed=seed)
+
+
 def read_binary_files(path: str,
                       recursive: bool = True,
                       pattern: Optional[str] = None,
@@ -24,7 +41,7 @@ def read_binary_files(path: str,
                       column_name: str = "value") -> DataTable:
     rows = [
         {column_name: BinaryFileSchema.make_row(p, data)}
-        for p, data in iter_binary_files(
+        for p, data in _iter_source(
             path, pattern=pattern, recursive=recursive,
             inspect_zip=inspect_zip, sample_ratio=sample_ratio, seed=seed)
     ]

@@ -16,7 +16,6 @@ import numpy as np
 
 from mmlspark_tpu.core.schema import ImageSchema, Schema
 from mmlspark_tpu.core.table import DataTable
-from mmlspark_tpu.utils.file_utils import iter_binary_files
 
 IMAGE_EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".tif",
                     ".tiff", ".webp")
@@ -72,10 +71,11 @@ def read_images(path: str,
                 seed: int = 0,
                 column_name: str = "image",
                 drop_undecodable: bool = True) -> DataTable:
+    from mmlspark_tpu.io.binary import _iter_source
     rows = []
-    for p, data in iter_binary_files(path, recursive=recursive,
-                                     inspect_zip=inspect_zip,
-                                     sample_ratio=sample_ratio, seed=seed):
+    for p, data in _iter_source(path, recursive=recursive,
+                                inspect_zip=inspect_zip,
+                                sample_ratio=sample_ratio, seed=seed):
         if not p.lower().endswith(IMAGE_EXTENSIONS):
             continue
         img = decode_image(data)

@@ -166,6 +166,9 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
     resume = BoolParam("resume from latest checkpoint if present",
                        default=True)
     logEvery = IntParam("steps between loss logs", default=50)
+    profileDir = StringParam(
+        "emit a jax.profiler xplane trace of the training loop here "
+        "('' = off; SURVEY §5 profiler upgrade)", default="")
 
     def _post_init(self):
         self._mesh: Optional[Mesh] = None
@@ -362,7 +365,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                 x[idx], batch_size, axis=0)
             by, _ = mesh_lib.pad_to_multiple(y[idx], batch_size, axis=0)
             w = (np.arange(batch_size) < true_len).astype(np.float32)
-            return epoch, step, {
+            return epoch, step, true_len, {
                 "x": jax.device_put(bx, data_sharding["x"]),
                 "y": jax.device_put(by, data_sharding["y"]),
                 "w": jax.device_put(w, data_sharding["w"]),
@@ -381,24 +384,31 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                                      "epoch": epoch_, "time": t})
                 logger.info("step %d/%d loss %.4f", step_, total_steps, lv)
 
+        from mmlspark_tpu.utils.profiling import maybe_trace
+
         global_step = start_step
         t_first = None
+        examples_timed = 0   # true (unpadded) rows after the warmup step
         feed = ThreadedPrefetcher(index_stream(), make_batch, depth=2)
         try:
-            for epoch, global_step, batch in feed:
-                state, loss = jit_step(state, batch)
-                if t_first is None:
-                    # block on the compile+first step so steady-state
-                    # timing starts after warmup
-                    loss.block_until_ready()
-                    t_first = _time.time()
-                    first_timed_step = global_step
-                if global_step % log_every == 0 or \
-                        global_step == total_steps:
-                    pending.append((global_step, epoch, loss, _time.time()))
-                    flush_logs()
-                if ckpt_dir and global_step % ckpt_every == 0:
-                    _save_checkpoint(ckpt_dir, global_step, state)
+            with maybe_trace(self.get("profileDir")):
+                for epoch, global_step, true_len, batch in feed:
+                    state, loss = jit_step(state, batch)
+                    if t_first is None:
+                        # block on the compile+first step so steady-state
+                        # timing starts after warmup
+                        loss.block_until_ready()
+                        t_first = _time.time()
+                        first_timed_step = global_step
+                    else:
+                        examples_timed += true_len
+                    if global_step % log_every == 0 or \
+                            global_step == total_steps:
+                        pending.append(
+                            (global_step, epoch, loss, _time.time()))
+                        flush_logs()
+                    if ckpt_dir and global_step % ckpt_every == 0:
+                        _save_checkpoint(ckpt_dir, global_step, state)
         finally:
             # abnormal exit must not leave the worker blocked in put()
             # pinning prefetched batches in HBM
@@ -411,8 +421,10 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             self.timing = {
                 "steps_timed": steps_timed,
                 "wall_s": t_end - t_first,
+                # true rows only — padding of partial batches is masked
+                # compute, counting it would inflate the metric
                 "examples_per_sec":
-                    steps_timed * batch_size / max(t_end - t_first, 1e-9),
+                    examples_timed / max(t_end - t_first, 1e-9),
             }
         if ckpt_dir:
             _save_checkpoint(ckpt_dir, global_step, state)

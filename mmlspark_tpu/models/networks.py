@@ -98,12 +98,18 @@ class ResNetBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        # explicit symmetric (1,1) padding: identical to SAME at stride 1,
+        # and matches torch's padding=1 at stride 2 (XLA SAME would pad
+        # asymmetrically there), so imported torch checkpoints
+        # (importers/torch_import.py) reproduce bit-comparable activations
+        pad = ((1, 1), (1, 1))
         residual = x
-        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False,
-                    dtype=self.dtype)(x)
+        y = nn.Conv(self.features, (3, 3), self.strides, padding=pad,
+                    use_bias=False, dtype=self.dtype)(x)
         y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(y)
         y = nn.relu(y)
-        y = nn.Conv(self.features, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        y = nn.Conv(self.features, (3, 3), padding=pad, use_bias=False,
+                    dtype=self.dtype)(y)
         y = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
                          scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:

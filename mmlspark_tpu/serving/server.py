@@ -226,16 +226,49 @@ class ServingEngine:
     def __init__(self, source: HTTPSource, pipeline: Transformer,
                  reply_col: str = "reply", id_col: str = "id",
                  batch_size: int = 64,
-                 content_type: str = "application/json"):
+                 content_type: str = "application/json",
+                 error_col: str = "error"):
         self.source = source
         self.pipeline = pipeline
         self.reply_col = reply_col
         self.id_col = id_col
         self.batch_size = batch_size
         self.content_type = content_type
+        self.error_col = error_col
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.batches_processed = 0
+
+    def _respond_ok(self, rid: str, rep: Any) -> None:
+        body = rep if isinstance(rep, (bytes, str)) \
+            else json.dumps(_to_jsonable(rep))
+        self.source.respond(rid, HTTPSchema.response(
+            200, "OK", body if isinstance(body, bytes)
+            else body.encode("utf-8"),
+            {"Content-Type": self.content_type}))
+
+    def _answer_output(self, out: DataTable, ids: List[str]) -> None:
+        """Answer one transformed batch, splitting per-row errors: a
+        non-null ``error_col`` value means that row failed and gets a
+        500 while its batchmates still get their 200s
+        (ref: SimpleHTTPTransformer.scala:104-150 error-split pipeline)."""
+        replies = out[self.reply_col]
+        out_ids = out[self.id_col]
+        errors = (out[self.error_col]
+                  if self.error_col in out.column_names else None)
+        answered = set()
+        for i, (rid, rep) in enumerate(zip(out_ids, replies)):
+            err = errors[i] if errors is not None else None
+            if err is not None and err == err:  # non-null, non-NaN
+                self.source.respond(rid, HTTPSchema.response(
+                    500, f"row error: {err}", None))
+            else:
+                self._respond_ok(rid, rep)
+            answered.add(rid)
+        for rid in ids:
+            if rid not in answered:
+                self.source.respond(rid, HTTPSchema.response(
+                    500, "row dropped by pipeline", None))
 
     def process_one_batch(self, wait_s: float = 0.05) -> int:
         table, ids = self.source.get_batch(self.batch_size, wait_s)
@@ -243,34 +276,45 @@ class ServingEngine:
             return 0
         try:
             out = self.pipeline.transform(table)
-            replies = out[self.reply_col]
-            out_ids = out[self.id_col]
-        except Exception as e:  # noqa: BLE001 — errors become 500s
-            log.warning("serving pipeline failed: %s", e)
+        except Exception as e:  # noqa: BLE001 — isolate the poison row(s)
+            log.warning("serving batch failed (%s); retrying per-row", e)
+            self._process_rows_individually(table, ids)
+            self.batches_processed += 1
+            return len(ids)
+        try:
+            self._answer_output(out, ids)
+        except Exception as e:  # noqa: BLE001 — e.g. missing reply column
+            log.warning("answering batch failed (%s); sending 500s", e)
             for rid in ids:
                 self.source.respond(rid, HTTPSchema.response(
-                    500, f"pipeline error: {e}", None))
-            return len(ids)
-        answered = set()
-        for rid, rep in zip(out_ids, replies):
-            body = rep if isinstance(rep, (bytes, str)) \
-                else json.dumps(_to_jsonable(rep))
-            self.source.respond(rid, HTTPSchema.response(
-                200, "OK", body if isinstance(body, bytes)
-                else body.encode("utf-8"),
-                {"Content-Type": self.content_type}))
-            answered.add(rid)
-        for rid in ids:
-            if rid not in answered:
-                self.source.respond(rid, HTTPSchema.response(
-                    500, "row dropped by pipeline", None))
+                    500, f"reply error: {e}", None))
         self.batches_processed += 1
         return len(ids)
+
+    def _process_rows_individually(self, table: DataTable,
+                                   ids: List[str]) -> None:
+        """Batch-failure fallback: run each row alone so one poison
+        request cannot 500 its batchmates (the per-row half of the
+        reference's error isolation, SimpleHTTPTransformer.scala:104-150)."""
+        requests = table["request"]
+        for rid, req in zip(ids, requests):
+            row = DataTable({"id": [rid], "request": [req]})
+            try:
+                out = self.pipeline.transform(row)
+                self._answer_output(out, [rid])
+            except Exception as e:  # noqa: BLE001
+                self.source.respond(rid, HTTPSchema.response(
+                    500, f"pipeline error: {e}", None))
 
     def start(self) -> "ServingEngine":
         def loop():
             while not self._stop.is_set():
-                if self.process_one_batch() == 0:
+                try:
+                    n = self.process_one_batch()
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    log.error("serving loop error (continuing): %s", e)
+                    n = 0
+                if n == 0:
                     time.sleep(0.005)
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -243,17 +243,24 @@ class Timer(Estimator):
 
     stage = StageParam("the wrapped stage", default=None)
     logToScala = BoolParam("log through framework logger", default=True)
+    traceDir = StringParam(
+        "emit a jax.profiler xplane trace of the wrapped stage here "
+        "(SURVEY §5: the profiler upgrade over wall-clock logging)",
+        default="")
 
     def fit(self, table: DataTable) -> "TimerModel":
+        from mmlspark_tpu.utils.profiling import maybe_trace
         inner = self.get("stage")
         if isinstance(inner, Estimator):
             t0 = time.time()
-            fitted = inner.fit(table)
+            with maybe_trace(self.get("traceDir")):
+                fitted = inner.fit(table)
             self._log(f"fit of {type(inner).__name__} took "
                       f"{time.time()-t0:.3f}s")
         else:
             fitted = inner
-        return TimerModel(stage=fitted, logToScala=self.get("logToScala"))
+        return TimerModel(stage=fitted, logToScala=self.get("logToScala"),
+                          traceDir=self.get("traceDir"))
 
     def transform(self, table: DataTable) -> DataTable:
         """Convenience for wrapping a pure Transformer outside a
@@ -271,11 +278,15 @@ class Timer(Estimator):
 class TimerModel(Model):
     stage = StageParam("the fitted wrapped stage", default=None)
     logToScala = BoolParam("log through framework logger", default=True)
+    traceDir = StringParam("emit a jax.profiler xplane trace here",
+                           default="")
 
     def transform(self, table: DataTable) -> DataTable:
+        from mmlspark_tpu.utils.profiling import maybe_trace
         inner = self.get("stage")
         t0 = time.time()
-        out = inner.transform(table)
+        with maybe_trace(self.get("traceDir")):
+            out = inner.transform(table)
         if self.get("logToScala"):
             log.info(f"transform of {type(inner).__name__} took "
                      f"{time.time()-t0:.3f}s")

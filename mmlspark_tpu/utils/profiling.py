@@ -1,0 +1,35 @@
+"""Profiler integration.
+
+The reference's only tracing is the Timer stage's wall-clock logging
+(ref: src/pipeline-stages/src/main/scala/Timer.scala:54); SURVEY §5 marks
+jax-profiler/xplane integration as the intended TPU upgrade. Any stage
+(Timer's ``traceDir``, TPULearner's ``profileDir``) can wrap its hot
+section in ``maybe_trace`` to emit a TensorBoard-loadable xplane trace of
+the real device timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+from typing import Iterator, List, Optional
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """jax.profiler.trace(trace_dir) when a directory is given, else a
+    no-op — callers wrap unconditionally and the param decides."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def trace_files(trace_dir: str) -> List[str]:
+    """The xplane protobufs a trace run produced (for tests/tools)."""
+    return sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))

@@ -85,6 +85,102 @@ class TestServing:
         finally:
             engine.stop()
 
+    def test_poison_row_isolated_from_batch(self):
+        # one poison request must NOT 500 its batchmates: the engine
+        # retries the failed batch per-row
+        # (ref: SimpleHTTPTransformer.scala:104-150 error split)
+        def handle(table):
+            replies = []
+            for req in table["request"]:
+                body = json.loads(req["entity"].decode())
+                if body.get("boom"):
+                    raise RuntimeError("poison row")
+                replies.append({"ok": body["x"]})
+            return table.with_column("reply", replies)
+
+        engine = serve_model(Lambda.apply(handle), port=18985, batch_size=8)
+        try:
+            results: dict = {}
+
+            def client(i):
+                payload = {"boom": True} if i == 3 else {"x": i}
+                try:
+                    results[i] = _post(engine.source.address, payload)[1]
+                except urllib.error.HTTPError as e:
+                    results[i] = e.code
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results[3] == 500
+            for i in range(6):
+                if i != 3:
+                    assert results[i] == {"ok": i}, results
+        finally:
+            engine.stop()
+
+    def test_error_col_splits_rows(self):
+        # pipelines can flag per-row failures via an 'error' column
+        # instead of raising (the errorCol convention of the reference)
+        def handle(table):
+            replies, errors = [], []
+            for req in table["request"]:
+                body = json.loads(req["entity"].decode())
+                if body["x"] < 0:
+                    replies.append(None)
+                    errors.append(f"negative x {body['x']}")
+                else:
+                    replies.append({"ok": body["x"]})
+                    errors.append(None)
+            return (table.with_column("reply", replies)
+                    .with_column("error", errors))
+
+        engine = serve_model(Lambda.apply(handle), port=18990, batch_size=8)
+        try:
+            assert _post(engine.source.address, {"x": 5})[1] == {"ok": 5}
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(engine.source.address, {"x": -1})
+            assert ei.value.code == 500
+        finally:
+            engine.stop()
+
+    def test_two_engines_two_ports(self):
+        # the documented multi-host story: one serving engine per host
+        # behind a load balancer — two engines, same pipeline, different
+        # ports; replies route through the engine that accepted them
+        def handle(table):
+            return table.with_column("reply", [
+                {"via": "pipeline",
+                 "x": json.loads(r["entity"].decode())["x"]}
+                for r in table["request"]])
+
+        e1 = serve_model(Lambda.apply(handle), port=18994, batch_size=4)
+        e2 = serve_model(Lambda.apply(handle), port=18996, batch_size=4)
+        try:
+            assert e1.source.port != e2.source.port
+            results = {}
+
+            def client(i):
+                # round-robin "load balancer"
+                engine = e1 if i % 2 == 0 else e2
+                results[i] = _post(engine.source.address, {"x": i})[1]["x"]
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results == {i: i for i in range(10)}
+            assert e1.source.requests_answered >= 5
+            assert e2.source.requests_answered >= 5
+        finally:
+            e1.stop()
+            e2.stop()
+
     def test_port_scan_on_conflict(self, echo_server):
         # same base port: must scan to the next free one
         src2 = HTTPSource(port=echo_server.source.port)

@@ -108,6 +108,18 @@ def test_regression_mse():
     assert resid < 0.2, f"relative mse {resid}"
 
 
+def test_profile_dir_emits_trace(tmp_path):
+    from mmlspark_tpu.utils.profiling import trace_files
+    t = _toy_table()
+    trace_dir = str(tmp_path / "prof")
+    learner = TPULearner(
+        networkSpec={"type": "mlp", "features": [8], "num_classes": 4},
+        epochs=1, batchSize=64, computeDtype="float32",
+        logEvery=1000, profileDir=trace_dir)
+    learner.fit(t)
+    assert trace_files(trace_dir), "no xplane trace emitted by training"
+
+
 def test_checkpoint_resume(tmp_path):
     t = _toy_table(seed=4)
     ck = str(tmp_path / "ckpt")

@@ -88,6 +88,22 @@ class TestBasicStages:
         model = timed.fit(basic_table)
         assert "weight" in model.transform(basic_table).column_names
 
+    def test_timer_emits_profiler_trace(self, basic_table, tmp_path):
+        # SURVEY §5: Timer upgrades the reference's wall-clock logging
+        # (Timer.scala:54) to a real jax.profiler xplane trace
+        from mmlspark_tpu.utils.profiling import trace_files
+
+        class _Jitted(DropColumns):
+            def transform(self, table):
+                import jax, jax.numpy as jnp  # noqa: E401
+                jax.jit(lambda v: v * 2)(jnp.ones(8)).block_until_ready()
+                return super().transform(table)
+
+        trace_dir = str(tmp_path / "trace")
+        Timer(stage=_Jitted(cols=["lists"]),
+              traceDir=trace_dir).transform(basic_table)
+        assert trace_files(trace_dir), "no xplane trace emitted"
+
     def test_timer_in_pipeline_fits_once(self, basic_table):
         # regression: Timer must be an Estimator so the pipeline stores
         # the FITTED inner model, not a refit-on-transform wrapper
