@@ -164,6 +164,8 @@ class HTTPRepo:
     layout LocalRepo publishes: ``<base>/index.json`` + ``<name>.msgpack``.
     """
 
+    handles_retries = True   # retry policy lives in the HTTP filesystem
+
     def __init__(self, base_url: str, retries: int = 3):
         self.base_url = base_url.rstrip("/")
         self._fs = None
@@ -227,9 +229,13 @@ class ModelDownloader:
             raise KeyError(
                 f"model {name!r} not cached and no remote repo configured")
         schema = self.repo.get_schema(name)
-        # each repo owns its retry policy (HTTPRepo retries in its
-        # filesystem layer); wrapping again here would multiply attempts
-        blob = self.repo.read_blob(schema)
+        # retry here UNLESS the repo declares it retries internally
+        # (HTTPRepo does, in its filesystem layer — wrapping again would
+        # multiply attempts; custom repos keep the default 3x backoff)
+        if getattr(self.repo, "handles_retries", False):
+            blob = self.repo.read_blob(schema)
+        else:
+            blob = retry_with_backoff(lambda: self.repo.read_blob(schema))
         return self.local.publish(
             name, schema.network_spec, blob=blob,
             dataset=schema.dataset, model_type=schema.model_type,

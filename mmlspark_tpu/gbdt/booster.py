@@ -23,7 +23,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mmlspark_tpu.gbdt.binning import BinMapper
@@ -298,7 +298,6 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
             (K, n_padded))
 
     rng = np.random.default_rng(p["seed"])
-    trees_dev: List[Tree] = []   # stays on device until the final stack
 
     # validation state — device-resident; the held-out set is scored
     # through the *binned* feature view (same comparisons training uses)
@@ -322,37 +321,56 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
         else int(p["num_leaves"]) - 1
 
     n_iter = int(p["num_iterations"])
-    w_iter = w_pad  # current bag persists between resamples
+    M = 2 * int(p["num_leaves"]) - 1
+    # power-of-two capacity bucket: the forest buffer shape feeds the
+    # jitted step, so tying it exactly to num_iterations would recompile
+    # for every distinct iteration count (buffers here are tiny)
+    t_cap = max(64, 1 << (n_iter * K - 1).bit_length())
+    # the whole forest lives on device: K trees are written per step at
+    # a traced row offset, one device_get fetches everything at the end
+    _f_dtypes = {"feature": jnp.int32, "bin_threshold": jnp.int32,
+                 "threshold": jnp.float32, "left": jnp.int32,
+                 "right": jnp.int32, "value": jnp.float32,
+                 "is_leaf": jnp.bool_, "gain": jnp.float32,
+                 "count": jnp.float32}
+    forest = Tree(**{fld: jnp.zeros((t_cap, M), dt)
+                     for fld, dt in _f_dtypes.items()})
+
+    bag_active = p["bagging_fraction"] < 1.0 and p["bagging_freq"] > 0
+    ff_active = p["feature_fraction"] < 1.0
+    w_d = _maybe_shard(jnp.asarray(w_pad, jnp.float32), mesh,
+                       data_parallel)
+    fmask = jnp.ones(f, jnp.float32)
+    trees_done = 0
     for it in range(n_iter):
         # bagging (ref: TrainParams baggingFraction/baggingFreq —
         # LightGBM resamples every `freq` iters and reuses the bag between)
-        if p["bagging_fraction"] < 1.0 and p["bagging_freq"] > 0 \
-                and it % p["bagging_freq"] == 0:
+        if bag_active and it % p["bagging_freq"] == 0:
             keep = rng.random(n_padded) < p["bagging_fraction"]
-            w_iter = w_pad * keep
-        w_d = _maybe_shard(jnp.asarray(w_iter, jnp.float32), mesh,
-                           data_parallel)
+            w_d = _maybe_shard(jnp.asarray(w_pad * keep, jnp.float32),
+                               mesh, data_parallel)
 
         # feature subsampling per tree
-        if p["feature_fraction"] < 1.0:
+        if ff_active:
             k = max(1, int(np.ceil(p["feature_fraction"] * f)))
             chosen = rng.choice(f, size=k, replace=False)
             fmask_np = np.zeros(f, np.float32)
             fmask_np[chosen] = 1.0
-        else:
-            fmask_np = np.ones(f, np.float32)
-        fmask = jnp.asarray(fmask_np)
+            fmask = jnp.asarray(fmask_np)
 
-        scores, class_trees = step_fn(bins_d, scores, y_d, w_d, fmask)
-        trees_dev.extend(class_trees)
+        scores, forest = step_fn(bins_d, scores, y_d, w_d, fmask,
+                                 forest, jnp.int32(it * K))
+        trees_done = (it + 1) * K
 
         if use_valid:
+            row = jnp.int32(it * K)
             for k_cls in range(K):
-                t = class_trees[k_cls]
+                sl = lambda a: lax.dynamic_slice_in_dim(  # noqa: E731
+                    a, row + k_cls, 1, axis=0)
                 tv = predict_trees(
-                    bins_v, t.feature[None],
-                    t.bin_threshold.astype(jnp.float32)[None],
-                    t.left[None], t.right[None], t.value[None],
+                    bins_v, sl(forest.feature),
+                    sl(forest.bin_threshold).astype(jnp.float32),
+                    sl(forest.left), sl(forest.right), sl(forest.value),
                     max_depth=valid_depth)
                 v_scores = v_scores.at[k_cls].add(lr * tv[0])
             vs = v_scores[0] if K == 1 else v_scores
@@ -362,12 +380,10 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
             elif it + 1 - best_iter >= esr:
                 break
 
-    if trees_dev:
+    if trees_done:
         # one device->host transfer for the whole forest
-        stacked_d = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
-                                           *trees_dev)
-        stacked = {name: np.asarray(arr)
-                   for name, arr in stacked_d._asdict().items()}
+        host = jax.device_get(forest._asdict())
+        stacked = {name: arr[:trees_done] for name, arr in host.items()}
         # bin threshold -> raw value threshold, one vectorized gather
         thr_lut = mapper.threshold_matrix(num_bins)          # (F, B)
         thr = thr_lut[stacked["feature"], stacked["bin_threshold"]]
@@ -419,28 +435,34 @@ def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
     objective = get_objective(name, num_class=num_class, alpha=alpha,
                               tweedie_variance_power=rho)
 
-    def step(bins, scores, y, w, fmask):
+    def step(bins, scores, y, w, fmask, forest, base):
+        """forest: Tree of (T_cap, M) buffers; the K grown trees are
+        written at rows base..base+K-1 ON DEVICE — no per-iteration
+        host transfer or stacking (one device_get fetches the whole
+        forest after the loop)."""
         score_in = scores[0] if K == 1 else scores
         grad, hess = objective.grad_hess(score_in, y)
         if K == 1:
             grad, hess = grad[None, :], hess[None, :]
         new_scores = scores
-        trees_out = []
         for k in range(K):
             tree, leaf_of_row, leaf_vals, _ = grow_tree(
                 bins, grad[k], hess[k], w, fmask, gp, axis_name)
             new_scores = new_scores.at[k].add(lr * leaf_vals[leaf_of_row])
-            trees_out.append(tree)
-        return new_scores, tuple(trees_out)
+            forest = Tree(*[
+                getattr(forest, fld).at[base + k].set(getattr(tree, fld))
+                for fld in Tree._fields])
+        return new_scores, forest
 
     if axis_name is None:
-        return jax.jit(step)
+        return jax.jit(step, donate_argnums=(1, 5))
 
     d = mesh_lib.DATA_AXIS
     tree_spec = Tree(*([P()] * len(Tree._fields)))
     mapped = shard_map(
         step, mesh=mesh,
-        in_specs=(P(d, None), P(None, d), P(d), P(d), P(None)),
-        out_specs=(P(None, d), tuple(tree_spec for _ in range(K))),
+        in_specs=(P(d, None), P(None, d), P(d), P(d), P(None),
+                  tree_spec, P()),
+        out_specs=(P(None, d), tree_spec),
         check_vma=False)
-    return jax.jit(mapped)
+    return jax.jit(mapped, donate_argnums=(1, 5))

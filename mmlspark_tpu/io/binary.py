@@ -19,17 +19,36 @@ def _iter_source(path: str, pattern=None, recursive=True, inspect_zip=True,
                  sample_ratio=1.0, seed=0):
     """Local dirs use the zip-inspecting iterator; remote schemes go
     through the pluggable filesystem registry (ref: HadoopUtils /
-    HDFSRepo remote reads, ModelDownloader.scala:54-124)."""
+    HDFSRepo remote reads, ModelDownloader.scala:54-124). Zip archives
+    are descended into on both paths."""
+    import fnmatch
+    import io as _io
+    import zipfile
+
     from mmlspark_tpu.utils import filesystem as fslib
     if fslib.scheme_of(path) == "file":
         yield from iter_binary_files(
-            path if not path.startswith("file://") else path[7:],
+            fslib.LocalFileSystem._strip(path),
             pattern=pattern, recursive=recursive, inspect_zip=inspect_zip,
             sample_ratio=sample_ratio, seed=seed)
-    else:
-        yield from fslib.iter_remote_binary_files(
-            path, pattern=pattern, recursive=recursive,
-            sample_ratio=sample_ratio, seed=seed)
+        return
+    for p, data in fslib.iter_remote_binary_files(
+            path, pattern=None if inspect_zip else pattern,
+            recursive=recursive, sample_ratio=sample_ratio, seed=seed):
+        if inspect_zip and p.lower().endswith(".zip"):
+            with zipfile.ZipFile(_io.BytesIO(data)) as zf:
+                for info in zf.infolist():
+                    if info.is_dir():
+                        continue
+                    name = info.filename.rsplit("/", 1)[-1]
+                    if pattern and not fnmatch.fnmatch(name, pattern):
+                        continue
+                    yield f"{p}/{info.filename}", zf.read(info)
+        else:
+            leaf = p.rsplit("/", 1)[-1]
+            if pattern and not fnmatch.fnmatch(leaf, pattern):
+                continue
+            yield p, data
 
 
 def read_binary_files(path: str,

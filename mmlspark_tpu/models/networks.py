@@ -101,7 +101,10 @@ class ResNetBlock(nn.Module):
         # explicit symmetric (1,1) padding: identical to SAME at stride 1,
         # and matches torch's padding=1 at stride 2 (XLA SAME would pad
         # asymmetrically there), so imported torch checkpoints
-        # (importers/torch_import.py) reproduce bit-comparable activations
+        # (importers/torch_import.py) reproduce bit-comparable activations.
+        # NOTE: stride-2 numerics differ from pre-torch-compat builds;
+        # ResNet checkpoints saved before this change shift one pixel at
+        # stage entries and should be retrained or re-imported
         pad = ((1, 1), (1, 1))
         residual = x
         y = nn.Conv(self.features, (3, 3), self.strides, padding=pad,

@@ -7,8 +7,8 @@ from mmlspark_tpu.stages.basic import (
 )
 from mmlspark_tpu.stages.dataprep import (
     CleanMissingData, CleanMissingDataModel, DataConversion, EnsembleByKey,
-    MultiColumnAdapter, MultiColumnAdapterModel, PartitionSample,
-    SummarizeData, ValueIndexer, ValueIndexerModel,
+    FastVectorAssembler, MultiColumnAdapter, MultiColumnAdapterModel,
+    PartitionSample, SummarizeData, ValueIndexer, ValueIndexerModel,
 )
 from mmlspark_tpu.stages.image import (
     ImageSetAugmenter, ImageTransformer, UnrollImage,
@@ -25,8 +25,9 @@ __all__ = [
     "SelectColumns", "TextPreprocessor", "Timer", "TimerModel",
     "UDFTransformer",
     "CleanMissingData", "CleanMissingDataModel", "DataConversion",
-    "EnsembleByKey", "MultiColumnAdapter", "MultiColumnAdapterModel",
-    "PartitionSample", "SummarizeData", "ValueIndexer", "ValueIndexerModel",
+    "EnsembleByKey", "FastVectorAssembler", "MultiColumnAdapter",
+    "MultiColumnAdapterModel", "PartitionSample", "SummarizeData",
+    "ValueIndexer", "ValueIndexerModel",
     "ImageSetAugmenter", "ImageTransformer", "UnrollImage",
     "ImageFeaturizer",
     "CountVectorizer", "CountVectorizerModel", "HashingTF", "IDF",

@@ -384,3 +384,40 @@ class MultiColumnAdapterModel(Model):
         for stage in self.get("stages") or []:
             schema = stage.transform_schema(schema)
         return schema
+
+
+class FastVectorAssembler(Transformer, HasOutputCol):
+    """Assemble numeric/vector columns into one vector column without a
+    metadata walk (ref: src/core/spark/.../FastVectorAssembler.scala:23).
+
+    Scalars contribute one slot, array/vector columns contribute their
+    width; output is float32 (the device-boundary dtype). Null/NaN
+    handling matches the reference's assembler: NaNs pass through."""
+
+    inputCols = ListParam("columns to assemble", default=None)
+
+    def __init__(self, **kw):
+        kw.setdefault("outputCol", "features")
+        super().__init__(**kw)
+
+    def transform(self, table: DataTable) -> DataTable:
+        cols = self.get("inputCols")
+        if not cols:
+            raise ValueError("inputCols is not set")
+        parts = []
+        for c in cols:
+            v = table[c]
+            arr = (v if isinstance(v, np.ndarray)
+                   else np.asarray([np.asarray(x, dtype=np.float64)
+                                    for x in v]))
+            if arr.ndim == 1:
+                arr = arr[:, None]
+            parts.append(arr.astype(np.float32))
+        out = np.concatenate(parts, axis=1)
+        return table.with_column(self.get_output_col(), out,
+                                 Field(self.get_output_col(), VECTOR))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for c in self.get("inputCols") or []:
+            schema.require(c)
+        return schema.add_or_replace(Field(self.get_output_col(), VECTOR))

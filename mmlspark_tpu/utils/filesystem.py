@@ -104,6 +104,8 @@ class HTTPFileSystem(FileSystem):
         names = json.loads(self._fetch(f"{base}/_index.json").decode())
         out = []
         for name in names:
+            if not recursive and "/" in name:
+                continue   # nested entry — match local non-recursive
             leaf = name.rsplit("/", 1)[-1]
             if pattern is None or fnmatch.fnmatch(leaf, pattern):
                 out.append(f"{base}/{name}")

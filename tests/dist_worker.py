@@ -1,0 +1,66 @@
+"""Worker process for the multi-process jax.distributed test.
+
+Launched N times by tests/test_distributed.py — the TPU-native analog of
+the reference's distributed-without-a-cluster pattern (ref:
+LightGBMUtils.scala:110-118 local[*] partitions-as-nodes; SURVEY §4):
+real separate processes rendezvous at a coordinator, assemble one global
+device mesh, and run a psum across it.
+
+Usage: python dist_worker.py <coordinator_port> <process_id> <n_processes>
+"""
+
+import os
+import sys
+
+import jax
+
+# CPU backend with 2 virtual devices per process, configured before any
+# backend use (env vars don't work here — sitecustomize pins the platform)
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    port, pid, nproc = (int(a) for a in sys.argv[1:4])
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.parallel import distributed as dist
+
+    info = dist.initialize(f"127.0.0.1:{port}", num_processes=nproc,
+                           process_id=pid)
+    assert info.process_count == nproc, info
+    assert info.global_device_count == 2 * nproc, info
+    assert info.is_coordinator == (pid == 0)
+
+    # host-partitioned feeding: each process keeps its own row range
+    # (replaces HDFS staging + scp, ref: CNTKLearner.scala:123-140)
+    n_rows = 4 * nproc
+    table = DataTable({"x": np.arange(n_rows, dtype=np.float64)})
+    local = dist.shard_table_for_host(table, info)
+    local_x = np.asarray(local["x"], dtype=np.float32)
+    print(f"SHARD {pid} {','.join(str(int(v)) for v in local_x)}",
+          flush=True)
+
+    # one global mesh over every device of every process; psum rides the
+    # collective backend exactly like histogram/gradient allreduce
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    global_x = jax.make_array_from_process_local_data(sharding, local_x)
+
+    total = jax.jit(shard_map(
+        lambda v: lax.psum(jnp.sum(v), "data"),
+        mesh=mesh, in_specs=P("data"), out_specs=P()))(global_x)
+    print(f"PSUM {pid} {float(total):.1f}", flush=True)
+    print(f"OK {pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

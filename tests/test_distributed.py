@@ -1,0 +1,69 @@
+"""Multi-process jax.distributed rendezvous test.
+
+The reference fakes a cluster by making local[*] partitions act as nodes
+and running the real socket rendezvous + native allreduce ring in one
+machine (ref: LightGBMUtils.scala:110-118, :235-249). The TPU-native
+equivalent launches real OS processes that rendezvous at the
+jax.distributed coordinator, build one global device mesh, shard a table
+per host, and psum across every device of every process — giving
+parallel/distributed.py actual execution coverage (VERDICT item 6).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "dist_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_multiprocess_rendezvous_and_psum(nproc):
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(pid), str(nproc)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env)
+        for pid in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"distributed workers hung; partial: {outs}")
+
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
+        assert f"OK" in out
+
+    # every process must report the same global psum: sum(0..4n-1)
+    n_rows = 4 * nproc
+    expect = n_rows * (n_rows - 1) / 2
+    shards = {}
+    for rc, out, err in outs:
+        for line in out.splitlines():
+            if line.startswith("PSUM"):
+                _, pid, val = line.split()
+                assert float(val) == expect, line
+            if line.startswith("SHARD"):
+                _, pid, vals = line.split()
+                shards[int(pid)] = vals
+    # host shards are disjoint row ranges
+    assert len(shards) == nproc
+    all_rows = ",".join(shards[i] for i in range(nproc))
+    assert all_rows == ",".join(str(i) for i in range(n_rows))

@@ -138,8 +138,9 @@ def _register_all():
         Explode, HashingTF, IDF, ImageFeaturizer, ImageSetAugmenter,
         ImageTransformer, Lambda, MultiColumnAdapter, NGram,
         PartitionSample, RenameColumn, Repartition, SelectColumns,
-        StopWordsRemover, SummarizeData, TextFeaturizer, TextPreprocessor,
-        Timer, Tokenizer, UDFTransformer, UnrollImage, ValueIndexer,
+        FastVectorAssembler, StopWordsRemover, SummarizeData,
+        TextFeaturizer, TextPreprocessor, Timer, Tokenizer, UDFTransformer,
+        UnrollImage, ValueIndexer,
     )
 
     T = _num_table()
@@ -172,6 +173,9 @@ def _register_all():
     reg(lambda: TestObject(CheckpointData(), transform_table=_num_table()))
 
     # data prep
+    reg(lambda: TestObject(
+        FastVectorAssembler(inputCols=["num", "label"], outputCol="fv"),
+        transform_table=_num_table()))
     reg(lambda: TestObject(ValueIndexer(inputCol="cat", outputCol="ci"),
                            fit_table=_num_table()))
     reg(lambda: TestObject(

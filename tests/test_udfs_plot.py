@@ -1,0 +1,75 @@
+"""udfs + plot helpers + FastVectorAssembler
+(ref: src/udf/src/main/scala/udfs.scala:15-29,
+src/plot/src/main/python/plot.py,
+src/core/spark/.../FastVectorAssembler.scala:23)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import plot, udfs
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.stages import FastVectorAssembler, UDFTransformer
+
+
+class TestUdfs:
+    def test_to_vector(self):
+        v = udfs.to_vector([1, 2, 3])
+        assert v.dtype == np.float64 and list(v) == [1, 2, 3]
+
+    def test_get_value_at(self):
+        assert udfs.get_value_at(1)([5.0, 7.0, 9.0]) == 7.0
+
+    def test_with_udf_transformer(self):
+        t = DataTable({"vec": np.asarray([[1.0, 2.0], [3.0, 4.0]])})
+        out = UDFTransformer(inputCol="vec", outputCol="second",
+                             udf=udfs.get_value_at(1)).transform(t)
+        assert list(out["second"]) == [2.0, 4.0]
+
+    def test_table_helpers(self):
+        t = DataTable({"arr": [[1.0, 2.0], [3.0, 4.0]]})
+        t2 = udfs.table_to_vector(t, "arr", "vec")
+        assert t2["vec"].shape == (2, 2)
+        t3 = udfs.table_get_value_at(t2, "vec", "v0", 0)
+        assert list(t3["v0"]) == [1.0, 3.0]
+
+
+class TestPlot:
+    def test_confusion_matrix_saves(self, tmp_path):
+        t = DataTable({"y": np.asarray([0, 0, 1, 1, 1.0]),
+                       "yhat": np.asarray([0, 1, 1, 1, 0.0])})
+        p = str(tmp_path / "cm.png")
+        plot.confusion_matrix(t, "y", "yhat", path=p)
+        assert os.path.getsize(p) > 0
+
+    def test_roc_saves(self, tmp_path):
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 2, 200).astype(float)
+        score = y * 0.6 + rng.random(200) * 0.4
+        t = DataTable({"y": y, "score": score})
+        p = str(tmp_path / "roc.png")
+        plot.roc(t, "y", "score", path=p)
+        assert os.path.getsize(p) > 0
+
+
+class TestFastVectorAssembler:
+    def test_assembles_scalars_and_vectors(self):
+        t = DataTable({"a": np.asarray([1.0, 2.0]),
+                       "v": np.asarray([[3.0, 4.0], [5.0, 6.0]]),
+                       "b": np.asarray([7.0, 8.0])})
+        out = FastVectorAssembler(inputCols=["a", "v", "b"],
+                                  outputCol="features").transform(t)
+        np.testing.assert_allclose(out["features"],
+                                   [[1, 3, 4, 7], [2, 5, 6, 8]])
+
+    def test_schema(self):
+        t = DataTable({"a": np.asarray([1.0]), "b": np.asarray([2.0])})
+        stage = FastVectorAssembler(inputCols=["a", "b"])
+        schema = stage.transform_schema(t.schema)
+        assert "features" in schema.names
+
+    def test_requires_input_cols(self):
+        t = DataTable({"a": np.asarray([1.0])})
+        with pytest.raises(ValueError, match="inputCols"):
+            FastVectorAssembler().transform(t)
