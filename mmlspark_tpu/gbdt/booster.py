@@ -201,14 +201,43 @@ class Booster:
 # ---------------------------------------------------------------------------
 
 
-def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
+def _bin_stream(shards, max_bin: int, seed: int):
+    """Streaming ingestion: ``shards`` yields (X, y[, w]) tuples. Bin
+    boundaries are fitted on the FIRST shard's sample (LightGBM also
+    fits its BinMapper on a head sample), then every shard is binned as
+    it arrives — only the int32 binned matrix is retained on host, so
+    the raw float features never need to fit in RAM at once."""
+    mapper = None
+    bins_parts, y_parts, w_parts = [], [], []
+    for shard in shards:
+        Xs = np.asarray(shard[0], dtype=np.float64)
+        ys = np.asarray(shard[1], dtype=np.float64)
+        ws = (np.asarray(shard[2], dtype=np.float64) if len(shard) > 2
+              else np.ones(len(ys)))
+        if mapper is None:
+            mapper = BinMapper.fit(Xs, max_bin=max_bin, seed=seed)
+        bins_parts.append(mapper.transform(Xs))
+        y_parts.append(ys)
+        w_parts.append(ws)
+    if mapper is None:
+        raise ValueError("empty shard stream")
+    return (mapper, np.concatenate(bins_parts), np.concatenate(y_parts),
+            np.concatenate(w_parts))
+
+
+def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
           sample_weight: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
           feature_names: Optional[List[str]] = None,
           mesh: Optional[Mesh] = None) -> Booster:
     """Train a Booster. ``parallelism='data'`` shards rows over ``mesh``'s
     data axis and psums histograms (LightGBM data-parallel tree learner
-    analog, ref: TrainParams.scala:26)."""
+    analog, ref: TrainParams.scala:26).
+
+    ``X`` is either a dense (N, F) matrix with ``y`` labels, or — for
+    datasets that should not be materialized as floats at once — an
+    iterable of ``(X_shard, y_shard[, w_shard])`` tuples with ``y=None``
+    (only the int32 binned matrix is kept per shard)."""
     p = dict(DEFAULTS)
     p.update(params or {})
     if p["hist_method"] == "auto":
@@ -220,22 +249,30 @@ def train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
                             if jax.default_backend() in ("tpu", "axon")
                             else "scatter")
 
-    X = np.asarray(X, dtype=np.float64)
-    y = np.asarray(y, dtype=np.float64)
-    n, f = X.shape
-    if feature_names is None:
-        feature_names = [f"Column_{i}" for i in range(f)]
-    w_base = (np.ones(n) if sample_weight is None
-              else np.asarray(sample_weight, dtype=np.float64))
-
     objective = get_objective(
         p["objective"], num_class=p["num_class"], alpha=p["alpha"],
         tweedie_variance_power=p["tweedie_variance_power"])
     K = objective.num_class
 
-    # 1) bin on host, once
-    mapper = BinMapper.fit(X, max_bin=p["max_bin"], seed=p["seed"])
-    bins_np = mapper.transform(X)
+    # 1) bin on host, once (dense or streaming-shard input)
+    if y is None and not isinstance(X, np.ndarray):
+        if sample_weight is not None:
+            raise ValueError(
+                "pass per-shard weights inside the shard tuples in "
+                "streaming mode")
+        mapper, bins_np, y, w_base = _bin_stream(
+            X, p["max_bin"], p["seed"])
+        n, f = bins_np.shape
+    else:
+        X = np.asarray(X, dtype=np.float64)
+        y = np.asarray(y, dtype=np.float64)
+        n, f = X.shape
+        w_base = (np.ones(n) if sample_weight is None
+                  else np.asarray(sample_weight, dtype=np.float64))
+        mapper = BinMapper.fit(X, max_bin=p["max_bin"], seed=p["seed"])
+        bins_np = mapper.transform(X)
+    if feature_names is None:
+        feature_names = [f"Column_{i}" for i in range(f)]
     num_bins = int(mapper.num_bins.max())
 
     # 2) data-parallel layout

@@ -25,6 +25,8 @@ def _iter_source(path: str, pattern=None, recursive=True, inspect_zip=True,
     import io as _io
     import zipfile
 
+    import random
+
     from mmlspark_tpu.utils import filesystem as fslib
     if fslib.scheme_of(path) == "file":
         yield from iter_binary_files(
@@ -32,23 +34,29 @@ def _iter_source(path: str, pattern=None, recursive=True, inspect_zip=True,
             pattern=pattern, recursive=recursive, inspect_zip=inspect_zip,
             sample_ratio=sample_ratio, seed=seed)
         return
-    for p, data in fslib.iter_remote_binary_files(
-            path, pattern=None if inspect_zip else pattern,
-            recursive=recursive, sample_ratio=sample_ratio, seed=seed):
+    rng = random.Random(seed)
+    fs = fslib.get_filesystem(path)
+    for p in fs.list_files(path, None, recursive):
+        leaf = p.rsplit("/", 1)[-1]
         if inspect_zip and p.lower().endswith(".zip"):
-            with zipfile.ZipFile(_io.BytesIO(data)) as zf:
+            with zipfile.ZipFile(_io.BytesIO(fs.read_bytes(p))) as zf:
                 for info in zf.infolist():
                     if info.is_dir():
                         continue
                     name = info.filename.rsplit("/", 1)[-1]
                     if pattern and not fnmatch.fnmatch(name, pattern):
                         continue
+                    if sample_ratio < 1.0 and rng.random() > sample_ratio:
+                        continue
                     yield f"{p}/{info.filename}", zf.read(info)
         else:
-            leaf = p.rsplit("/", 1)[-1]
+            # filter BEFORE fetching — non-matching remote files must
+            # not be downloaded at all
             if pattern and not fnmatch.fnmatch(leaf, pattern):
                 continue
-            yield p, data
+            if sample_ratio < 1.0 and rng.random() > sample_ratio:
+                continue
+            yield p, fs.read_bytes(p)
 
 
 def read_binary_files(path: str,

@@ -208,17 +208,44 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             losses = (pred - y.astype(jnp.float32)) ** 2
         return jnp.sum(losses * w) / jnp.maximum(jnp.sum(w), 1.0)
 
-    def fit(self, table: DataTable) -> TPUModel:
+    def fit(self, table) -> TPUModel:
+        """``table`` is a DataTable, or — streaming ingestion for data
+        that should not live in host RAM at once — a sequence of
+        DataTable shards / a zero-arg callable returning an iterable of
+        shards (re-invoked each epoch; shuffling is within-shard with
+        remainder rows carried across shard boundaries). The HDFS-staged
+        feed of the reference (CNTKLearner.scala:123-140) becomes a
+        shard iterator."""
         mesh = self._mesh or mesh_lib.make_mesh(self.get("meshAxes"))
         module = self._build_module()
         input_shape = self.get("inputShape")
-        x, y = table_to_xy(table, self.get_features_col(),
-                           self.get_label_col(), input_shape)
-        y = y.astype(np.int32) if self.get("loss") != "mse" \
-            else y.astype(np.float32)
+        fcol, lcol = self.get_features_col(), self.get_label_col()
+        y_cast = np.int32 if self.get("loss") != "mse" else np.float32
+
+        streaming = not isinstance(table, DataTable)
+        if streaming:
+            if not callable(table) and iter(table) is table:
+                raise ValueError(
+                    "streaming fit() needs to replay shards every epoch: "
+                    "pass a sequence of DataTables or a zero-arg callable "
+                    "returning a fresh iterator, not a one-shot generator")
+            factory = table if callable(table) else (lambda: iter(table))
+            n = sum(len(t) for t in factory())   # one metadata pass
+            if n == 0:
+                raise ValueError("empty shard stream")
+            first_shard = next(iter(factory()))
+            x0, y0 = table_to_xy(first_shard, fcol, lcol, input_shape)
+            sample_x, sample_y = x0[:1], y0[:1].astype(y_cast)
+            schema_src = first_shard
+            x = y = None
+        else:
+            x, y = table_to_xy(table, fcol, lcol, input_shape)
+            y = y.astype(y_cast)
+            n = x.shape[0]
+            sample_x, sample_y = x[:1], y[:1]
+            schema_src = table
 
         batch_size = self.get("batchSize")
-        n = x.shape[0]
         steps_per_epoch = max(1, (n + batch_size - 1) // batch_size)
         total_steps = steps_per_epoch * self.get("epochs")
 
@@ -231,7 +258,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             total_steps=total_steps)
 
         rng = jax.random.PRNGKey(self.get("seed"))
-        sample_in = jnp.asarray(x[:1])
+        sample_in = jnp.asarray(sample_x)
         if getattr(module, "int_input", False):
             sample_in = sample_in.astype(jnp.int32)
         variables = module.init(rng, sample_in, train=False)
@@ -261,9 +288,9 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
 
         data_sharding = {
             "x": NamedSharding(mesh, P(*((mesh_lib.DATA_AXIS,)
-                                         + (None,) * (x.ndim - 1)))),
+                                         + (None,) * (sample_x.ndim - 1)))),
             "y": NamedSharding(mesh, P(*((mesh_lib.DATA_AXIS,)
-                                         + (None,) * (y.ndim - 1)))),
+                                         + (None,) * (sample_y.ndim - 1)))),
             "w": NamedSharding(mesh, P(mesh_lib.DATA_AXIS)),
         }
 
@@ -340,7 +367,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
         # on device and are flushed one logEvery-interval late, by which
         # time they are ready and float() is free.
         import time as _time
-        from mmlspark_tpu.utils.prefetch import ThreadedPrefetcher
+        from mmlspark_tpu.utils.prefetch import make_prefetcher
 
         self.history = []
         self.timing: Dict[str, float] = {}
@@ -350,20 +377,50 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
         epochs = self.get("epochs")
 
         def index_stream():
+            """(epoch, step, bx, by) numpy batches. In-memory mode
+            shuffles globally per epoch; streaming mode re-reads the
+            shard factory each epoch, shuffles within shards, and
+            carries remainder rows across shard boundaries."""
             step = 0
             for epoch in range(epochs):
-                order = np_rng.permutation(n)
-                for bstart in range(0, n, batch_size):
+                if not streaming:
+                    order = np_rng.permutation(n)
+                    for bstart in range(0, n, batch_size):
+                        step += 1
+                        if step <= start_step:
+                            continue  # fast-forward post-resume
+                        idx = order[bstart:bstart + batch_size]
+                        yield epoch, step, x[idx], y[idx]
+                    continue
+                carry_x = carry_y = None
+                for shard in factory():
+                    xs, ys = table_to_xy(shard, fcol, lcol, input_shape)
+                    ys = ys.astype(y_cast)
+                    perm = np_rng.permutation(len(xs))
+                    xs, ys = xs[perm], ys[perm]
+                    if carry_x is not None:
+                        xs = np.concatenate([carry_x, xs])
+                        ys = np.concatenate([carry_y, ys])
+                    n_full = len(xs) // batch_size
+                    for i in range(n_full):
+                        step += 1
+                        if step <= start_step:
+                            continue
+                        sl = slice(i * batch_size, (i + 1) * batch_size)
+                        yield epoch, step, xs[sl], ys[sl]
+                    rest = len(xs) - n_full * batch_size
+                    carry_x = xs[-rest:] if rest else None
+                    carry_y = ys[-rest:] if rest else None
+                if carry_x is not None:
                     step += 1
-                    if step <= start_step:
-                        continue  # fast-forward after resume (keeps rng)
-                    yield epoch, step, order[bstart:bstart + batch_size]
+                    if step > start_step:
+                        yield epoch, step, carry_x, carry_y
 
         def make_batch(item):
-            epoch, step, idx = item
+            epoch, step, bx_np, by_np = item
             bx, true_len = mesh_lib.pad_to_multiple(
-                x[idx], batch_size, axis=0)
-            by, _ = mesh_lib.pad_to_multiple(y[idx], batch_size, axis=0)
+                bx_np, batch_size, axis=0)
+            by, _ = mesh_lib.pad_to_multiple(by_np, batch_size, axis=0)
             w = (np.arange(batch_size) < true_len).astype(np.float32)
             return epoch, step, true_len, {
                 "x": jax.device_put(bx, data_sharding["x"]),
@@ -389,11 +446,18 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
         global_step = start_step
         t_first = None
         examples_timed = 0   # true (unpadded) rows after the warmup step
-        feed = ThreadedPrefetcher(index_stream(), make_batch, depth=2)
+        # CPU backend: async dispatch racing ahead starves XLA's
+        # in-process collective rendezvous on small hosts (7/8 devices
+        # join, the 8th's thunk never gets a pool thread -> fatal
+        # timeout). Serialize steps there; TPU keeps async dispatch.
+        sync_each_step = jax.default_backend() == "cpu"
+        feed = make_prefetcher(index_stream(), make_batch, depth=2)
         try:
             with maybe_trace(self.get("profileDir")):
                 for epoch, global_step, true_len, batch in feed:
                     state, loss = jit_step(state, batch)
+                    if sync_each_step:
+                        loss.block_until_ready()
                     if t_first is None:
                         # block on the compile+first step so steady-state
                         # timing starts after warmup
@@ -434,7 +498,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
         weights = {"params": host_params}
         if has_bn:
             weights["batch_stats"] = host_bs
-        field = table.schema.get(self.get_features_col())
+        field = schema_src.schema.get(self.get_features_col())
         img_scale = (1.0 / 255.0) if (field is not None
                                       and ImageSchema.is_image(field)) else 1.0
         model = TPUModel(

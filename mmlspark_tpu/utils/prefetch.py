@@ -67,3 +67,35 @@ class ThreadedPrefetcher:
                     break
             except queue.Empty:
                 break
+
+
+class SyncPrefetcher:
+    """Same interface, no thread: prepare each item inline.
+
+    Used on the CPU backend, where a worker-thread ``device_put`` racing
+    a multi-virtual-device collective can deadlock XLA's in-process
+    communicator (single-core hosts starve the rendezvous). TPU keeps
+    the threaded version — there device transfers overlap MXU compute.
+    """
+
+    def __init__(self, source: Iterable[Any],
+                 prepare: Callable[[Any], Any], depth: int = 2):
+        self._it = iter(source)
+        self._prepare = prepare
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        return self._prepare(next(self._it))
+
+    def close(self) -> None:
+        pass
+
+
+def make_prefetcher(source: Iterable[Any], prepare: Callable[[Any], Any],
+                    depth: int = 2):
+    import jax
+    cls = (SyncPrefetcher if jax.default_backend() == "cpu"
+           else ThreadedPrefetcher)
+    return cls(source, prepare, depth=depth)

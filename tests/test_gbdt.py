@@ -259,6 +259,40 @@ class TestBoosterTraining:
         assert b.predict(X).mean() > bu.predict(X).mean()
 
 
+class TestStreamingIngestion:
+    def test_shard_stream_matches_dense(self, breast_cancer):
+        # iterator-of-shards feed: only the binned int32 matrix is kept
+        # (bin boundaries fitted on the first shard's sample)
+        X, y = breast_cancer
+        kw = {"objective": "binary", "num_iterations": 20}
+        b_dense = train(kw, X, y)
+
+        def shards():
+            for lo in range(0, len(y), 150):
+                yield X[lo:lo + 150], y[lo:lo + 150]
+
+        b_stream = train(kw, shards())
+        # first-shard binning differs slightly from full-data binning;
+        # the model must still be equivalent in quality
+        assert _auc(y, b_stream.predict(X)) > 0.99
+        assert abs(_auc(y, b_dense.predict(X))
+                   - _auc(y, b_stream.predict(X))) < 0.005
+
+    def test_shard_stream_with_weights(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(400, 3))
+        y = (X[:, 0] > 0).astype(float)
+        w = np.where(y == 1, 5.0, 1.0)
+        b = train({"objective": "binary", "num_iterations": 10},
+                  [(X[:200], y[:200], w[:200]), (X[200:], y[200:], w[200:])])
+        bu = train({"objective": "binary", "num_iterations": 10}, X, y)
+        assert b.predict(X).mean() > bu.predict(X).mean()
+
+    def test_empty_stream_raises(self):
+        with pytest.raises(ValueError, match="empty shard stream"):
+            train({"objective": "binary"}, iter([]))
+
+
 class TestEdgeCases:
     def test_nan_routing_consistent_train_predict(self):
         # NaN maps to bin 0 (left) in training; inference must agree

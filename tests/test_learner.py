@@ -108,6 +108,27 @@ def test_regression_mse():
     assert resid < 0.2, f"relative mse {resid}"
 
 
+def test_streaming_shard_ingestion():
+    # shard iterator feed: datasets that never materialize in one table
+    # (the HDFS-staged feed analog, ref: CNTKLearner.scala:123-140)
+    t = _toy_table()
+    shards = list(t.shards(4))
+    learner = TPULearner(
+        networkSpec={"type": "mlp", "features": [32], "num_classes": 4},
+        epochs=8, batchSize=64, learningRate=0.05, optimizer="momentum",
+        computeDtype="float32", logEvery=1000)
+    model = learner.fit(shards)                 # list of shard tables
+    acc = _accuracy(model, t)
+    assert acc > 0.9, f"accuracy {acc}"
+
+    learner2 = TPULearner(
+        networkSpec={"type": "mlp", "features": [32], "num_classes": 4},
+        epochs=8, batchSize=64, learningRate=0.05, optimizer="momentum",
+        computeDtype="float32", logEvery=1000)
+    model2 = learner2.fit(lambda: iter(t.shards(3)))   # callable factory
+    assert _accuracy(model2, t) > 0.9
+
+
 def test_profile_dir_emits_trace(tmp_path):
     from mmlspark_tpu.utils.profiling import trace_files
     t = _toy_table()
