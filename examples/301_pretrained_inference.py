@@ -1,0 +1,111 @@
+"""Notebook-301 parity: pretrained-model inference.
+
+The reference loads a pretrained CNTK ResNet from the model zoo and runs
+batched DataFrame inference (ref: notebooks/samples/301 + CNTKModel.scala
+:469-514). Here: a ResNet trained in torch (weights this framework did
+not produce) is imported to flax, published through the model zoo, and
+served batch-inference-style over an image table.
+"""
+
+import tempfile
+
+import numpy as np
+import torch
+import torch.nn as tnn
+
+from mmlspark_tpu.core.schema import ImageSchema
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.downloader import LocalRepo, ModelDownloader
+from mmlspark_tpu.importers import import_torch_checkpoint
+from mmlspark_tpu.models.networks import build_network
+from mmlspark_tpu.stages.featurizer import ImageFeaturizer
+
+SPEC = {"type": "resnet", "stage_sizes": [1, 1, 1], "width": 16,
+        "num_classes": 10}
+
+
+class TorchBlock(tnn.Module):
+    def __init__(self, cin, cout, stride):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(cout)
+        self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+        self.bn2 = tnn.BatchNorm2d(cout)
+        self.downsample = None
+        if stride != 1 or cin != cout:
+            self.downsample = tnn.Sequential(
+                tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                tnn.BatchNorm2d(cout))
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = torch.relu(self.bn1(self.conv1(x)))
+        return torch.relu(idt + self.bn2(self.conv2(y)))
+
+
+class TorchResNet(tnn.Module):
+    """torchvision-style naming so the importer maps it directly."""
+
+    def __init__(self, width=16, classes=10):
+        super().__init__()
+        self.conv1 = tnn.Conv2d(3, width, 3, 1, 1, bias=False)
+        self.bn1 = tnn.BatchNorm2d(width)
+        self.layer1 = tnn.Sequential(TorchBlock(width, width, 1))
+        self.layer2 = tnn.Sequential(TorchBlock(width, width * 2, 2))
+        self.layer3 = tnn.Sequential(TorchBlock(width * 2, width * 4, 2))
+        self.fc = tnn.Linear(width * 4, classes)
+
+    def forward(self, x):
+        x = torch.relu(self.bn1(self.conv1(x)))
+        x = self.layer3(self.layer2(self.layer1(x)))
+        return self.fc(x.mean(dim=(2, 3)))
+
+
+def main():
+    # "pretrained" weights produced outside this framework
+    torch.manual_seed(0)
+    tmodel = TorchResNet()
+    xb = torch.randn(64, 3, 32, 32)
+    yb = torch.randint(0, 10, (64,))
+    opt = torch.optim.SGD(tmodel.parameters(), lr=0.05)
+    for _ in range(5):
+        opt.zero_grad()
+        tnn.functional.cross_entropy(tmodel(xb), yb).backward()
+        opt.step()
+    tmodel.eval()
+
+    # import -> publish to the zoo -> download with sha256 verification
+    variables = import_torch_checkpoint(
+        tmodel.state_dict(), SPEC, validate_input_shape=[32, 32, 3])
+    with tempfile.TemporaryDirectory() as root:
+        repo = LocalRepo(f"{root}/repo")
+        schema = repo.publish(
+            "ResNet_pretrained", SPEC, variables, dataset="CIFAR",
+            model_type="image", input_shape=[32, 32, 3],
+            layer_names=build_network(SPEC).feature_layers())
+        downloader = ModelDownloader(f"{root}/cache", repo=repo)
+
+        # batched inference over an image table (cutOutputLayers=0 keeps
+        # the classification head)
+        rng = np.random.default_rng(0)
+        rows = [ImageSchema.make_row(
+            f"img{i}", rng.integers(0, 255, (32, 32, 3)).astype(np.uint8),
+            "RGB") for i in range(16)]
+        table = DataTable({"image": rows})
+        model = ImageFeaturizer.from_model_schema(
+            schema, downloader, cutOutputLayers=0, outputCol="scores")
+        out = model.transform(table)
+    pred = np.argmax(out["scores"], axis=1)
+    print(f"scored {len(table)} images; logits {out['scores'].shape}, "
+          f"predictions {pred.tolist()}")
+
+    # fidelity: the imported graph must reproduce torch's outputs
+    xs = np.stack([r[ImageSchema.DATA] for r in rows]).astype(np.float32)
+    with torch.no_grad():
+        ref = tmodel(torch.tensor(xs).permute(0, 3, 1, 2) / 255.0).numpy()
+    np.testing.assert_allclose(out["scores"], ref, rtol=1e-3, atol=1e-4)
+    print("imported model matches torch outputs to 1e-4")
+
+
+if __name__ == "__main__":
+    main()

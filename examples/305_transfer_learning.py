@@ -1,0 +1,66 @@
+"""Notebook-305 parity: transfer learning with ImageFeaturizer.
+
+The reference featurizes flower images through a truncated pretrained CNN
+and trains a classical head on the features (ref: notebooks/samples/305 +
+ImageFeaturizer.scala:91-141). Here: a zoo ResNet backbone is cut one
+layer before the head, the pooled features feed a GBDT classifier, and
+the pipeline separates bright-vs-dark image classes.
+"""
+
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core.schema import ImageSchema
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.downloader import LocalRepo, ModelDownloader
+from mmlspark_tpu.gbdt import TPUBoostClassifier
+from mmlspark_tpu.models.networks import build_network
+from mmlspark_tpu.stages.featurizer import ImageFeaturizer
+
+SPEC = {"type": "resnet", "stage_sizes": [1, 1, 1], "width": 8,
+        "num_classes": 10}
+
+
+def make_images(n=80, seed=0):
+    rng = np.random.default_rng(seed)
+    rows, labels = [], []
+    for i in range(n):
+        base = 60 if i % 2 == 0 else 180
+        img = np.clip(rng.normal(base, 35, (32, 32, 3)), 0, 255)
+        rows.append(ImageSchema.make_row(f"img{i}",
+                                         img.astype(np.uint8), "RGB"))
+        labels.append(float(i % 2))
+    return DataTable({"image": rows, "label": np.asarray(labels)})
+
+
+def main():
+    # publish a backbone to the zoo (any pretrained weights work; see
+    # examples/301 for importing torch checkpoints)
+    with tempfile.TemporaryDirectory() as root:
+        repo = LocalRepo(f"{root}/repo")
+        module = build_network(SPEC)
+        variables = module.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 32, 32, 3)))
+        schema = repo.publish("ResNet_backbone", SPEC, variables,
+                              input_shape=[32, 32, 3],
+                              layer_names=module.feature_layers())
+        downloader = ModelDownloader(f"{root}/cache", repo=repo)
+
+        table = make_images()
+        featurizer = ImageFeaturizer.from_model_schema(
+            schema, downloader, cutOutputLayers=1)   # cut head -> pooled
+        feats = featurizer.transform(table)
+    print(f"features: {feats['features'].shape}")
+
+    head = TPUBoostClassifier(numIterations=20, maxBin=32).fit(feats)
+    scored = head.transform(feats)
+    acc = (scored["prediction"] == table["label"]).mean()
+    print(f"transfer-learning accuracy: {acc:.3f}")
+    assert acc > 0.9
+
+
+if __name__ == "__main__":
+    main()

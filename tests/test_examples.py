@@ -1,0 +1,32 @@
+"""Execute every example script end-to-end — the local analog of the
+reference's notebook test harness (ref: tools/notebook/tester/
+TestNotebooksLocally.py + NotebookTests.scala: every sample notebook must
+run green in CI). Each example asserts its own quality bar internally."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+@pytest.mark.parametrize("script", sorted(
+    f for f in os.listdir(EXAMPLES) if f.endswith(".py")))
+def test_example_runs(script):
+    path = os.path.join(EXAMPLES, script)
+    code = (
+        "import jax;"
+        "jax.config.update('jax_platforms','cpu');"
+        "jax.config.update('jax_num_cpu_devices',8);"
+        f"import runpy; runpy.run_path({path!r}, run_name='__main__')")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=600,
+                         env=env)
+    assert res.returncode == 0, (
+        f"{script} failed:\nSTDOUT:\n{res.stdout[-3000:]}\n"
+        f"STDERR:\n{res.stderr[-3000:]}")
