@@ -39,6 +39,7 @@ STAGE_MODULES = [
     "mmlspark_tpu.models.tpu_model",
     "mmlspark_tpu.io.http",
     "mmlspark_tpu.io.minibatch",
+    "mmlspark_tpu.serving.fleet",
 ]
 
 

@@ -192,10 +192,44 @@ def _import_mlp(sd: Dict[str, Any], spec: Dict[str, Any],
     return b.finish(strict)
 
 
+def _import_bilstm(sd: Dict[str, Any], spec: Dict[str, Any],
+                   strict: bool,
+                   input_shape: Optional[List[int]]) -> Dict[str, Any]:
+    """torch bidirectional ``nn.LSTM`` -> BiLSTMTagger variables
+    (the notebook-304 pretrained Bi-LSTM ingestion path).
+
+    Expected torch names: ``embed`` (nn.Embedding), ``lstm``
+    (nn.LSTM(bidirectional=True, batch_first=True)), ``head``
+    (nn.Linear). torch packs gates (i, f, g, o) along dim 0 of
+    ``weight_ih/hh``; flax's OptimizedLSTMCell keeps one Dense per gate
+    with the bias only on the recurrent half, so torch's two biases are
+    summed."""
+    h = int(spec.get("hidden", 128))
+    b = _TreeBuilder(sd)
+    b._set(b.params, ["embed", "embedding"],
+           _to_numpy(b.take("embed.weight")))
+    # forward cell = OptimizedLSTMCell_0, reverse = _1 (creation order
+    # in BiLSTMTagger.__call__)
+    for suffix, cell in (("", "OptimizedLSTMCell_0"),
+                         ("_reverse", "OptimizedLSTMCell_1")):
+        wih = _to_numpy(b.take(f"lstm.weight_ih_l0{suffix}"))   # (4H, E)
+        whh = _to_numpy(b.take(f"lstm.weight_hh_l0{suffix}"))   # (4H, H)
+        bias = (_to_numpy(b.take(f"lstm.bias_ih_l0{suffix}"))
+                + _to_numpy(b.take(f"lstm.bias_hh_l0{suffix}")))
+        for gi, gate in enumerate("ifgo"):
+            sl = slice(gi * h, (gi + 1) * h)
+            b._set(b.params, [cell, f"i{gate}", "kernel"], wih[sl].T)
+            b._set(b.params, [cell, f"h{gate}", "kernel"], whh[sl].T)
+            b._set(b.params, [cell, f"h{gate}", "bias"], bias[sl])
+    b.linear(["head"], "head")
+    return b.finish(strict)
+
+
 _IMPORTERS = {
     "resnet": _import_resnet,
     "convnet": _import_convnet,
     "mlp": _import_mlp,
+    "bilstm": _import_bilstm,
 }
 
 
@@ -234,8 +268,10 @@ def _validate(variables: Dict[str, Any], network_spec: Dict[str, Any],
     import jax.numpy as jnp
     from mmlspark_tpu.models.networks import build_network
     module = build_network(network_spec)
+    dummy_dtype = (jnp.int32 if getattr(module, "int_input", False)
+                   else jnp.float32)
     target = module.init(jax.random.PRNGKey(0),
-                         jnp.zeros([1] + list(input_shape)))
+                         jnp.zeros([1] + list(input_shape), dummy_dtype))
     t_paths = {tuple(str(p.key) for p in path): leaf.shape
                for path, leaf in jax.tree_util.tree_leaves_with_path(target)}
     v_paths = {tuple(str(p.key) for p in path): leaf.shape

@@ -166,6 +166,10 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         n = len(table)
         out_cols: Dict[str, List[np.ndarray]] = {c: [] for c in fetches}
 
+        # integer-token models (BiLSTM/Transformer) must not round-trip
+        # their ids through float compute dtypes
+        int_input = bool(getattr(self.get("modelFn"), "int_input", False))
+
         def prepare(start):
             """Host batch assembly + device_put — runs on the prefetch
             thread so transfers overlap the current batch's compute
@@ -175,10 +179,11 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
             for model_in, col_name in feeds.items():
                 field = table.schema.get(col_name)
                 arr = table[col_name][start:stop]
-                arr = _column_to_array(arr, field, np.float32
-                                       if dtype == jnp.bfloat16 else dtype)
+                host_dtype = np.int32 if int_input else (
+                    np.float32 if dtype == jnp.bfloat16 else dtype)
+                arr = _column_to_array(arr, field, host_dtype)
                 sharded, _ = mesh_lib.shard_batch(mesh, arr)
-                if dtype == jnp.bfloat16:
+                if dtype == jnp.bfloat16 and not int_input:
                     sharded = sharded.astype(jnp.bfloat16)
                 inputs[model_in] = sharded
             return stop - start, inputs
@@ -237,6 +242,7 @@ class _FlaxApply:
     def __init__(self, module, method=None):
         self.module = module
         self.method = method
+        self.int_input = bool(getattr(module, "int_input", False))
 
     def __call__(self, weights, inputs: Dict[str, jnp.ndarray]):
         args = list(inputs.values())

@@ -1,12 +1,15 @@
 """Streaming HTTP serving.
 
 Analog of Spark Serving (ref: src/io/http/src/main/scala/HTTPSource.scala,
-DistributedHTTPSource.scala, ServingImplicits.scala).
+DistributedHTTPSource.scala, ServingImplicits.scala,
+PartitionConsolidator.scala).
 """
 
+from mmlspark_tpu.serving.fleet import PartitionConsolidator, ServingFleet
 from mmlspark_tpu.serving.server import (
     HTTPSource, ServingEngine, SharedSingleton, SharedVariable, serve_model,
 )
 
-__all__ = ["HTTPSource", "ServingEngine", "SharedSingleton",
-           "SharedVariable", "serve_model"]
+__all__ = ["HTTPSource", "PartitionConsolidator", "ServingEngine",
+           "ServingFleet", "SharedSingleton", "SharedVariable",
+           "serve_model"]

@@ -1,0 +1,125 @@
+"""Multi-host serving: one engine per host + partition consolidation.
+
+The reference's DistributedHTTPSource runs one JVMSharedServer per
+executor with batch-indexed request routing and reply-by-uuid
+(ref: src/io/http/src/main/scala/DistributedHTTPSource.scala:33-472);
+PartitionConsolidator funnels many partitions' rows into one stream per
+executor for rate-limited resources (PartitionConsolidator.scala:17,103).
+
+TPU-native shape: model state is replicated by jax, so serving hosts are
+independent — each runs one ServingEngine and any TCP load balancer
+fronts them. ``ServingFleet`` manages N engines (the one-process
+simulation of that deployment and the orchestration utility on a real
+host group); ``PartitionConsolidator`` keeps each process's own row
+range of a table, funneling work to exactly one consumer per host.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from mmlspark_tpu.core.logging_utils import get_logger
+from mmlspark_tpu.core.params import IntParam
+from mmlspark_tpu.core.schema import Schema
+from mmlspark_tpu.core.stage import Transformer
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.serving.server import HTTPSource, ServingEngine
+
+log = get_logger("serving.fleet")
+
+
+class ServingFleet:
+    """N serving engines over one pipeline — one per host in a real
+    deployment, N ports on one host in simulation/tests. Replies always
+    flow through the engine that accepted the request (the reference's
+    reply-routing invariant, DistributedHTTPSource.scala:188-192)."""
+
+    def __init__(self, pipeline, n_engines: int = 2,
+                 host: str = "127.0.0.1", base_port: int = 18700,
+                 batch_size: int = 64, reply_col: str = "reply"):
+        self.engines: List[ServingEngine] = []
+        port = base_port
+        try:
+            for _ in range(n_engines):
+                source = HTTPSource(host=host, port=port)
+                port = source.port + 1      # skip whatever port-scan used
+                self.engines.append(ServingEngine(
+                    source, pipeline, reply_col=reply_col,
+                    batch_size=batch_size).start())
+        except Exception:
+            # partial construction must not leak threads/bound ports
+            self.stop_all()
+            raise
+        self._next = 0
+        log.info("fleet of %d engines: %s", n_engines, self.addresses)
+
+    @property
+    def addresses(self) -> List[str]:
+        return [e.source.address for e in self.engines]
+
+    def post(self, payload: Any, timeout: float = 30.0) -> Dict[str, Any]:
+        """Round-robin client — the stand-in for an external load
+        balancer in tests/examples."""
+        addr = self.addresses[self._next % len(self.engines)]
+        self._next += 1
+        body = payload if isinstance(payload, bytes) \
+            else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            addr, data=body, headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "seen": sum(e.source.requests_seen for e in self.engines),
+            "accepted": sum(e.source.requests_accepted
+                            for e in self.engines),
+            "answered": sum(e.source.requests_answered
+                            for e in self.engines),
+        }
+
+    def stop_all(self) -> None:
+        for e in self.engines:
+            e.stop()
+
+
+class PartitionConsolidator(Transformer):
+    """Funnel a table to one stream per host
+    (ref: PartitionConsolidator.scala:17 — many partitions feeding one
+    connection-holding consumer per executor).
+
+    In a multi-process ``jax.distributed`` job each process keeps only
+    its own contiguous row range (consolidating that host's partitions
+    into one table); single-process it coalesces the table's shards into
+    one. ``hostCount``/``hostIndex`` override auto-detection for tests."""
+
+    hostCount = IntParam("total hosts (0 = auto from jax.distributed)",
+                         default=0)
+    hostIndex = IntParam("this host's index (-1 = auto)", default=-1)
+
+    def transform(self, table: DataTable) -> DataTable:
+        count = self.get("hostCount")
+        index = self.get("hostIndex")
+        from mmlspark_tpu.parallel import distributed as dist
+        if count <= 0 or index < 0:
+            # delegate to the training-side feeder so serving and
+            # training always agree on the host-sharding rule
+            info = dist.host_info()
+            if count <= 0:
+                count = info.process_count
+            if index < 0:
+                index = info.process_index
+        if index >= count:
+            raise ValueError(
+                f"hostIndex {index} out of range for hostCount {count}")
+        if count <= 1:
+            return table   # eager tables are already one partition
+        return dist.shard_table_for_host(
+            table, dist.HostInfo(process_index=index, process_count=count,
+                                 local_device_count=0,
+                                 global_device_count=0))
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        return schema

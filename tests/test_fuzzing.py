@@ -173,6 +173,9 @@ def _register_all():
     reg(lambda: TestObject(CheckpointData(), transform_table=_num_table()))
 
     # data prep
+    from mmlspark_tpu.serving.fleet import PartitionConsolidator
+    reg(lambda: TestObject(PartitionConsolidator(hostCount=2, hostIndex=0),
+                           transform_table=_num_table()))
     reg(lambda: TestObject(
         FastVectorAssembler(inputCols=["num", "label"], outputCol="fv"),
         transform_table=_num_table()))

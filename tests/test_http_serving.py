@@ -181,6 +181,42 @@ class TestServing:
             e1.stop()
             e2.stop()
 
+    def test_serving_fleet_round_robin(self):
+        # one engine per host behind a balancer, N ports in simulation
+        # (ref: DistributedHTTPSource.scala per-executor servers)
+        from mmlspark_tpu.serving import ServingFleet
+
+        def handle(table):
+            return table.with_column("reply", [
+                {"echo": json.loads(r["entity"].decode())["x"]}
+                for r in table["request"]])
+
+        fleet = ServingFleet(Lambda.apply(handle), n_engines=3,
+                             base_port=18700, batch_size=4)
+        try:
+            results = [fleet.post({"x": i})["echo"] for i in range(9)]
+            assert results == list(range(9))
+            c = fleet.counters()
+            assert c["answered"] == 9
+            # round-robin really spread the load
+            per_engine = [e.source.requests_answered
+                          for e in fleet.engines]
+            assert per_engine == [3, 3, 3], per_engine
+        finally:
+            fleet.stop_all()
+
+    def test_partition_consolidator(self):
+        from mmlspark_tpu.serving import PartitionConsolidator
+        import numpy as np
+        t = DataTable({"x": np.arange(10.0)})
+        # single host: pass-through
+        assert len(PartitionConsolidator().transform(t)) == 10
+        # simulated 2-host fleet: each host keeps its own range
+        a = PartitionConsolidator(hostCount=2, hostIndex=0).transform(t)
+        b = PartitionConsolidator(hostCount=2, hostIndex=1).transform(t)
+        assert len(a) + len(b) == 10
+        assert list(a["x"]) + list(b["x"]) == list(map(float, range(10)))
+
     def test_port_scan_on_conflict(self, echo_server):
         # same base port: must scan to the next free one
         src2 = HTTPSource(port=echo_server.source.port)

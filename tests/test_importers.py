@@ -101,6 +101,21 @@ class TConvNet(tnn.Module):
         return self.head(x)
 
 
+class TBiLSTM(tnn.Module):
+    """torch twin of BiLSTMTagger (notebook-304's pretrained family)."""
+
+    def __init__(self, vocab=30, embed=8, hidden=6, tags=4):
+        super().__init__()
+        self.embed = tnn.Embedding(vocab, embed)
+        self.lstm = tnn.LSTM(embed, hidden, batch_first=True,
+                             bidirectional=True)
+        self.head = tnn.Linear(2 * hidden, tags)
+
+    def forward(self, tokens):
+        h, _ = self.lstm(self.embed(tokens))
+        return self.head(h)
+
+
 class TMLP(tnn.Module):
     def __init__(self, dims=(20, 16, 8), classes=3):
         super().__init__()
@@ -182,6 +197,23 @@ class TestTorchImportFidelity:
         got = np.asarray(build_network(spec).apply(
             variables, jnp.asarray(xt.numpy())))
         np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_bilstm_outputs_match(self):
+        # the pretrained Bi-LSTM ingestion path (notebook-304 parity):
+        # gate packing (i,f,g,o), kernel transposes, and the summed
+        # ih+hh biases must reproduce torch's per-token outputs exactly
+        torch.manual_seed(3)
+        model = TBiLSTM(vocab=30, embed=8, hidden=6, tags=4).eval()
+        spec = {"type": "bilstm", "vocab_size": 30, "embed_dim": 8,
+                "hidden": 6, "num_tags": 4}
+        variables = import_torch_checkpoint(
+            model.state_dict(), spec, validate_input_shape=[7])
+        toks = torch.randint(0, 30, (3, 7))
+        with torch.no_grad():
+            ref = model(toks).numpy()
+        got = np.asarray(build_network(spec).apply(
+            variables, jnp.asarray(toks.numpy())))
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
     def test_pt_file_roundtrip(self, trained_torch_resnet, tmp_path):
         path = str(tmp_path / "resnet.pt")

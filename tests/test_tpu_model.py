@@ -147,3 +147,24 @@ def test_image_to_model_e2e():
     ])
     out = pipe.fit(t).transform(t)
     assert out["scores"].shape == (6, 3)
+
+
+def test_int_token_model_inputs_stay_integer():
+    # integer-token models (BiLSTM/Transformer) must receive int32 ids,
+    # not float-coerced values (regression: embed rejects float input)
+    from mmlspark_tpu.models.networks import build_network
+
+    spec = {"type": "bilstm", "vocab_size": 20, "embed_dim": 4,
+            "hidden": 4, "num_tags": 3}
+    module = build_network(spec)
+    variables = module.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 6), jnp.int32))
+    model = TPUModel.from_flax(module, variables, inputCol="tokens",
+                               outputCol="tags", batchSize=4)
+    toks = np.random.default_rng(0).integers(0, 20, size=(10, 6))
+    out = model.transform(DataTable({"tokens": toks.astype(np.int64)}))
+    assert out["tags"].shape == (10, 6, 3)
+    # bfloat16 compute must also leave token ids alone
+    model.set("computeDtype", "bfloat16")
+    out2 = model.transform(DataTable({"tokens": toks.astype(np.int64)}))
+    assert out2["tags"].shape == (10, 6, 3)
