@@ -1,0 +1,1065 @@
+"""GENERATED smoke tests — python -m mmlspark_tpu.codegen."""
+
+
+def test_assemblefeatures_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.featurize import AssembleFeatures
+    stage = AssembleFeatures()
+    assert stage.uid.startswith("AssembleFeatures")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is AssembleFeatures
+    assert clone.uid == stage.uid
+    for p in AssembleFeatures.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_bestmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.tuning import BestModel
+    stage = BestModel()
+    assert stage.uid.startswith("BestModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is BestModel
+    assert clone.uid == stage.uid
+    for p in BestModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_cacher_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import Cacher
+    stage = Cacher()
+    assert stage.uid.startswith("Cacher")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Cacher
+    assert clone.uid == stage.uid
+    for p in Cacher.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_checkpointdata_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import CheckpointData
+    stage = CheckpointData()
+    assert stage.uid.startswith("CheckpointData")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is CheckpointData
+    assert clone.uid == stage.uid
+    for p in CheckpointData.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_classbalancer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import ClassBalancer
+    stage = ClassBalancer()
+    assert stage.uid.startswith("ClassBalancer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ClassBalancer
+    assert clone.uid == stage.uid
+    for p in ClassBalancer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_classbalancermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import ClassBalancerModel
+    stage = ClassBalancerModel()
+    assert stage.uid.startswith("ClassBalancerModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ClassBalancerModel
+    assert clone.uid == stage.uid
+    for p in ClassBalancerModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_cleanmissingdata_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import CleanMissingData
+    stage = CleanMissingData()
+    assert stage.uid.startswith("CleanMissingData")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is CleanMissingData
+    assert clone.uid == stage.uid
+    for p in CleanMissingData.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_cleanmissingdatamodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import CleanMissingDataModel
+    stage = CleanMissingDataModel()
+    assert stage.uid.startswith("CleanMissingDataModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is CleanMissingDataModel
+    assert clone.uid == stage.uid
+    for p in CleanMissingDataModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_computemodelstatistics_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.statistics import ComputeModelStatistics
+    stage = ComputeModelStatistics()
+    assert stage.uid.startswith("ComputeModelStatistics")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ComputeModelStatistics
+    assert clone.uid == stage.uid
+    for p in ComputeModelStatistics.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_computeperinstancestatistics_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.statistics import ComputePerInstanceStatistics
+    stage = ComputePerInstanceStatistics()
+    assert stage.uid.startswith("ComputePerInstanceStatistics")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ComputePerInstanceStatistics
+    assert clone.uid == stage.uid
+    for p in ComputePerInstanceStatistics.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_countvectorizer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import CountVectorizer
+    stage = CountVectorizer()
+    assert stage.uid.startswith("CountVectorizer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is CountVectorizer
+    assert clone.uid == stage.uid
+    for p in CountVectorizer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_countvectorizermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import CountVectorizerModel
+    stage = CountVectorizerModel()
+    assert stage.uid.startswith("CountVectorizerModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is CountVectorizerModel
+    assert clone.uid == stage.uid
+    for p in CountVectorizerModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_custominputparser_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.http import CustomInputParser
+    stage = CustomInputParser()
+    assert stage.uid.startswith("CustomInputParser")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is CustomInputParser
+    assert clone.uid == stage.uid
+    for p in CustomInputParser.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_customoutputparser_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.http import CustomOutputParser
+    stage = CustomOutputParser()
+    assert stage.uid.startswith("CustomOutputParser")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is CustomOutputParser
+    assert clone.uid == stage.uid
+    for p in CustomOutputParser.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_dataconversion_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import DataConversion
+    stage = DataConversion()
+    assert stage.uid.startswith("DataConversion")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is DataConversion
+    assert clone.uid == stage.uid
+    for p in DataConversion.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_dropcolumns_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import DropColumns
+    stage = DropColumns()
+    assert stage.uid.startswith("DropColumns")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is DropColumns
+    assert clone.uid == stage.uid
+    for p in DropColumns.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_dynamicminibatchtransformer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.minibatch import DynamicMiniBatchTransformer
+    stage = DynamicMiniBatchTransformer()
+    assert stage.uid.startswith("DynamicMiniBatchTransformer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is DynamicMiniBatchTransformer
+    assert clone.uid == stage.uid
+    for p in DynamicMiniBatchTransformer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_ensemblebykey_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import EnsembleByKey
+    stage = EnsembleByKey()
+    assert stage.uid.startswith("EnsembleByKey")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is EnsembleByKey
+    assert clone.uid == stage.uid
+    for p in EnsembleByKey.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_explode_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import Explode
+    stage = Explode()
+    assert stage.uid.startswith("Explode")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Explode
+    assert clone.uid == stage.uid
+    for p in Explode.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_fastvectorassembler_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import FastVectorAssembler
+    stage = FastVectorAssembler()
+    assert stage.uid.startswith("FastVectorAssembler")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is FastVectorAssembler
+    assert clone.uid == stage.uid
+    for p in FastVectorAssembler.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_featurize_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.featurize import Featurize
+    stage = Featurize()
+    assert stage.uid.startswith("Featurize")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Featurize
+    assert clone.uid == stage.uid
+    for p in Featurize.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_featurizemodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.featurize import FeaturizeModel
+    stage = FeaturizeModel()
+    assert stage.uid.startswith("FeaturizeModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is FeaturizeModel
+    assert clone.uid == stage.uid
+    for p in FeaturizeModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_findbestmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.tuning import FindBestModel
+    stage = FindBestModel()
+    assert stage.uid.startswith("FindBestModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is FindBestModel
+    assert clone.uid == stage.uid
+    for p in FindBestModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_fixedminibatchtransformer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.minibatch import FixedMiniBatchTransformer
+    stage = FixedMiniBatchTransformer()
+    assert stage.uid.startswith("FixedMiniBatchTransformer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is FixedMiniBatchTransformer
+    assert clone.uid == stage.uid
+    for p in FixedMiniBatchTransformer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_flattenbatch_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.minibatch import FlattenBatch
+    stage = FlattenBatch()
+    assert stage.uid.startswith("FlattenBatch")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is FlattenBatch
+    assert clone.uid == stage.uid
+    for p in FlattenBatch.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_httptransformer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.http import HTTPTransformer
+    stage = HTTPTransformer()
+    assert stage.uid.startswith("HTTPTransformer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is HTTPTransformer
+    assert clone.uid == stage.uid
+    for p in HTTPTransformer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_hashingtf_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import HashingTF
+    stage = HashingTF()
+    assert stage.uid.startswith("HashingTF")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is HashingTF
+    assert clone.uid == stage.uid
+    for p in HashingTF.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_idf_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import IDF
+    stage = IDF()
+    assert stage.uid.startswith("IDF")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is IDF
+    assert clone.uid == stage.uid
+    for p in IDF.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_idfmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import IDFModel
+    stage = IDFModel()
+    assert stage.uid.startswith("IDFModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is IDFModel
+    assert clone.uid == stage.uid
+    for p in IDFModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_imagefeaturizer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.featurizer import ImageFeaturizer
+    stage = ImageFeaturizer()
+    assert stage.uid.startswith("ImageFeaturizer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ImageFeaturizer
+    assert clone.uid == stage.uid
+    for p in ImageFeaturizer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_imagesetaugmenter_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.image import ImageSetAugmenter
+    stage = ImageSetAugmenter()
+    assert stage.uid.startswith("ImageSetAugmenter")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ImageSetAugmenter
+    assert clone.uid == stage.uid
+    for p in ImageSetAugmenter.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_imagetransformer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.image import ImageTransformer
+    stage = ImageTransformer()
+    assert stage.uid.startswith("ImageTransformer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ImageTransformer
+    assert clone.uid == stage.uid
+    for p in ImageTransformer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_jsoninputparser_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.http import JSONInputParser
+    stage = JSONInputParser()
+    assert stage.uid.startswith("JSONInputParser")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is JSONInputParser
+    assert clone.uid == stage.uid
+    for p in JSONInputParser.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_jsonoutputparser_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.http import JSONOutputParser
+    stage = JSONOutputParser()
+    assert stage.uid.startswith("JSONOutputParser")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is JSONOutputParser
+    assert clone.uid == stage.uid
+    for p in JSONOutputParser.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_lambda_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import Lambda
+    stage = Lambda()
+    assert stage.uid.startswith("Lambda")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Lambda
+    assert clone.uid == stage.uid
+    for p in Lambda.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_multicolumnadapter_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import MultiColumnAdapter
+    stage = MultiColumnAdapter()
+    assert stage.uid.startswith("MultiColumnAdapter")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is MultiColumnAdapter
+    assert clone.uid == stage.uid
+    for p in MultiColumnAdapter.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_multicolumnadaptermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import MultiColumnAdapterModel
+    stage = MultiColumnAdapterModel()
+    assert stage.uid.startswith("MultiColumnAdapterModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is MultiColumnAdapterModel
+    assert clone.uid == stage.uid
+    for p in MultiColumnAdapterModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_ngram_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import NGram
+    stage = NGram()
+    assert stage.uid.startswith("NGram")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is NGram
+    assert clone.uid == stage.uid
+    for p in NGram.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_partitionconsolidator_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.serving.fleet import PartitionConsolidator
+    stage = PartitionConsolidator()
+    assert stage.uid.startswith("PartitionConsolidator")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is PartitionConsolidator
+    assert clone.uid == stage.uid
+    for p in PartitionConsolidator.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_partitionsample_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import PartitionSample
+    stage = PartitionSample()
+    assert stage.uid.startswith("PartitionSample")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is PartitionSample
+    assert clone.uid == stage.uid
+    for p in PartitionSample.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_pipeline_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.core.stage import Pipeline
+    stage = Pipeline()
+    assert stage.uid.startswith("Pipeline")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Pipeline
+    assert clone.uid == stage.uid
+    for p in Pipeline.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_pipelinemodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.core.stage import PipelineModel
+    stage = PipelineModel()
+    assert stage.uid.startswith("PipelineModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is PipelineModel
+    assert clone.uid == stage.uid
+    for p in PipelineModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_renamecolumn_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import RenameColumn
+    stage = RenameColumn()
+    assert stage.uid.startswith("RenameColumn")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is RenameColumn
+    assert clone.uid == stage.uid
+    for p in RenameColumn.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_renameto_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import RenameTo
+    stage = RenameTo()
+    assert stage.uid.startswith("RenameTo")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is RenameTo
+    assert clone.uid == stage.uid
+    for p in RenameTo.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_repartition_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import Repartition
+    stage = Repartition()
+    assert stage.uid.startswith("Repartition")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Repartition
+    assert clone.uid == stage.uid
+    for p in Repartition.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_selectcolumns_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import SelectColumns
+    stage = SelectColumns()
+    assert stage.uid.startswith("SelectColumns")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is SelectColumns
+    assert clone.uid == stage.uid
+    for p in SelectColumns.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_simplehttptransformer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.http import SimpleHTTPTransformer
+    stage = SimpleHTTPTransformer()
+    assert stage.uid.startswith("SimpleHTTPTransformer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is SimpleHTTPTransformer
+    assert clone.uid == stage.uid
+    for p in SimpleHTTPTransformer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_stopwordsremover_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import StopWordsRemover
+    stage = StopWordsRemover()
+    assert stage.uid.startswith("StopWordsRemover")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is StopWordsRemover
+    assert clone.uid == stage.uid
+    for p in StopWordsRemover.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_summarizedata_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import SummarizeData
+    stage = SummarizeData()
+    assert stage.uid.startswith("SummarizeData")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is SummarizeData
+    assert clone.uid == stage.uid
+    for p in SummarizeData.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpuboostclassificationmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.gbdt.estimators import TPUBoostClassificationModel
+    stage = TPUBoostClassificationModel()
+    assert stage.uid.startswith("TPUBoostClassificationModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPUBoostClassificationModel
+    assert clone.uid == stage.uid
+    for p in TPUBoostClassificationModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpuboostclassifier_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.gbdt.estimators import TPUBoostClassifier
+    stage = TPUBoostClassifier()
+    assert stage.uid.startswith("TPUBoostClassifier")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPUBoostClassifier
+    assert clone.uid == stage.uid
+    for p in TPUBoostClassifier.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpuboostregressionmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.gbdt.estimators import TPUBoostRegressionModel
+    stage = TPUBoostRegressionModel()
+    assert stage.uid.startswith("TPUBoostRegressionModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPUBoostRegressionModel
+    assert clone.uid == stage.uid
+    for p in TPUBoostRegressionModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpuboostregressor_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.gbdt.estimators import TPUBoostRegressor
+    stage = TPUBoostRegressor()
+    assert stage.uid.startswith("TPUBoostRegressor")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPUBoostRegressor
+    assert clone.uid == stage.uid
+    for p in TPUBoostRegressor.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpulearner_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.models.learner import TPULearner
+    stage = TPULearner()
+    assert stage.uid.startswith("TPULearner")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPULearner
+    assert clone.uid == stage.uid
+    for p in TPULearner.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpulinearregression_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.models.linear import TPULinearRegression
+    stage = TPULinearRegression()
+    assert stage.uid.startswith("TPULinearRegression")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPULinearRegression
+    assert clone.uid == stage.uid
+    for p in TPULinearRegression.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpulinearregressionmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.models.linear import TPULinearRegressionModel
+    stage = TPULinearRegressionModel()
+    assert stage.uid.startswith("TPULinearRegressionModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPULinearRegressionModel
+    assert clone.uid == stage.uid
+    for p in TPULinearRegressionModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpulogisticregression_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.models.linear import TPULogisticRegression
+    stage = TPULogisticRegression()
+    assert stage.uid.startswith("TPULogisticRegression")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPULogisticRegression
+    assert clone.uid == stage.uid
+    for p in TPULogisticRegression.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpulogisticregressionmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.models.linear import TPULogisticRegressionModel
+    stage = TPULogisticRegressionModel()
+    assert stage.uid.startswith("TPULogisticRegressionModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPULogisticRegressionModel
+    assert clone.uid == stage.uid
+    for p in TPULogisticRegressionModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tpumodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    stage = TPUModel()
+    assert stage.uid.startswith("TPUModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TPUModel
+    assert clone.uid == stage.uid
+    for p in TPUModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_textfeaturizer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import TextFeaturizer
+    stage = TextFeaturizer()
+    assert stage.uid.startswith("TextFeaturizer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TextFeaturizer
+    assert clone.uid == stage.uid
+    for p in TextFeaturizer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_textfeaturizermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import TextFeaturizerModel
+    stage = TextFeaturizerModel()
+    assert stage.uid.startswith("TextFeaturizerModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TextFeaturizerModel
+    assert clone.uid == stage.uid
+    for p in TextFeaturizerModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_textpreprocessor_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import TextPreprocessor
+    stage = TextPreprocessor()
+    assert stage.uid.startswith("TextPreprocessor")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TextPreprocessor
+    assert clone.uid == stage.uid
+    for p in TextPreprocessor.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_timeintervalminibatchtransformer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.io.minibatch import TimeIntervalMiniBatchTransformer
+    stage = TimeIntervalMiniBatchTransformer()
+    assert stage.uid.startswith("TimeIntervalMiniBatchTransformer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TimeIntervalMiniBatchTransformer
+    assert clone.uid == stage.uid
+    for p in TimeIntervalMiniBatchTransformer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_timer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import Timer
+    stage = Timer()
+    assert stage.uid.startswith("Timer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Timer
+    assert clone.uid == stage.uid
+    for p in Timer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_timermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import TimerModel
+    stage = TimerModel()
+    assert stage.uid.startswith("TimerModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TimerModel
+    assert clone.uid == stage.uid
+    for p in TimerModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tokenizer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.text import Tokenizer
+    stage = Tokenizer()
+    assert stage.uid.startswith("Tokenizer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is Tokenizer
+    assert clone.uid == stage.uid
+    for p in Tokenizer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_trainclassifier_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.train import TrainClassifier
+    stage = TrainClassifier()
+    assert stage.uid.startswith("TrainClassifier")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TrainClassifier
+    assert clone.uid == stage.uid
+    for p in TrainClassifier.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_trainregressor_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.train import TrainRegressor
+    stage = TrainRegressor()
+    assert stage.uid.startswith("TrainRegressor")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TrainRegressor
+    assert clone.uid == stage.uid
+    for p in TrainRegressor.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_trainedclassifiermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.train import TrainedClassifierModel
+    stage = TrainedClassifierModel()
+    assert stage.uid.startswith("TrainedClassifierModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TrainedClassifierModel
+    assert clone.uid == stage.uid
+    for p in TrainedClassifierModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_trainedregressormodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.train import TrainedRegressorModel
+    stage = TrainedRegressorModel()
+    assert stage.uid.startswith("TrainedRegressorModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TrainedRegressorModel
+    assert clone.uid == stage.uid
+    for p in TrainedRegressorModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tunehyperparameters_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.tuning import TuneHyperparameters
+    stage = TuneHyperparameters()
+    assert stage.uid.startswith("TuneHyperparameters")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TuneHyperparameters
+    assert clone.uid == stage.uid
+    for p in TuneHyperparameters.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_tunehyperparametersmodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.automl.tuning import TuneHyperparametersModel
+    stage = TuneHyperparametersModel()
+    assert stage.uid.startswith("TuneHyperparametersModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is TuneHyperparametersModel
+    assert clone.uid == stage.uid
+    for p in TuneHyperparametersModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_udftransformer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.basic import UDFTransformer
+    stage = UDFTransformer()
+    assert stage.uid.startswith("UDFTransformer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is UDFTransformer
+    assert clone.uid == stage.uid
+    for p in UDFTransformer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_unrollimage_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.image import UnrollImage
+    stage = UnrollImage()
+    assert stage.uid.startswith("UnrollImage")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is UnrollImage
+    assert clone.uid == stage.uid
+    for p in UnrollImage.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_valueindexer_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import ValueIndexer
+    stage = ValueIndexer()
+    assert stage.uid.startswith("ValueIndexer")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ValueIndexer
+    assert clone.uid == stage.uid
+    for p in ValueIndexer.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_valueindexermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import ValueIndexerModel
+    stage = ValueIndexerModel()
+    assert stage.uid.startswith("ValueIndexerModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is ValueIndexerModel
+    assert clone.uid == stage.uid
+    for p in ValueIndexerModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)

@@ -368,6 +368,26 @@ class DataTable:
         for start in range(0, self._n_rows, batch_size):
             yield self.slice(start, start + batch_size)
 
+    # -- fluent sugar (ref: core/spark FluentAPI.scala:12-24
+    # df.mlTransform(stage, ...)) --------------------------------------
+
+    def ml_transform(self, *stages) -> "DataTable":
+        """Apply transformers (or fitted models) in sequence:
+        ``table.ml_transform(resize, unroll, model)``. An Estimator in
+        the chain is fitted on the current table first (the fluent
+        convenience the reference's DataFrameSugars provide)."""
+        from mmlspark_tpu.core.stage import Estimator
+        out = self
+        for stage in stages:
+            if isinstance(stage, Estimator):
+                stage = stage.fit(out)
+            out = stage.transform(out)
+        return out
+
+    def ml_fit(self, estimator):
+        """``table.ml_fit(est)`` -> fitted model."""
+        return estimator.fit(self)
+
     # -- misc --------------------------------------------------------------
 
     def cache(self) -> "DataTable":

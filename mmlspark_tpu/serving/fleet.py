@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import json
 import urllib.request
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.params import IntParam
@@ -45,9 +45,14 @@ class ServingFleet:
             for _ in range(n_engines):
                 source = HTTPSource(host=host, port=port)
                 port = source.port + 1      # skip whatever port-scan used
-                self.engines.append(ServingEngine(
-                    source, pipeline, reply_col=reply_col,
-                    batch_size=batch_size).start())
+                try:
+                    engine = ServingEngine(source, pipeline,
+                                           reply_col=reply_col,
+                                           batch_size=batch_size).start()
+                except Exception:
+                    source.close()   # don't orphan the bound port
+                    raise
+                self.engines.append(engine)
         except Exception:
             # partial construction must not leak threads/bound ports
             self.stop_all()
@@ -115,11 +120,13 @@ class PartitionConsolidator(Transformer):
             raise ValueError(
                 f"hostIndex {index} out of range for hostCount {count}")
         if count <= 1:
-            return table   # eager tables are already one partition
+            # consolidate: downstream shard-aware consumers must see ONE
+            # logical partition (that is this stage's whole purpose)
+            return table.repartition(1)
         return dist.shard_table_for_host(
             table, dist.HostInfo(process_index=index, process_count=count,
                                  local_device_count=0,
-                                 global_device_count=0))
+                                 global_device_count=0)).repartition(1)
 
     def transform_schema(self, schema: Schema) -> Schema:
         return schema

@@ -122,3 +122,29 @@ def test_distinct_values():
     t = DataTable({"a": [1, 2, 2, 3], "b": ["x", "x", "y", "z"]})
     assert sorted(t.distinct_values("a")) == [1, 2, 3]
     assert sorted(t.distinct_values("b")) == ["x", "y", "z"]
+
+
+class TestFluentAPI:
+    """df.mlTransform sugar (ref: core/spark FluentAPI.scala:12-24)."""
+
+    def test_ml_transform_chain(self):
+        import numpy as np
+        from mmlspark_tpu.stages import DropColumns, RenameColumn
+        t = DataTable({"a": np.arange(4.0), "b": np.arange(4.0) * 2})
+        out = t.ml_transform(RenameColumn(inputCol="a", outputCol="a2"),
+                             DropColumns(cols=["b"]))
+        assert out.column_names == ["a2"]
+
+    def test_ml_transform_fits_estimators_inline(self):
+        import numpy as np
+        from mmlspark_tpu.stages import ValueIndexer
+        t = DataTable({"cat": ["x", "y", "x", "z"]})
+        out = t.ml_transform(ValueIndexer(inputCol="cat", outputCol="ci"))
+        assert sorted(set(out["ci"])) == [0.0, 1.0, 2.0]
+
+    def test_ml_fit(self):
+        import numpy as np
+        from mmlspark_tpu.stages import ValueIndexer
+        t = DataTable({"cat": ["x", "y"]})
+        model = t.ml_fit(ValueIndexer(inputCol="cat", outputCol="ci"))
+        assert len(model.transform(t)) == 2
