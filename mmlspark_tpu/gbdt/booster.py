@@ -293,6 +293,10 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     else:
         y_pad, w_pad = y, w_base
     n_padded = n + pad
+    # features-major layout: per-split column reads become contiguous
+    # rows and the Pallas kernel consumes (F, N) directly (see
+    # tree.grow_tree docstring)
+    bins_np = np.ascontiguousarray(bins_np.T)
 
     # 3) init scores
     if p["boost_from_average"]:
@@ -320,8 +324,10 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
 
     if data_parallel:
         shard = mesh_lib.data_sharding(mesh)
-        bins_d = jax.device_put(jnp.asarray(bins_np, jnp.int32),
-                                mesh_lib.data_sharding(mesh, 2))
+        bins_d = jax.device_put(
+            jnp.asarray(bins_np, jnp.int32),
+            jax.sharding.NamedSharding(
+                mesh, P(None, mesh_lib.DATA_AXIS)))   # rows on data axis
         y_d = jax.device_put(jnp.asarray(y_pad, jnp.float32), shard)
         scores = jax.device_put(
             jnp.broadcast_to(jnp.asarray(init_score, jnp.float32)[:, None],
@@ -498,7 +504,7 @@ def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
     tree_spec = Tree(*([P()] * len(Tree._fields)))
     mapped = shard_map(
         step, mesh=mesh,
-        in_specs=(P(d, None), P(None, d), P(d), P(d), P(None),
+        in_specs=(P(None, d), P(None, d), P(d), P(d), P(None),
                   tree_spec, P()),
         out_specs=(P(None, d), tree_spec),
         check_vma=False)

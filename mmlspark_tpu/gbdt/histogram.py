@@ -7,12 +7,17 @@ sync happens inside ``LGBM_BoosterUpdateOneIter``). Here the histogram is
 an XLA program and the allreduce is ``lax.psum`` over the mesh's data
 axis — riding ICI instead of ethernet sockets.
 
-Two device strategies, one contract:
-  - 'scatter': segment_sum scatter-add. Best on CPU and fine on TPU for
-    small bin counts.
-  - 'onehot': stats×one-hot einsum over row chunks — turns the histogram
-    into matmuls the MXU executes directly. Chunked with lax.scan so peak
-    memory is chunk×F×B, not N×F×B.
+The binned matrix is FEATURES-MAJOR, (F, N) int32: rows (the reduction
+dim) live in the TPU lane dimension, per-feature reads are contiguous,
+and the Pallas kernel consumes the layout without a transpose.
+
+Three device strategies, one contract:
+  - 'pallas': VMEM-resident bin one-hot contracted on the MXU — the TPU
+    production path (see pallas_hist.py).
+  - 'scatter': segment_sum scatter-add. The CPU-backend default;
+    hundreds of times slower than the matmul paths on TPU.
+  - 'onehot': stats×one-hot einsum over row chunks via lax.scan —
+    portable fallback; round-trips the one-hot through HBM.
 
 Output layout: (3, L, F, B) float32 — channels grad / hess / count,
 L leaf slots, F features, B bins.
@@ -20,7 +25,6 @@ L leaf slots, F features, B bins.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -35,9 +39,10 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     axis_name: Optional[str] = None) -> jnp.ndarray:
     """Per-(leaf, feature, bin) sums of grad/hess/count.
 
-    bins: (N, F) int32; grad/hess/weight: (N,) f32; leaf_of_row: (N,) int32.
-    weight doubles as the padding/bagging mask (0 = row ignored).
-    Returns (3, L, F, B) f32, psum'd over ``axis_name`` when given.
+    bins: (F, N) int32 features-major; grad/hess/weight: (N,) f32;
+    leaf_of_row: (N,) int32. weight doubles as the padding/bagging mask
+    (0 = row ignored). Returns (3, L, F, B) f32, psum'd over
+    ``axis_name`` when given.
     """
     if method == "onehot":
         hist = _hist_onehot(bins, grad, hess, weight, leaf_of_row,
@@ -57,14 +62,15 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
 def _hist_scatter(bins, grad, hess, weight, leaf_of_row,
                   num_leaves, num_bins):
-    n, f = bins.shape
+    f, n = bins.shape
     lfb = num_leaves * f * num_bins
-    # flat segment id per (row, feature): ((leaf * F) + f) * B + bin
-    seg = (leaf_of_row[:, None] * f + jnp.arange(f)[None, :]) * num_bins + bins
+    # flat segment id per (feature, row): ((leaf * F) + f) * B + bin
+    seg = (leaf_of_row[None, :] * f
+           + jnp.arange(f)[:, None]) * num_bins + bins
     seg = seg.reshape(-1)
 
     def one(values):
-        v = jnp.broadcast_to(values[:, None], (n, f)).reshape(-1)
+        v = jnp.broadcast_to(values[None, :], (f, n)).reshape(-1)
         return jax.ops.segment_sum(v, seg, num_segments=lfb,
                                    indices_are_sorted=False)
 
@@ -76,30 +82,30 @@ def _hist_scatter(bins, grad, hess, weight, leaf_of_row,
 
 def _hist_onehot(bins, grad, hess, weight, leaf_of_row,
                  num_leaves, num_bins, chunk: int = 4096):
-    n, f = bins.shape
+    f, n = bins.shape
     x = f * num_bins
     pad = (-n) % chunk
     if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
         grad = jnp.pad(grad, (0, pad))
         hess = jnp.pad(hess, (0, pad))
         weight = jnp.pad(weight, (0, pad))  # pad rows weight 0 → no effect
         leaf_of_row = jnp.pad(leaf_of_row, (0, pad))
     steps = (n + pad) // chunk
-    bins_c = bins.reshape(steps, chunk, f)
+    bins_c = bins.reshape(f, steps, chunk).transpose(1, 0, 2)  # (S, F, C)
     grad_c = grad.reshape(steps, chunk)
     hess_c = hess.reshape(steps, chunk)
     w_c = weight.reshape(steps, chunk)
     leaf_c = leaf_of_row.reshape(steps, chunk)
 
     def body(acc, args):
-        b, g, h, w, l = args
+        b, g, h, w, l = args                                  # b: (F, C)
         stats = jnp.stack([g * w, h * w, w], axis=0)          # (3, C)
         leaf_oh = jax.nn.one_hot(l, num_leaves,
                                  dtype=jnp.float32)            # (C, L)
         lhs = stats[:, None, :] * leaf_oh.T[None, :, :]        # (3, L, C)
-        bin_oh = jax.nn.one_hot(b, num_bins, dtype=jnp.float32)  # (C, F, B)
-        rhs = bin_oh.reshape(chunk, x)                         # (C, F*B)
+        bin_oh = jax.nn.one_hot(b, num_bins, dtype=jnp.float32)  # (F, C, B)
+        rhs = bin_oh.transpose(1, 0, 2).reshape(chunk, x)      # (C, F*B)
         contrib = jnp.einsum(
             "slc,cx->slx", lhs, rhs,
             preferred_element_type=jnp.float32)                # (3, L, X)

@@ -15,9 +15,9 @@ Two layout decisions carry the performance:
     the MXU sublane axis where it pads 3->8, not the lane axis where it
     would pad 3->128 — a 16x difference in matmul work;
   - block shapes obey Mosaic's tiling rules ((8, 128)-divisible or
-    full-dimension): bins ship transposed (F_p, N_p) blocked (fc, C)
-    and are re-laid out to (C, fc) in VMEM (a few KB); num_bins is
-    padded to a multiple of 32 so fc*B is always 128-divisible.
+    full-dimension): bins arrive features-major (F, N) — the layout the
+    whole GBDT engine stores — blocked (fc, C); num_bins is padded to a
+    multiple of 32 so fc*B is always 128-divisible.
 
 Row-chunk grid steps accumulate into the same output block, which is
 safe because TPU grid iterations execute sequentially on a core.
@@ -36,8 +36,12 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-ROW_CHUNK = 512           # multiple of 128 (lane dim of the bins block)
-VMEM_ONEHOT_ELEMS = 2048  # fc*B budget: onehot block = C*fc*B*4 bytes
+ROW_CHUNK = 512            # multiple of 128 (lane dim of the bins block)
+ROW_CHUNK_SINGLE = 2048    # L==1 hot path: fewer grid steps (the per-
+                           # step overhead dominates at C=512), bigger
+                           # VMEM onehot block is affordable without the
+                           # (3L, C) leaf-weighted lhs
+VMEM_ONEHOT_BYTES = 8 << 20   # onehot block budget: c*fc*B*4 bytes
 
 
 def _hist_kernel(bins_ref, stats_ref, leaf_ref, out_ref, *,
@@ -87,38 +91,45 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 interpret: bool = False) -> jnp.ndarray:
     """(3, L, F, B) float32 histogram via the Pallas MXU kernel.
 
+    ``bins`` is features-major (F, N) — consumed directly, no transpose.
     Same contract as histogram.build_histogram's other methods; rows
     with weight 0 (padding/bagging) contribute nothing.
     """
-    n, f = bins.shape
+    f, n = bins.shape
 
     # bins padded to a multiple of 32 keeps fc*B 128-divisible for any
     # fc that is a multiple of 8 (bin values never reach the pad slots)
     b_pad = -(-num_bins // 32) * 32
 
-    # row chunk: one full chunk for small inputs, else ROW_CHUNK slices
-    if n >= ROW_CHUNK:
-        c = ROW_CHUNK
+    # row chunk: one full chunk for small inputs, else fixed slices —
+    # capped so the one-hot block (c * fc * B * 4 bytes, fc >= 8) can
+    # never exceed the VMEM budget even at the fc floor
+    row_chunk = ROW_CHUNK_SINGLE if num_leaves == 1 else ROW_CHUNK
+    row_cap = max(128, (VMEM_ONEHOT_BYTES // 4 // (8 * b_pad))
+                  // 128 * 128)
+    row_chunk = min(row_chunk, row_cap)
+    if n >= row_chunk:
+        c = row_chunk
     else:
         c = n + ((-n) % 8)          # single chunk, sublane-aligned
     pad_rows = (-n) % c
 
-    # feature chunk: bounded so the VMEM one-hot block stays ~4 MB
-    fc = max(8, (VMEM_ONEHOT_ELEMS // b_pad) // 8 * 8)
+    # feature chunk: bounded so the VMEM one-hot block fits the budget
+    elems = VMEM_ONEHOT_BYTES // 4 // c
+    fc = max(8, (elems // b_pad) // 8 * 8)
     fc = min(fc, f + ((-f) % 8))
     pad_feats = (-f) % fc
 
     if pad_rows:
-        bins = jnp.pad(bins, ((0, pad_rows), (0, 0)))
+        bins = jnp.pad(bins, ((0, 0), (0, pad_rows)))
         grad = jnp.pad(grad, (0, pad_rows))
         hess = jnp.pad(hess, (0, pad_rows))
         weight = jnp.pad(weight, (0, pad_rows))   # 0-weight padding
         leaf_of_row = jnp.pad(leaf_of_row, (0, pad_rows))
     if pad_feats:
-        bins = jnp.pad(bins, ((0, 0), (0, pad_feats)))
-    n_p, f_p = bins.shape
+        bins = jnp.pad(bins, ((0, pad_feats), (0, 0)))
+    f_p, n_p = bins.shape
 
-    bins_t = bins.T                                      # (F_p, N_p)
     stats = jnp.stack([grad * weight, hess * weight, weight],
                       axis=0).astype(jnp.float32)        # (3, N_p)
     leaf2 = leaf_of_row.astype(jnp.int32)[None, :]       # (1, N_p)
@@ -138,7 +149,7 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct(
             (3 * num_leaves, f_p * b_pad), jnp.float32),
         interpret=interpret,
-    )(bins_t, stats, leaf2)
+    )(bins, stats, leaf2)
 
     # (3L, F_p*B_pad) -> (3, L, F, B)
     hist = out.reshape(3, num_leaves, f_p, b_pad)

@@ -78,7 +78,11 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               p: GrowParams, axis_name: Optional[str] = None):
     """Grow one tree; returns (Tree, leaf_of_row, leaf_values_per_slot).
 
-    bins (N,F) int32; grad/hess/weight (N,) f32; feature_mask (F,) f32
+    bins is FEATURES-MAJOR (F, N) int32 — row-major (N, F) would make
+    the per-split split-column read a strided gather (one useful lane
+    per 512-byte read on TPU); features-major makes it one contiguous
+    row and is the layout the Pallas kernel consumes directly.
+    grad/hess/weight (N,) f32; feature_mask (F,) f32
     (0 disables a feature this tree — featureFraction sampling).
 
     Histogram-cache + subtraction growth (LightGBM's strategy, ref:
@@ -91,7 +95,7 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     feasible and infeasible at HIGGS scale (255 leaves) for the
     MXU matmul formulations.
     """
-    n, f = bins.shape
+    f, n = bins.shape
     L = p.num_leaves
     M = 2 * L - 1
     B = p.num_bins
@@ -168,7 +172,7 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             & (best_gain > NEG_INF / 2)
 
         new_leaf = st["n_leaves"]
-        goes_right = (st["leaf_of_row"] == bl) & (bins[:, bf] > bb)
+        goes_right = (st["leaf_of_row"] == bl) & (bins[bf] > bb)
         leaf_of_row2 = jnp.where(goes_right & do, new_leaf,
                                  st["leaf_of_row"])
 

@@ -79,7 +79,7 @@ class TestHistogram:
         w = (rng.random(n) < 0.8).astype(np.float32)
         leaf = rng.integers(0, L, size=n).astype(np.int32)
         hist = np.asarray(build_histogram(
-            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(bins.T), jnp.asarray(grad), jnp.asarray(hess),
             jnp.asarray(w), jnp.asarray(leaf), L, B, method="scatter"))
         # numpy reference
         ref = np.zeros((3, L, f, B), np.float64)
@@ -93,7 +93,7 @@ class TestHistogram:
     def test_onehot_matches_scatter(self):
         rng = np.random.default_rng(1)
         n, f, L, B = 500, 4, 6, 16
-        bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+        bins = jnp.asarray(rng.integers(0, B, size=(f, n)), jnp.int32)
         grad = jnp.asarray(rng.normal(size=n), jnp.float32)
         hess = jnp.asarray(rng.uniform(0.1, 1, size=n), jnp.float32)
         w = jnp.ones(n, jnp.float32)
@@ -113,7 +113,7 @@ class TestHistogram:
         # (weight 0), row-chunk accumulation across grid steps, and
         # multi-feature-chunk block indexing must agree with scatter
         rng = np.random.default_rng(2)
-        bins = jnp.asarray(rng.integers(0, B, size=(n, f)), jnp.int32)
+        bins = jnp.asarray(rng.integers(0, B, size=(f, n)), jnp.int32)
         grad = jnp.asarray(rng.normal(size=n), jnp.float32)
         hess = jnp.asarray(rng.uniform(0.1, 1, size=n), jnp.float32)
         w = jnp.asarray((rng.random(n) < 0.8), jnp.float32)
