@@ -1,0 +1,74 @@
+"""Long-context LM training with sequence parallelism.
+
+No reference-notebook twin — this is the capability the TPU build adds
+beyond the reference (SURVEY §5 long-context): a decoder-only LM whose
+sequence dimension is sharded across the mesh, attention running as a
+ppermute ring (exact online-softmax) so the per-device memory stays
+O(L/num_shards). The same weights run dense on one device or ring/
+Ulysses on a pod; gradients are bit-checked against dense attention in
+tests/test_ring_attention.py.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from mmlspark_tpu.models.networks import Transformer
+from mmlspark_tpu.parallel import mesh as mesh_lib
+from mmlspark_tpu.parallel.ring_attention import (
+    make_seq_parallel_train_step,
+)
+
+VOCAB, DIM, DEPTH, HEADS = 64, 32, 2, 4
+
+
+def make_copy_task(n, length, seed=0):
+    """Tokens repeat with period 4 — predictable only from context."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(1, VOCAB, size=(n, 4))
+    toks = np.tile(base, (1, length // 4))[:, :length]
+    targets = np.roll(toks, -1, axis=1)
+    return jnp.asarray(toks, jnp.int32), jnp.asarray(targets, jnp.int32)
+
+
+def main():
+    n_dev = len(jax.devices())
+    seq_shards = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    data = n_dev // seq_shards
+    mesh = mesh_lib.make_mesh({"data": data, "seq": seq_shards})
+    L = 16 * seq_shards    # global sequence, sharded over the seq axis
+
+    module = Transformer(vocab_size=VOCAB, dim=DIM, depth=DEPTH,
+                         heads=HEADS, max_len=L, seq_axis="seq",
+                         seq_impl="ring")
+    dense = Transformer(vocab_size=VOCAB, dim=DIM, depth=DEPTH,
+                        heads=HEADS, max_len=L)
+
+    toks, targets = make_copy_task(4 * data, L)
+    params = dense.init(jax.random.PRNGKey(0), toks[:1])
+    opt = optax.adam(3e-3)
+    opt_state = opt.init(params)
+    step = make_seq_parallel_train_step(module, mesh, opt)
+
+    first = last = None
+    for i in range(60):
+        params, opt_state, loss = step(params, opt_state, toks, targets)
+        if i == 0:
+            first = loss
+        last = loss          # device arrays — no per-step host sync
+    first, last = float(first), float(last)
+    print(f"mesh={dict(mesh.shape)} global_seq={L}: "
+          f"loss {first:.3f} -> {last:.3f}")
+    assert last < first * 0.5, "LM failed to learn the periodic task"
+
+    # the SAME weights run dense on a single device
+    logits = dense.apply(params, toks[:1])
+    pred = np.asarray(jnp.argmax(logits[0, :-1], axis=-1))
+    acc = float((pred[4:] == np.asarray(toks[0, 5:])).mean())
+    print(f"dense single-device decode accuracy on the task: {acc:.2f}")
+    assert acc > 0.9
+
+
+if __name__ == "__main__":
+    main()

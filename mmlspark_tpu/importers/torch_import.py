@@ -95,6 +95,12 @@ class _TreeBuilder:
         self._set(self.params, flax_path + ["bias"],
                   _to_numpy(self.take(f"{torch_name}.bias")))
 
+    def layernorm(self, flax_path: List[str], torch_name: str):
+        self._set(self.params, flax_path + ["scale"],
+                  _to_numpy(self.take(f"{torch_name}.weight")))
+        self._set(self.params, flax_path + ["bias"],
+                  _to_numpy(self.take(f"{torch_name}.bias")))
+
     def batchnorm(self, flax_path: List[str], torch_name: str):
         self._set(self.params, flax_path + ["scale"],
                   _to_numpy(self.take(f"{torch_name}.weight")))
@@ -225,11 +231,38 @@ def _import_bilstm(sd: Dict[str, Any], spec: Dict[str, Any],
     return b.finish(strict)
 
 
+def _import_transformer(sd: Dict[str, Any], spec: Dict[str, Any],
+                        strict: bool,
+                        input_shape: Optional[List[int]]) -> Dict[str, Any]:
+    """GPT-2-shaped torch decoder -> Transformer variables.
+
+    Expected torch names (the GPT-2 block structure with fused qkv):
+    ``embed`` (nn.Embedding), ``pos_embed`` (nn.Parameter (max_len, D)),
+    ``block_{i}.ln1/qkv/proj/ln2/mlp_up/mlp_down``, ``ln_f``, and
+    ``lm_head`` (or ``head`` when num_classes > 0). qkv packs q|k|v
+    along the output dim, matching TransformerBlock's fused Dense."""
+    b = _TreeBuilder(sd)
+    b._set(b.params, ["embed", "embedding"],
+           _to_numpy(b.take("embed.weight")))
+    b._set(b.params, ["pos_embed"], _to_numpy(b.take("pos_embed")))
+    for i in range(int(spec.get("depth", 4))):
+        t = f"block_{i}"
+        for ln in ("ln1", "ln2"):
+            b.layernorm([t, ln], f"{t}.{ln}")
+        for lin in ("qkv", "proj", "mlp_up", "mlp_down"):
+            b.linear([t, lin], f"{t}.{lin}")
+    b.layernorm(["ln_f"], "ln_f")
+    head = "head" if int(spec.get("num_classes", 0)) > 0 else "lm_head"
+    b.linear([head], head)
+    return b.finish(strict)
+
+
 _IMPORTERS = {
     "resnet": _import_resnet,
     "convnet": _import_convnet,
     "mlp": _import_mlp,
     "bilstm": _import_bilstm,
+    "transformer": _import_transformer,
 }
 
 

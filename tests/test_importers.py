@@ -116,6 +116,52 @@ class TBiLSTM(tnn.Module):
         return self.head(h)
 
 
+class TTBlock(tnn.Module):
+    """GPT-2-shaped pre-LN decoder block, fused qkv, tanh-gelu."""
+
+    def __init__(self, d, heads):
+        super().__init__()
+        self.ln1 = tnn.LayerNorm(d, eps=1e-6)
+        self.qkv = tnn.Linear(d, 3 * d)
+        self.proj = tnn.Linear(d, d)
+        self.ln2 = tnn.LayerNorm(d, eps=1e-6)
+        self.mlp_up = tnn.Linear(d, 4 * d)
+        self.mlp_down = tnn.Linear(4 * d, d)
+        self.heads = heads
+
+    def forward(self, x):
+        b, l, d = x.shape
+        hd = d // self.heads
+        q, k, v = self.qkv(self.ln1(x)).chunk(3, dim=-1)
+        q = q.view(b, l, self.heads, hd).transpose(1, 2)
+        k = k.view(b, l, self.heads, hd).transpose(1, 2)
+        v = v.view(b, l, self.heads, hd).transpose(1, 2)
+        a = tnn.functional.scaled_dot_product_attention(
+            q, k, v, is_causal=True)
+        x = x + self.proj(a.transpose(1, 2).reshape(b, l, d))
+        y = tnn.functional.gelu(self.mlp_up(self.ln2(x)),
+                                approximate="tanh")
+        return x + self.mlp_down(y)
+
+
+class TTransformer(tnn.Module):
+    def __init__(self, vocab=50, d=16, depth=2, heads=4, max_len=10):
+        super().__init__()
+        self.embed = tnn.Embedding(vocab, d)
+        self.pos_embed = tnn.Parameter(torch.randn(max_len, d) * 0.02)
+        for i in range(depth):
+            setattr(self, f"block_{i}", TTBlock(d, heads))
+        self.depth = depth
+        self.ln_f = tnn.LayerNorm(d, eps=1e-6)
+        self.lm_head = tnn.Linear(d, vocab)
+
+    def forward(self, tokens):
+        x = self.embed(tokens) + self.pos_embed[:tokens.shape[1]]
+        for i in range(self.depth):
+            x = getattr(self, f"block_{i}")(x)
+        return self.lm_head(self.ln_f(x))
+
+
 class TMLP(tnn.Module):
     def __init__(self, dims=(20, 16, 8), classes=3):
         super().__init__()
@@ -214,6 +260,23 @@ class TestTorchImportFidelity:
         got = np.asarray(build_network(spec).apply(
             variables, jnp.asarray(toks.numpy())))
         np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_transformer_outputs_match(self):
+        # GPT-2-shaped decoder ingestion: fused-qkv packing, pre-LN,
+        # causal attention, and tanh-gelu must reproduce torch logits
+        torch.manual_seed(4)
+        model = TTransformer(vocab=50, d=16, depth=2, heads=4,
+                             max_len=10).eval()
+        spec = {"type": "transformer", "vocab_size": 50, "dim": 16,
+                "depth": 2, "heads": 4, "max_len": 10}
+        variables = import_torch_checkpoint(
+            model.state_dict(), spec, validate_input_shape=[10])
+        toks = torch.randint(0, 50, (2, 10))
+        with torch.no_grad():
+            ref = model(toks).numpy()
+        got = np.asarray(build_network(spec).apply(
+            variables, jnp.asarray(toks.numpy())))
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-3)
 
     def test_pt_file_roundtrip(self, trained_torch_resnet, tmp_path):
         path = str(tmp_path / "resnet.pt")
