@@ -229,7 +229,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
           sample_weight: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
           feature_names: Optional[List[str]] = None,
-          mesh: Optional[Mesh] = None) -> Booster:
+          mesh: Optional[Mesh] = None,
+          init_model: Optional["Booster | str"] = None) -> Booster:
     """Train a Booster. ``parallelism='data'`` shards rows over ``mesh``'s
     data axis and psums histograms (LightGBM data-parallel tree learner
     analog, ref: TrainParams.scala:26).
@@ -237,7 +238,13 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     ``X`` is either a dense (N, F) matrix with ``y`` labels, or — for
     datasets that should not be materialized as floats at once — an
     iterable of ``(X_shard, y_shard[, w_shard])`` tuples with ``y=None``
-    (only the int32 binned matrix is kept per shard)."""
+    (only the int32 binned matrix is kept per shard).
+
+    ``init_model`` (Booster or model string) warm-starts boosting: the
+    run continues from the given forest's scores and the returned
+    Booster carries old + new trees (ref: TrainUtils.scala:74-77
+    modelString warm start). Requires dense ``X`` (the base forest is
+    scored on the raw features)."""
     p = dict(DEFAULTS)
     p.update(params or {})
     if p["hist_method"] == "auto":
@@ -298,8 +305,33 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # tree.grow_tree docstring)
     bins_np = np.ascontiguousarray(bins_np.T)
 
-    # 3) init scores
-    if p["boost_from_average"]:
+    # 3) init scores — fresh start or warm start from a base forest
+    base_model: Optional[Booster] = None
+    if init_model is not None:
+        base_model = (Booster.from_string(init_model)
+                      if isinstance(init_model, str) else init_model)
+        if not isinstance(X, np.ndarray):
+            raise ValueError("init_model warm start requires dense X")
+        if base_model.num_class != K:
+            raise ValueError(
+                f"init_model has {base_model.num_class} classes, "
+                f"objective expects {K}")
+        if base_model.objective.name != objective.name:
+            raise ValueError(
+                f"init_model was trained with objective "
+                f"{base_model.objective.name!r}; resuming as "
+                f"{objective.name!r} would mix link spaces")
+        init_score = base_model.init_score
+        # score + merge against the base model's EFFECTIVE forest: an
+        # early-stopped base contributes only its best_iteration trees
+        # (raw_score truncates the same way)
+        base_eff_trees = base_model._resolve_iterations(None) * K
+        base_raw = base_model.raw_score(X)             # (N,) or (K, N)
+        if K == 1:
+            base_raw = base_raw[None, :]
+        base_scores = np.pad(base_raw.astype(np.float32),
+                             ((0, 0), (0, pad)))
+    elif p["boost_from_average"]:
         init_score = objective.init_score(y, w_base)
     else:
         init_score = np.zeros(K)
@@ -322,6 +354,10 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
          float(p["tweedie_variance_power"])),
         gp, lr, K, axis_name, mesh)
 
+    scores_np = (base_scores if base_model is not None
+                 else np.broadcast_to(
+                     np.asarray(init_score, np.float32)[:, None],
+                     (K, n_padded)))
     if data_parallel:
         shard = mesh_lib.data_sharding(mesh)
         bins_d = jax.device_put(
@@ -330,15 +366,12 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                 mesh, P(None, mesh_lib.DATA_AXIS)))   # rows on data axis
         y_d = jax.device_put(jnp.asarray(y_pad, jnp.float32), shard)
         scores = jax.device_put(
-            jnp.broadcast_to(jnp.asarray(init_score, jnp.float32)[:, None],
-                             (K, n_padded)),
+            jnp.asarray(scores_np, jnp.float32),
             jax.sharding.NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS)))
     else:
         bins_d = jnp.asarray(bins_np, jnp.int32)
         y_d = jnp.asarray(y_pad, jnp.float32)
-        scores = jnp.broadcast_to(
-            jnp.asarray(init_score, jnp.float32)[:, None],
-            (K, n_padded))
+        scores = jnp.asarray(scores_np, jnp.float32)
 
     rng = np.random.default_rng(p["seed"])
 
@@ -353,9 +386,16 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             mapper.transform(np.asarray(valid[0], dtype=np.float64))
             .astype(np.float32))
         yv = jnp.asarray(np.asarray(valid[1], dtype=np.float32))
-        v_scores = jnp.broadcast_to(
-            jnp.asarray(init_score, jnp.float32)[:, None],
-            (K, bins_v.shape[0]))
+        if base_model is not None:
+            v_raw = base_model.raw_score(
+                np.asarray(valid[0], dtype=np.float64))
+            if K == 1:
+                v_raw = v_raw[None, :]
+            v_scores = jnp.asarray(v_raw, jnp.float32)
+        else:
+            v_scores = jnp.broadcast_to(
+                jnp.asarray(init_score, jnp.float32)[:, None],
+                (K, bins_v.shape[0]))
     best_loss = np.inf
     best_iter = -1
     # one fixed walk length -> one predict_trees compile for the whole
@@ -439,9 +479,46 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     else:
         stacked = {}
         tree_depths = []
+
+    if base_model is not None and base_eff_trees > 0:
+        base_trees = {key: v[:base_eff_trees]
+                      for key, v in base_model.trees.items()}
+        stacked = _concat_forests(base_trees, stacked)
+        tree_depths = (list(base_model.tree_depths[:base_eff_trees])
+                       + tree_depths)
+        if best_iter > 0:
+            best_iter += base_eff_trees // K
     return Booster(objective, stacked, init_score, K, feature_names, p,
                    best_iteration=best_iter if esr > 0 else -1,
                    tree_depths=tree_depths)
+
+
+def _pad_nodes(v: np.ndarray, m: int, key: str) -> np.ndarray:
+    """Grow a (T, M) tree-array's node dim with inert self-loop leaves."""
+    t, cur = v.shape
+    if cur == m:
+        return v
+    pad = m - cur
+    if key in ("left", "right"):
+        idx = np.broadcast_to(np.arange(cur, m), (t, pad))
+        return np.concatenate([v, idx.astype(v.dtype)], axis=1)
+    if key == "is_leaf":
+        return np.concatenate([v, np.ones((t, pad), v.dtype)], axis=1)
+    return np.concatenate([v, np.zeros((t, pad), v.dtype)], axis=1)
+
+
+def _concat_forests(a: Dict[str, np.ndarray],
+                    b: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Stack two stacked-tree dicts along T, padding node dims to match
+    (warm start may use a different num_leaves than the base model)."""
+    if not a:
+        return b
+    if not b:
+        return a
+    m = max(a["feature"].shape[1], b["feature"].shape[1])
+    return {key: np.concatenate(
+        [_pad_nodes(a[key], m, key), _pad_nodes(b[key], m, key)], axis=0)
+        for key in b}
 
 
 def _maybe_shard(arr, mesh, data_parallel):

@@ -64,6 +64,10 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         default="serial")
     validationData = TableParam("held-out table for early stopping",
                                 default=None)
+    initModelString = StringParam(
+        "serialized booster to warm-start from "
+        "(ref: TrainParams modelString, TrainUtils.scala:74-77)",
+        default="")
 
     def _train_params(self) -> Dict[str, Any]:
         return {
@@ -130,7 +134,8 @@ class TPUBoostClassifier(Estimator, _BoostParams):
             params["num_class"] = num_class
         else:
             params["objective"] = "binary"
-        booster = train(params, X, y, sample_weight=w, valid=valid)
+        booster = train(params, X, y, sample_weight=w, valid=valid,
+                        init_model=self.get("initModelString") or None)
         model = TPUBoostClassificationModel(
             modelString=booster.model_to_string(),
             numClasses=num_class)
@@ -222,7 +227,8 @@ class TPUBoostRegressor(Estimator, _BoostParams):
         params["objective"] = self.get("objective")
         params["alpha"] = self.get("alpha")
         params["tweedie_variance_power"] = self.get("tweedieVariancePower")
-        booster = train(params, X, y, sample_weight=w, valid=valid)
+        booster = train(params, X, y, sample_weight=w, valid=valid,
+                        init_model=self.get("initModelString") or None)
         model = TPUBoostRegressionModel(modelString=booster.model_to_string())
         for name in ("featuresCol", "predictionCol"):
             model.set(name, self.get(name))

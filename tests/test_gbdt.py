@@ -259,6 +259,70 @@ class TestBoosterTraining:
         assert b.predict(X).mean() > bu.predict(X).mean()
 
 
+class TestWarmStart:
+    """modelString warm start (ref: TrainUtils.scala:74-77)."""
+
+    def test_warm_start_matches_single_run(self, breast_cancer):
+        X, y = breast_cancer
+        kw = {"objective": "binary", "num_iterations": 10}
+        full = train({**kw, "num_iterations": 20}, X, y)
+        first = train(kw, X, y)
+        resumed = train(kw, X, y, init_model=first.model_to_string())
+        assert resumed.num_trees == 20
+        np.testing.assert_allclose(resumed.predict(X), full.predict(X),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_warm_start_different_num_leaves(self, breast_cancer):
+        # the continuation may use a different tree size; node dims pad
+        X, y = breast_cancer
+        first = train({"objective": "binary", "num_iterations": 5,
+                       "num_leaves": 7}, X, y)
+        resumed = train({"objective": "binary", "num_iterations": 5,
+                         "num_leaves": 31}, X, y, init_model=first)
+        assert resumed.num_trees == 10
+        assert _auc(y, resumed.predict(X)) > _auc(y, first.predict(X))
+
+    def test_estimator_warm_start(self, breast_cancer):
+        X, y = breast_cancer
+        t = DataTable({"features": np.asarray(X, np.float64),
+                       "label": np.asarray(y, np.float64)})
+        m1 = TPUBoostClassifier(numIterations=5).fit(t)
+        m2 = TPUBoostClassifier(
+            numIterations=5,
+            initModelString=m1.get("modelString")).fit(t)
+        assert m2.get_booster().num_trees == 10
+
+    def test_early_stopped_base_truncated(self, breast_cancer):
+        # an early-stopped base contributes only its best_iteration
+        # trees to the continuation (raw_score truncates the same way)
+        X, y = breast_cancer
+        rng = np.random.default_rng(0)
+        idx = rng.permutation(len(y))
+        tr, te = idx[:350], idx[350:]
+        base = train({"objective": "binary", "num_iterations": 200,
+                      "early_stopping_round": 5},
+                     X[tr], y[tr], valid=(X[te], y[te]))
+        assert 0 < base.best_iteration < 200
+        resumed = train({"objective": "binary", "num_iterations": 3},
+                        X[tr], y[tr], init_model=base)
+        assert resumed.num_trees == base.best_iteration + 3
+
+    def test_objective_mismatch_rejected(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "regression", "num_iterations": 2}, X, y)
+        with pytest.raises(ValueError, match="link spaces"):
+            train({"objective": "binary", "num_iterations": 2}, X, y,
+                  init_model=b)
+
+    def test_class_mismatch_rejected(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 2}, X, y)
+        with pytest.raises(ValueError, match="classes"):
+            train({"objective": "multiclass", "num_class": 3,
+                   "num_iterations": 2}, X[:150],
+                  np.arange(150) % 3, init_model=b)
+
+
 class TestStreamingIngestion:
     def test_shard_stream_matches_dense(self, breast_cancer):
         # iterator-of-shards feed: only the binned int32 matrix is kept
