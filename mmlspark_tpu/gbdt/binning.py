@@ -89,6 +89,17 @@ class BinMapper:
             return np.inf
         return float(ub[int(bin_idx)])
 
+    def f32_safe(self) -> bool:
+        """True when every feature's bin boundaries stay distinct after
+        a float32 cast — the precondition for binning on device in f32.
+        Large-magnitude features (unix timestamps, IDs: >24-bit
+        mantissa) collapse adjacent boundaries and must bin in f64."""
+        for ub in self.upper_bounds:
+            ub32 = ub.astype(np.float32)
+            if len(ub32) > 1 and (np.diff(ub32) <= 0).any():
+                return False
+        return True
+
     def threshold_matrix(self, num_bins: int) -> np.ndarray:
         """(F, num_bins) lookup of bin_threshold_value for every (feature,
         bin) pair — lets the booster convert a whole stacked forest's bin

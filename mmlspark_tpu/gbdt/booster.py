@@ -85,26 +85,42 @@ class Booster:
             self.params.get("num_leaves", 31) - 1]
         return max(1, max(depths))
 
+    def _needs_f64_inference(self) -> bool:
+        """Thresholds beyond float32's 24-bit integer range (unix
+        timestamps, large IDs) lose split resolution on the jitted f32
+        walk; such forests score on host in float64."""
+        if not self.trees:
+            return False
+        thr = self.trees["threshold"][~self.trees["is_leaf"].astype(bool)]
+        finite = thr[np.isfinite(thr)]
+        return bool(len(finite)) and bool(
+            np.abs(finite).max() >= 2.0 ** 24)
+
     def raw_score(self, X: np.ndarray,
                   num_iteration: Optional[int] = None) -> np.ndarray:
         """Raw margin scores, shape (N,) or (K, N) for multiclass."""
-        X = np.asarray(X, dtype=np.float32)
-        n = X.shape[0]
+        n = np.asarray(X).shape[0]
         K = self.num_class
         it = self._resolve_iterations(num_iteration)
         t_limit = it * K
         scores = np.broadcast_to(
             self.init_score[:, None].astype(np.float32), (K, n)).copy()
         if t_limit > 0 and self.num_trees > 0:
-            out = predict_trees(
-                jnp.asarray(X),
-                jnp.asarray(self.trees["feature"][:t_limit]),
-                jnp.asarray(self.trees["threshold"][:t_limit]),
-                jnp.asarray(self.trees["left"][:t_limit]),
-                jnp.asarray(self.trees["right"][:t_limit]),
-                jnp.asarray(self.trees["value"][:t_limit]),
-                max_depth=self._max_depth(t_limit))   # (T, N)
-            out = np.asarray(out).reshape(it, K, n).sum(axis=0)
+            if self._needs_f64_inference():
+                out = _host_predict_trees(
+                    np.asarray(X, dtype=np.float64),
+                    {k: v[:t_limit] for k, v in self.trees.items()},
+                    self._max_depth(t_limit))
+            else:
+                out = np.asarray(predict_trees(
+                    jnp.asarray(np.asarray(X, dtype=np.float32)),
+                    jnp.asarray(self.trees["feature"][:t_limit]),
+                    jnp.asarray(self.trees["threshold"][:t_limit]),
+                    jnp.asarray(self.trees["left"][:t_limit]),
+                    jnp.asarray(self.trees["right"][:t_limit]),
+                    jnp.asarray(self.trees["value"][:t_limit]),
+                    max_depth=self._max_depth(t_limit)))   # (T, N)
+            out = out.reshape(it, K, n).sum(axis=0)
             scores += out
         return scores[0] if K == 1 else scores
 
@@ -175,7 +191,7 @@ class Booster:
             d["objective"], num_class=d["num_class"],
             alpha=0.9 if alpha is None else alpha,
             tweedie_variance_power=1.5 if rho is None else rho)
-        tree_dtypes = {"feature": np.int32, "threshold": np.float32,
+        tree_dtypes = {"feature": np.int32, "threshold": np.float64,
                        "left": np.int32, "right": np.int32,
                        "value": np.float32, "is_leaf": bool,
                        "gain": np.float32, "count": np.float32,
@@ -267,6 +283,9 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             raise ValueError(
                 "pass per-shard weights inside the shard tuples in "
                 "streaming mode")
+        if init_model is not None:
+            # fail fast — before consuming the (possibly huge) stream
+            raise ValueError("init_model warm start requires dense X")
         mapper, bins_np, y, w_base = _bin_stream(
             X, p["max_bin"], p["seed"])
         n, f = bins_np.shape
@@ -277,7 +296,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         w_base = (np.ones(n) if sample_weight is None
                   else np.asarray(sample_weight, dtype=np.float64))
         mapper = BinMapper.fit(X, max_bin=p["max_bin"], seed=p["seed"])
-        bins_np = mapper.transform(X)
+        bins_np = None   # dense path bins on device (below)
     if feature_names is None:
         feature_names = [f"Column_{i}" for i in range(f)]
     num_bins = int(mapper.num_bins.max())
@@ -294,24 +313,36 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
 
     pad = (-n) % max(n_shards, 1)
     if pad:
-        bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
         y_pad = np.pad(y, (0, pad))
         w_pad = np.pad(w_base, (0, pad))  # zero weight → padding inert
     else:
         y_pad, w_pad = y, w_base
     n_padded = n + pad
-    # features-major layout: per-split column reads become contiguous
-    # rows and the Pallas kernel consumes (F, N) directly (see
-    # tree.grow_tree docstring)
-    bins_np = np.ascontiguousarray(bins_np.T)
+    # features-major (F, N) layout: per-split column reads become
+    # contiguous rows and the Pallas kernel consumes it directly (see
+    # tree.grow_tree docstring). Dense serial-mode inputs are binned ON
+    # DEVICE (the transform + transpose of 1M+ rows would serialize on
+    # the host) when the bin boundaries survive the float32 cast;
+    # large-magnitude features (>24-bit mantissa, e.g. unix timestamps)
+    # collapse adjacent f32 boundaries and fall back to f64 host
+    # binning. Data-parallel mode also bins on host so each device only
+    # ever receives its own shard.
+    if bins_np is None and (data_parallel or not mapper.f32_safe()):
+        bins_np = mapper.transform(X)
+    if bins_np is None:
+        ub = jnp.asarray(mapper.threshold_matrix(num_bins), jnp.float32)
+        bins_dev = _device_binning(jnp.asarray(X, jnp.float32), ub, pad)
+    else:
+        if pad:
+            bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
+        bins_dev = jnp.asarray(
+            np.ascontiguousarray(bins_np.T), jnp.int32)
 
     # 3) init scores — fresh start or warm start from a base forest
     base_model: Optional[Booster] = None
     if init_model is not None:
         base_model = (Booster.from_string(init_model)
                       if isinstance(init_model, str) else init_model)
-        if not isinstance(X, np.ndarray):
-            raise ValueError("init_model warm start requires dense X")
         if base_model.num_class != K:
             raise ValueError(
                 f"init_model has {base_model.num_class} classes, "
@@ -321,15 +352,17 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                 f"init_model was trained with objective "
                 f"{base_model.objective.name!r}; resuming as "
                 f"{objective.name!r} would mix link spaces")
+        if len(base_model.feature_names) != f:
+            raise ValueError(
+                f"init_model was trained on "
+                f"{len(base_model.feature_names)} features, X has {f} "
+                f"(out-of-range gathers would clamp silently)")
         init_score = base_model.init_score
         # score + merge against the base model's EFFECTIVE forest: an
         # early-stopped base contributes only its best_iteration trees
         # (raw_score truncates the same way)
         base_eff_trees = base_model._resolve_iterations(None) * K
-        base_raw = base_model.raw_score(X)             # (N,) or (K, N)
-        if K == 1:
-            base_raw = base_raw[None, :]
-        base_scores = np.pad(base_raw.astype(np.float32),
+        base_scores = np.pad(_base_raw_kn(base_model, X, K),
                              ((0, 0), (0, pad)))
     elif p["boost_from_average"]:
         init_score = objective.init_score(y, w_base)
@@ -361,7 +394,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     if data_parallel:
         shard = mesh_lib.data_sharding(mesh)
         bins_d = jax.device_put(
-            jnp.asarray(bins_np, jnp.int32),
+            bins_dev,
             jax.sharding.NamedSharding(
                 mesh, P(None, mesh_lib.DATA_AXIS)))   # rows on data axis
         y_d = jax.device_put(jnp.asarray(y_pad, jnp.float32), shard)
@@ -369,7 +402,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             jnp.asarray(scores_np, jnp.float32),
             jax.sharding.NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS)))
     else:
-        bins_d = jnp.asarray(bins_np, jnp.int32)
+        bins_d = bins_dev
         y_d = jnp.asarray(y_pad, jnp.float32)
         scores = jnp.asarray(scores_np, jnp.float32)
 
@@ -387,11 +420,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             .astype(np.float32))
         yv = jnp.asarray(np.asarray(valid[1], dtype=np.float32))
         if base_model is not None:
-            v_raw = base_model.raw_score(
-                np.asarray(valid[0], dtype=np.float64))
-            if K == 1:
-                v_raw = v_raw[None, :]
-            v_scores = jnp.asarray(v_raw, jnp.float32)
+            v_scores = jnp.asarray(_base_raw_kn(
+                base_model, np.asarray(valid[0], dtype=np.float64), K))
         else:
             v_scores = jnp.broadcast_to(
                 jnp.asarray(init_score, jnp.float32)[:, None],
@@ -467,11 +497,13 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         # one device->host transfer for the whole forest
         host = jax.device_get(forest._asdict())
         stacked = {name: arr[:trees_done] for name, arr in host.items()}
-        # bin threshold -> raw value threshold, one vectorized gather
+        # bin threshold -> raw value threshold, one vectorized gather.
+        # Stored in float64: f32 storage would quantize away split
+        # resolution for large-magnitude features (the jitted predict
+        # path casts down itself when that is safe)
         thr_lut = mapper.threshold_matrix(num_bins)          # (F, B)
         thr = thr_lut[stacked["feature"], stacked["bin_threshold"]]
-        stacked["threshold"] = np.where(stacked["is_leaf"], 0.0, thr) \
-            .astype(np.float32)
+        stacked["threshold"] = np.where(stacked["is_leaf"], 0.0, thr)
         stacked["value"] = stacked["value"] * lr  # bake shrinkage
         tree_depths = [
             _tree_depth({k: v[t] for k, v in stacked.items()})
@@ -491,6 +523,53 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     return Booster(objective, stacked, init_score, K, feature_names, p,
                    best_iteration=best_iter if esr > 0 else -1,
                    tree_depths=tree_depths)
+
+
+def _host_predict_trees(X: np.ndarray, trees: Dict[str, np.ndarray],
+                        max_depth: int) -> np.ndarray:
+    """float64 numpy tree walk — same semantics as predict_trees (leaves
+    self-loop, NaN goes left) without the f32 cast. (T, N)."""
+    t_count, n = trees["feature"].shape[0], X.shape[0]
+    out = np.empty((t_count, n), np.float32)
+    rows = np.arange(n)
+    for t in range(t_count):
+        feat, thr = trees["feature"][t], trees["threshold"][t]
+        left, right = trees["left"][t], trees["right"][t]
+        node = np.zeros(n, np.int64)
+        for _ in range(max_depth):
+            fv = X[rows, feat[node]]
+            go_left = ~(fv > thr[node])        # NaN -> left, like binning
+            node = np.where(go_left, left[node], right[node])
+        out[t] = trees["value"][t][node]
+    return out
+
+
+def _base_raw_kn(base_model: Booster, X: np.ndarray, K: int) -> np.ndarray:
+    """Base-forest raw margins as (K, N) float32 (warm-start init)."""
+    raw = base_model.raw_score(X)
+    if K == 1:
+        raw = raw[None, :]
+    return np.asarray(raw, dtype=np.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _device_binning(X: jnp.ndarray, ub: jnp.ndarray, pad: int):
+    """Raw (N, F) f32 features -> (F, N+pad) int32 bins ON DEVICE.
+
+    bin = #{bounds < x} (searchsorted 'left'), computed per feature as a
+    compare-reduce; NaN compares false everywhere -> bin 0, matching the
+    host BinMapper. Run on TPU so the 1M-row transform and the
+    features-major transpose never touch the (single-core) host."""
+    xt = X.T                                        # (F, N)
+
+    def one(args):
+        row, bounds = args
+        return (row[:, None] > bounds[None, :]).sum(-1).astype(jnp.int32)
+
+    bins = lax.map(one, (xt, ub))
+    if pad:
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+    return bins
 
 
 def _pad_nodes(v: np.ndarray, m: int, key: str) -> np.ndarray:

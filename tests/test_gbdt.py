@@ -68,6 +68,24 @@ class TestBinning:
         m2 = BinMapper.from_json(m.to_json())
         assert np.array_equal(m.transform(X), m2.transform(X))
 
+    def test_f32_safety_detection(self):
+        rng = np.random.default_rng(0)
+        normal = rng.normal(size=(500, 2))
+        assert BinMapper.fit(normal, max_bin=32).f32_safe()
+        # unix-timestamp scale: 1s resolution needs >24 mantissa bits
+        ts = (1.7e9 + rng.integers(0, 600, size=(2000, 1))).astype(float)
+        assert not BinMapper.fit(ts, max_bin=255).f32_safe()
+
+    def test_large_magnitude_features_bin_correctly(self):
+        # the f32-unsafe fallback must keep full split resolution
+        rng = np.random.default_rng(1)
+        n = 2000
+        ts = 1.7e9 + rng.integers(0, 600, size=n).astype(float)
+        y = (ts % 600 > 300).astype(float)
+        b = train({"objective": "binary", "num_iterations": 30,
+                   "min_data_in_leaf": 5}, ts[:, None], y)
+        assert _auc(y, b.predict(ts[:, None])) > 0.99
+
 
 class TestHistogram:
     def test_scatter_matches_numpy(self):
@@ -313,6 +331,13 @@ class TestWarmStart:
         with pytest.raises(ValueError, match="link spaces"):
             train({"objective": "binary", "num_iterations": 2}, X, y,
                   init_model=b)
+
+    def test_feature_count_mismatch_rejected(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 2}, X, y)
+        with pytest.raises(ValueError, match="features"):
+            train({"objective": "binary", "num_iterations": 2},
+                  X[:, :3], y, init_model=b)
 
     def test_class_mismatch_rejected(self, breast_cancer):
         X, y = breast_cancer
