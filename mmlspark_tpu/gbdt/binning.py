@@ -27,10 +27,14 @@ class BinMapper:
     folds missing into the lowest bin).
     """
 
-    def __init__(self, upper_bounds: List[np.ndarray], max_bin: int):
+    def __init__(self, upper_bounds: List[np.ndarray], max_bin: int,
+                 f32_values_safe: bool = False):
         self.upper_bounds = [np.asarray(u, dtype=np.float64)
                              for u in upper_bounds]
         self.max_bin = int(max_bin)
+        # computed at fit time from TRUE data gaps (see _feature_bounds);
+        # conservative False for mappers restored without the flag
+        self.f32_values_safe = bool(f32_values_safe)
 
     @property
     def num_features(self) -> int:
@@ -50,8 +54,10 @@ class BinMapper:
             rng = np.random.default_rng(seed)
             idx = rng.choice(n, size=sample_cnt, replace=False)
             X = X[idx]
-        bounds = [_feature_bounds(X[:, j], max_bin) for j in range(f)]
-        return BinMapper(bounds, max_bin)
+        results = [_feature_bounds(X[:, j], max_bin) for j in range(f)]
+        bounds = [b for b, _ in results]
+        safe = all(ok for _, ok in results)
+        return BinMapper(bounds, max_bin, f32_values_safe=safe)
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Raw features -> int32 bin indices, shape (N, F).
@@ -90,15 +96,13 @@ class BinMapper:
         return float(ub[int(bin_idx)])
 
     def f32_safe(self) -> bool:
-        """True when every feature's bin boundaries stay distinct after
-        a float32 cast — the precondition for binning on device in f32.
-        Large-magnitude features (unix timestamps, IDs: >24-bit
-        mantissa) collapse adjacent boundaries and must bin in f64."""
-        for ub in self.upper_bounds:
-            ub32 = ub.astype(np.float32)
-            if len(ub32) > 1 and (np.diff(ub32) <= 0).any():
-                return False
-        return True
+        """True when binning/threshold comparison can run in float32
+        without changing any assignment: every boundary's distance to
+        the data values it separates (computed from the TRUE gaps at fit
+        time) dominates the f32 rounding band around it. Timestamps/IDs
+        (>24-bit mantissa) and features with sub-f32-resolution
+        distinctions both fail and stay in f64."""
+        return self.f32_values_safe
 
     def threshold_matrix(self, num_bins: int) -> np.ndarray:
         """(F, num_bins) lookup of bin_threshold_value for every (feature,
@@ -115,36 +119,53 @@ class BinMapper:
 
     def to_json(self) -> dict:
         return {"max_bin": self.max_bin,
+                "f32_values_safe": self.f32_values_safe,
                 "upper_bounds": [u.tolist() for u in self.upper_bounds]}
 
     @staticmethod
     def from_json(d: dict) -> "BinMapper":
         return BinMapper([np.asarray(u) for u in d["upper_bounds"]],
-                         d["max_bin"])
+                         d["max_bin"],
+                         f32_values_safe=d.get("f32_values_safe", False))
 
 
-def _feature_bounds(col: np.ndarray, max_bin: int) -> np.ndarray:
-    """Equal-frequency boundaries for one feature column."""
+_EPS32 = float(np.finfo(np.float32).eps)
+
+
+def _cut_f32_ok(lo: float, hi: float) -> bool:
+    """A boundary at (lo+hi)/2 separates lo from hi under f32 compares
+    iff the half-gap dominates the f32 rounding band at that magnitude."""
+    return (hi - lo) / 2.0 > 8.0 * _EPS32 * max(abs(lo), abs(hi))
+
+
+def _feature_bounds(col: np.ndarray, max_bin: int):
+    """Equal-frequency boundaries for one feature column.
+    Returns (bounds, f32_ok) — f32_ok is False when any cut sits closer
+    to its neighboring data values than float32 can resolve."""
     col = col[np.isfinite(col)]
     if col.size == 0:
-        return np.empty(0)
+        return np.empty(0), True
     distinct, counts = np.unique(col, return_counts=True)
     if len(distinct) <= 1:
-        return np.empty(0)
+        return np.empty(0), True
     if len(distinct) <= max_bin:
         # one bin per distinct value; boundaries at midpoints
-        return (distinct[:-1] + distinct[1:]) / 2.0
+        ok = all(_cut_f32_ok(a, b)
+                 for a, b in zip(distinct[:-1], distinct[1:]))
+        return (distinct[:-1] + distinct[1:]) / 2.0, ok
     # equal-frequency: walk cumulative counts, cut when a bin's quota fills
     total = counts.sum()
     per_bin = total / max_bin
     bounds = []
+    ok = True
     acc = 0.0
     target = per_bin
     for i in range(len(distinct) - 1):
         acc += counts[i]
         if acc >= target:
             bounds.append((distinct[i] + distinct[i + 1]) / 2.0)
+            ok = ok and _cut_f32_ok(distinct[i], distinct[i + 1])
             target = acc + per_bin
             if len(bounds) == max_bin - 1:
                 break
-    return np.asarray(bounds)
+    return np.asarray(bounds), ok

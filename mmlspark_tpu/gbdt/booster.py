@@ -86,15 +86,25 @@ class Booster:
         return max(1, max(depths))
 
     def _needs_f64_inference(self) -> bool:
-        """Thresholds beyond float32's 24-bit integer range (unix
-        timestamps, large IDs) lose split resolution on the jitted f32
-        walk; such forests score on host in float64."""
+        """True when the jitted f32 walk could misroute rows. Primary
+        signal: the fit-time flag recorded from the BinMapper's true
+        data gaps ('f32_unsafe' in params). Fallback for models saved
+        without the flag: a spacing heuristic over the stored
+        thresholds (catches large-magnitude timestamp/ID features).
+        Such forests score on host in float64."""
+        if "f32_unsafe" in self.params:
+            return bool(self.params["f32_unsafe"])
         if not self.trees:
             return False
         thr = self.trees["threshold"][~self.trees["is_leaf"].astype(bool)]
-        finite = thr[np.isfinite(thr)]
-        return bool(len(finite)) and bool(
-            np.abs(finite).max() >= 2.0 ** 24)
+        finite = np.unique(thr[np.isfinite(thr)])
+        if len(finite) < 2:
+            return False
+        eps32 = float(np.finfo(np.float32).eps)
+        gaps = np.diff(finite)
+        band = 8.0 * eps32 * np.maximum(np.abs(finite[:-1]),
+                                        np.abs(finite[1:]))
+        return bool((gaps <= band).any())
 
     def raw_score(self, X: np.ndarray,
                   num_iteration: Optional[int] = None) -> np.ndarray:
@@ -327,6 +337,9 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # collapse adjacent f32 boundaries and fall back to f64 host
     # binning. Data-parallel mode also bins on host so each device only
     # ever receives its own shard.
+    # record f32 safety on the model so inference picks the right walk
+    # (warm start below ORs in the base model's flag)
+    p["f32_unsafe"] = not mapper.f32_safe()
     if bins_np is None and (data_parallel or not mapper.f32_safe()):
         bins_np = mapper.transform(X)
     if bins_np is None:
@@ -358,6 +371,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                 f"{len(base_model.feature_names)} features, X has {f} "
                 f"(out-of-range gathers would clamp silently)")
         init_score = base_model.init_score
+        p["f32_unsafe"] = bool(p["f32_unsafe"]) or bool(
+            base_model.params.get("f32_unsafe", False))
         # score + merge against the base model's EFFECTIVE forest: an
         # early-stopped base contributes only its best_iteration trees
         # (raw_score truncates the same way)

@@ -245,8 +245,40 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             sample_x, sample_y = x[:1], y[:1]
             schema_src = table
 
+        # multi-host: each process feeds its LOCAL rows; the global batch
+        # is assembled per-step from every host's slice (the
+        # host-partitioned feeding that replaces HDFS staging + scp,
+        # ref: CNTKLearner.scala:123-140 / CommandBuilders.scala:207-229).
+        # The caller passes this host's shard (see
+        # parallel.distributed.shard_table_for_host); shards must be
+        # equal-sized across hosts so step counts agree.
+        from mmlspark_tpu.parallel import distributed as dist
+        proc_count = dist.host_info().process_count
         batch_size = self.get("batchSize")
-        steps_per_epoch = max(1, (n + batch_size - 1) // batch_size)
+        if proc_count > 1:
+            if batch_size % proc_count:
+                raise ValueError(
+                    f"batchSize {batch_size} must divide evenly over "
+                    f"{proc_count} processes")
+            local_batch = batch_size // proc_count
+            if not streaming:
+                # agree on a common step count: ragged shards would make
+                # one host enter a collective the others never reach.
+                # Truncate every host to the global minimum row count.
+                from jax.experimental import multihost_utils
+                n_all = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([n])))
+                n_min = int(n_all.min())
+                if n_min != n:
+                    logger.warning(
+                        "host shards are unequal (%s); truncating to %d "
+                        "rows per host so step counts agree",
+                        n_all.ravel().tolist(), n_min)
+                    x, y = x[:n_min], y[:n_min]
+                    n = n_min
+        else:
+            local_batch = batch_size
+        steps_per_epoch = max(1, (n + local_batch - 1) // local_batch)
         total_steps = steps_per_epoch * self.get("epochs")
 
         tx = make_optimizer(
@@ -359,6 +391,18 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     lambda a, s: jax.device_put(jnp.asarray(a), s),
                     host_state, state_sharding)
                 logger.info("resumed from %s (step %d)", latest, start_step)
+        if proc_count > 1 and ckpt_dir and self.get("resume"):
+            # hosts must resume from the SAME step — a host that found
+            # no checkpoint (non-shared filesystem) would replay steps
+            # the others skip and hang the first collective
+            from jax.experimental import multihost_utils
+            steps = np.asarray(multihost_utils.process_allgather(
+                np.asarray([start_step]))).ravel()
+            if len(set(steps.tolist())) > 1:
+                raise RuntimeError(
+                    f"hosts disagree on the resume step {steps.tolist()}:"
+                    f" checkpointDir must be on a filesystem shared by "
+                    f"all hosts (or set resume=False)")
 
         # training loop. Input feed: a background thread slices/pads the
         # next minibatch and device_puts it while the current step runs on
@@ -385,11 +429,11 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             for epoch in range(epochs):
                 if not streaming:
                     order = np_rng.permutation(n)
-                    for bstart in range(0, n, batch_size):
+                    for bstart in range(0, n, local_batch):
                         step += 1
                         if step <= start_step:
                             continue  # fast-forward post-resume
-                        idx = order[bstart:bstart + batch_size]
+                        idx = order[bstart:bstart + local_batch]
                         yield epoch, step, x[idx], y[idx]
                     continue
                 carry_x = carry_y = None
@@ -401,14 +445,14 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     if carry_x is not None:
                         xs = np.concatenate([carry_x, xs])
                         ys = np.concatenate([carry_y, ys])
-                    n_full = len(xs) // batch_size
+                    n_full = len(xs) // local_batch
                     for i in range(n_full):
                         step += 1
                         if step <= start_step:
                             continue
-                        sl = slice(i * batch_size, (i + 1) * batch_size)
+                        sl = slice(i * local_batch, (i + 1) * local_batch)
                         yield epoch, step, xs[sl], ys[sl]
-                    rest = len(xs) - n_full * batch_size
+                    rest = len(xs) - n_full * local_batch
                     carry_x = xs[-rest:] if rest else None
                     carry_y = ys[-rest:] if rest else None
                 if carry_x is not None:
@@ -416,16 +460,25 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     if step > start_step:
                         yield epoch, step, carry_x, carry_y
 
+        def _to_global(arr, sharding):
+            """Local slice -> global device array. Single-process:
+            plain device_put; multi-process: every host contributes its
+            slice of the global batch."""
+            if proc_count > 1:
+                return jax.make_array_from_process_local_data(
+                    sharding, arr)
+            return jax.device_put(arr, sharding)
+
         def make_batch(item):
             epoch, step, bx_np, by_np = item
             bx, true_len = mesh_lib.pad_to_multiple(
-                bx_np, batch_size, axis=0)
-            by, _ = mesh_lib.pad_to_multiple(by_np, batch_size, axis=0)
-            w = (np.arange(batch_size) < true_len).astype(np.float32)
-            return epoch, step, true_len, {
-                "x": jax.device_put(bx, data_sharding["x"]),
-                "y": jax.device_put(by, data_sharding["y"]),
-                "w": jax.device_put(w, data_sharding["w"]),
+                bx_np, local_batch, axis=0)
+            by, _ = mesh_lib.pad_to_multiple(by_np, local_batch, axis=0)
+            w = (np.arange(local_batch) < true_len).astype(np.float32)
+            return epoch, step, true_len * proc_count, {
+                "x": _to_global(bx, data_sharding["x"]),
+                "y": _to_global(by, data_sharding["y"]),
+                "w": _to_global(w, data_sharding["w"]),
             }
 
         pending: List[Tuple[int, int, Any, float]] = []  # deferred log queue
@@ -538,6 +591,9 @@ class _InferApply:
 
 
 def _save_checkpoint(ckpt_dir: str, step: int, state) -> None:
+    # multi-host: only the coordinator writes (hosts may share the FS)
+    if jax.process_index() != 0:
+        return
     host = jax.device_get(state)
     path = os.path.join(ckpt_dir, f"step_{step:08d}")
     os.makedirs(path, exist_ok=True)

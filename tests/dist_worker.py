@@ -59,6 +59,29 @@ def main() -> None:
         lambda v: lax.psum(jnp.sum(v), "data"),
         mesh=mesh, in_specs=P("data"), out_specs=P()))(global_x)
     print(f"PSUM {pid} {float(total):.1f}", flush=True)
+
+    # host-sharded TPULearner training across the processes: each host
+    # feeds its local rows, the global batch is assembled per step via
+    # make_array_from_process_local_data, gradients allreduce over the
+    # global mesh (the mpirun-cntk analog, CommandBuilders.scala:241)
+    from mmlspark_tpu.models.learner import TPULearner
+
+    rng = np.random.default_rng(7)   # same global data on every host
+    gx = rng.normal(size=(64, 6)).astype(np.float32)
+    gy = (gx[:, 0] + gx[:, 1] > 0).astype(np.int64)
+    full = DataTable({"features": gx, "label": gy})
+    local = dist.shard_table_for_host(full, info)
+
+    learner = TPULearner(
+        networkSpec={"type": "mlp", "features": [8], "num_classes": 2},
+        epochs=6, batchSize=8 * nproc, learningRate=0.1,
+        computeDtype="float32", logEvery=1000,
+        meshAxes={"data": info.global_device_count})
+    model = learner.fit(local)
+    # every host must end with IDENTICAL (replicated) trained params
+    leaf = np.asarray(jax.tree_util.tree_leaves(
+        model.get("weights"))[0]).ravel()[:3]
+    print(f"TRAIN {pid} {','.join(f'{v:.6f}' for v in leaf)}", flush=True)
     print(f"OK {pid}", flush=True)
 
 

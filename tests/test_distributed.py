@@ -55,6 +55,7 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     n_rows = 4 * nproc
     expect = n_rows * (n_rows - 1) / 2
     shards = {}
+    trained = {}
     for rc, out, err in outs:
         for line in out.splitlines():
             if line.startswith("PSUM"):
@@ -63,6 +64,12 @@ def test_multiprocess_rendezvous_and_psum(nproc):
             if line.startswith("SHARD"):
                 _, pid, vals = line.split()
                 shards[int(pid)] = vals
+            if line.startswith("TRAIN"):
+                _, pid, vals = line.split()
+                trained[int(pid)] = vals
+    # host-sharded training ran and produced identical replicated params
+    assert len(trained) == nproc
+    assert len(set(trained.values())) == 1, trained
     # host shards are disjoint row ranges
     assert len(shards) == nproc
     all_rows = ",".join(shards[i] for i in range(nproc))

@@ -75,6 +75,14 @@ class TestBinning:
         # unix-timestamp scale: 1s resolution needs >24 mantissa bits
         ts = (1.7e9 + rng.integers(0, 600, size=(2000, 1))).astype(float)
         assert not BinMapper.fit(ts, max_bin=255).f32_safe()
+        # isolated sub-f32-resolution pair between wide gaps: the cut at
+        # (1.0 + 1.000000005)/2 can't separate the pair in f32, even
+        # though boundary-to-boundary spacing looks wide
+        tight = np.asarray([1.0, 1.0 + 1e-8, 2.0] * 100)[:, None]
+        assert not BinMapper.fit(tight, max_bin=8).f32_safe()
+        # round-trip keeps the flag
+        m = BinMapper.fit(tight, max_bin=8)
+        assert not BinMapper.from_json(m.to_json()).f32_safe()
 
     def test_large_magnitude_features_bin_correctly(self):
         # the f32-unsafe fallback must keep full split resolution
