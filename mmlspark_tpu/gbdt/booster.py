@@ -89,22 +89,32 @@ class Booster:
         """True when the jitted f32 walk could misroute rows. Primary
         signal: the fit-time flag recorded from the BinMapper's true
         data gaps ('f32_unsafe' in params). Fallback for models saved
-        without the flag: a spacing heuristic over the stored
-        thresholds (catches large-magnitude timestamp/ID features).
-        Such forests score on host in float64."""
+        without the flag: thresholds beyond f32's 24-bit integer range
+        (timestamps/IDs), or PER-FEATURE threshold spacing below the
+        f32 rounding band. Such forests score on host in float64."""
         if "f32_unsafe" in self.params:
             return bool(self.params["f32_unsafe"])
         if not self.trees:
             return False
-        thr = self.trees["threshold"][~self.trees["is_leaf"].astype(bool)]
-        finite = np.unique(thr[np.isfinite(thr)])
-        if len(finite) < 2:
+        internal = ~self.trees["is_leaf"].astype(bool)
+        thr = self.trees["threshold"][internal]
+        feats = self.trees["feature"][internal]
+        keep = np.isfinite(thr)
+        thr, feats = thr[keep], feats[keep]
+        if not len(thr):
             return False
+        if np.abs(thr).max() >= 2.0 ** 24:
+            return True
         eps32 = float(np.finfo(np.float32).eps)
-        gaps = np.diff(finite)
-        band = 8.0 * eps32 * np.maximum(np.abs(finite[:-1]),
-                                        np.abs(finite[1:]))
-        return bool((gaps <= band).any())
+        for fid in np.unique(feats):
+            t = np.unique(thr[feats == fid])
+            if len(t) < 2:
+                continue
+            gaps = np.diff(t)
+            band = 8.0 * eps32 * np.maximum(np.abs(t[:-1]), np.abs(t[1:]))
+            if (gaps <= band).any():
+                return True
+        return False
 
     def raw_score(self, X: np.ndarray,
                   num_iteration: Optional[int] = None) -> np.ndarray:

@@ -261,21 +261,26 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     f"batchSize {batch_size} must divide evenly over "
                     f"{proc_count} processes")
             local_batch = batch_size // proc_count
-            if not streaming:
-                # agree on a common step count: ragged shards would make
-                # one host enter a collective the others never reach.
-                # Truncate every host to the global minimum row count.
-                from jax.experimental import multihost_utils
-                n_all = np.asarray(multihost_utils.process_allgather(
-                    np.asarray([n])))
-                n_min = int(n_all.min())
-                if n_min != n:
-                    logger.warning(
-                        "host shards are unequal (%s); truncating to %d "
-                        "rows per host so step counts agree",
-                        n_all.ravel().tolist(), n_min)
-                    x, y = x[:n_min], y[:n_min]
-                    n = n_min
+            if streaming:
+                raise NotImplementedError(
+                    "streaming shard ingestion is single-host for now: "
+                    "hosts cannot agree on step counts without knowing "
+                    "every shard's size up front (ragged streams would "
+                    "deadlock the global-batch collectives)")
+            # agree on a common step count: ragged shards would make one
+            # host enter a collective the others never reach. Truncate
+            # every host to the global minimum row count.
+            from jax.experimental import multihost_utils
+            n_all = np.asarray(multihost_utils.process_allgather(
+                np.asarray([n])))
+            n_min = int(n_all.min())
+            if n_min != n:
+                logger.warning(
+                    "host shards are unequal (%s); truncating to %d "
+                    "rows per host so step counts agree",
+                    n_all.ravel().tolist(), n_min)
+                x, y = x[:n_min], y[:n_min]
+                n = n_min
         else:
             local_batch = batch_size
         steps_per_epoch = max(1, (n + local_batch - 1) // local_batch)

@@ -1,0 +1,180 @@
+"""Pallas TPU flash attention (dense, single-device path).
+
+The O(L^2) score matrix of ``ring_attention.attention`` never leaves
+VMEM here: the kernel streams K/V blocks past each Q block, maintaining
+online-softmax statistics (m, l, acc) in scratch across the KV grid
+axis — O(L) HBM traffic per head instead of materializing (L, L) scores
+(the standard TPU flash-attention scheme; same m/l/o algebra the ring
+layer uses across devices, applied within one device).
+
+Same contract as ring_attention.attention: q (B, Lq, H, D),
+k/v (B, Lk, H, D), optional causal masking with global position offsets
+(shards of a longer sequence). Rows whose keys are all masked return 0,
+matching the ring layer's _finalize.
+
+Grid: (B*H, Lq blocks, Lk blocks) with the KV axis innermost — TPU grid
+steps run sequentially, so VMEM scratch carries the running statistics
+and the output block is written once, on the last KV step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+BLOCK_Q = 256
+BLOCK_K = 256
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            scale: float, causal: bool, q_offset: int, k_offset: int,
+            lq_true: int, lk_true: int, bq: int, bk: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32)                  # (bq, D)
+    k = k_ref[0].astype(jnp.float32)                  # (bk, D)
+    s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+
+    # mask: padding keys always; causal by global positions
+    kpos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = kpos < lk_true
+    if causal:
+        qpos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        valid = valid & (qpos + q_offset >= kpos + k_offset)
+    s = jnp.where(valid, s, NEG_INF)
+
+    m_prev = m_scr[:]                                  # (bq, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    # fully-masked-so-far rows keep m at NEG_INF; shift by m_new only
+    # where finite so exp() never sees inf-inf
+    p = jnp.where(valid, jnp.exp(s - m_new), 0.0)      # (bq, bk)
+    corr = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
+    l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
+        p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_scr[:]
+        o_ref[0] = (acc_scr[:] / jnp.where(l > 0, l, 1.0)
+                    ).astype(o_ref.dtype)
+
+
+def _dense_reference(q, k, v, causal, q_offset, k_offset):
+    """Local dense attention with identical semantics (incl. zeroed
+    fully-masked rows) — used ONLY to build the backward pass; calling
+    ring_attention.attention here would re-dispatch to flash and
+    recurse."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if causal:
+        p = jnp.where(mask.any(-1)[None, None, :, None], p, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, q_offset, k_offset, interpret):
+    return _flash_forward(q, k, v, causal, q_offset, k_offset, interpret)
+
+
+def _flash_fwd(q, k, v, causal, q_offset, k_offset, interpret):
+    return (_flash_forward(q, k, v, causal, q_offset, k_offset,
+                           interpret), (q, k, v))
+
+
+def _flash_bwd(causal, q_offset, k_offset, interpret, res, g):
+    # backward recomputes through the dense reference (O(L^2) memory in
+    # the backward only); the forward keeps the kernel's O(L) footprint
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: _dense_reference(a, b, c, causal, q_offset,
+                                         k_offset), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, q_offset: int = 0,
+                    k_offset: int = 0, interpret: bool = False):
+    """Drop-in for ring_attention.attention on big blocks.
+    Differentiable: the backward pass routes through a dense recompute
+    (custom_vjp), so training through this path stays correct."""
+    return _flash(q, k, v, bool(causal), int(q_offset), int(k_offset),
+                  bool(interpret))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "q_offset", "k_offset", "interpret"))
+def _flash_forward(q, k, v, causal: bool = False, q_offset: int = 0,
+                   k_offset: int = 0, interpret: bool = False):
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = 1.0 / float(d) ** 0.5
+
+    bq = min(BLOCK_Q, max(8, lq + ((-lq) % 8)))
+    bk = min(BLOCK_K, max(128, lk + ((-lk) % 128)))
+    pad_q = (-lq) % bq
+    pad_k = (-lk) % bk
+
+    # heads-major (BH, L, D) layout for per-(batch, head) grid blocks
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
+    if pad_q:
+        qt = jnp.pad(qt, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        kt = jnp.pad(kt, ((0, 0), (0, pad_k), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, pad_k), (0, 0)))
+
+    grid = (b * h, (lq + pad_q) // bq, (lk + pad_k) // bk)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, causal=causal, q_offset=q_offset,
+            k_offset=k_offset, lq_true=lq, lk_true=lk, bq=bq, bk=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, lq + pad_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+
+    out = out[:, :lq].reshape(b, h, lq, d).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)

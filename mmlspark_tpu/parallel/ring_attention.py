@@ -60,12 +60,27 @@ def _finalize(m, l, o):
     return o / l_safe.transpose(0, 2, 1)[..., None]
 
 
+# sequences at least this long route to the Pallas flash kernel on TPU
+# (below it, one fused XLA einsum is faster than the kernel's grid)
+FLASH_MIN_LEN = 512
+
+
 def attention(q, k, v, causal: bool = False,
               q_offset: int = 0, k_offset: int = 0) -> jnp.ndarray:
     """Plain (single-device) attention, the numerics reference.
 
     q (B, Lq, H, D); k/v (B, Lk, H, D). Offsets give global positions for
-    causal masking of sequence shards."""
+    causal masking of sequence shards. Long sequences on TPU run the
+    Pallas flash kernel (O(L) memory, scores never leave VMEM — see
+    ops/flash_attention.py); short ones use the fused XLA einsum."""
+    if (jax.default_backend() in ("tpu", "axon")
+            and isinstance(q_offset, int) and isinstance(k_offset, int)
+            and q.shape[1] >= FLASH_MIN_LEN
+            and k.shape[1] >= FLASH_MIN_LEN):
+        from mmlspark_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal,
+                               q_offset=int(q_offset),
+                               k_offset=int(k_offset))
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     s = _block_scores(q.astype(jnp.float32), k.astype(jnp.float32), scale)
     if causal:
@@ -74,6 +89,11 @@ def attention(q, k, v, causal: bool = False,
         mask = qpos[:, None] >= kpos[None, :]
         s = jnp.where(mask[None, None], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if causal:
+        # fully-masked rows (shard offsets can produce them) must output
+        # 0, matching _finalize's l==0 convention — a bare softmax would
+        # degenerate to a uniform average over masked keys
+        p = jnp.where(mask.any(-1)[None, None, :, None], p, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
 

@@ -84,6 +84,34 @@ class TestBinning:
         m = BinMapper.fit(tight, max_bin=8)
         assert not BinMapper.from_json(m.to_json()).f32_safe()
 
+    def test_legacy_model_f64_inference_heuristic(self, breast_cancer):
+        # models saved before the fit-time flag fall back to threshold
+        # heuristics: magnitude >= 2^24 forces f64; near-equal
+        # thresholds on DIFFERENT features must not
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 3}, X, y)
+        legacy = Booster.from_string(b.model_to_string())
+        legacy.params.pop("f32_unsafe", None)
+        assert not legacy._needs_f64_inference()
+        # widely-spaced timestamp thresholds: magnitude rule kicks in
+        legacy.trees["threshold"] = np.where(
+            legacy.trees["is_leaf"], 0.0,
+            1.7e9 + legacy.trees["threshold"])
+        assert legacy._needs_f64_inference()
+        # cross-feature near-equal thresholds: per-feature grouping
+        # avoids the false positive
+        legacy2 = Booster.from_string(b.model_to_string())
+        legacy2.params.pop("f32_unsafe", None)
+        thr = legacy2.trees["threshold"]
+        internal = ~legacy2.trees["is_leaf"].astype(bool)
+        idx = np.argwhere(internal)
+        a_, b_ = idx[0], idx[1]
+        legacy2.trees["feature"][tuple(a_)] = 0
+        legacy2.trees["feature"][tuple(b_)] = 1
+        thr[tuple(a_)] = 1000.0
+        thr[tuple(b_)] = 1000.00001
+        assert not legacy2._needs_f64_inference()
+
     def test_large_magnitude_features_bin_correctly(self):
         # the f32-unsafe fallback must keep full split resolution
         rng = np.random.default_rng(1)
