@@ -7,6 +7,8 @@ checks empirical coverage, and round-trips the model through its string
 serialization (the LightGBM modelString analog).
 """
 
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
 import numpy as np
 
 from mmlspark_tpu.core.table import DataTable

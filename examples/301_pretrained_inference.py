@@ -7,6 +7,8 @@ not produce) is imported to flax, published through the model zoo, and
 served batch-inference-style over an image table.
 """
 
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
 import tempfile
 
 import numpy as np

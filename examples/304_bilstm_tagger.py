@@ -7,6 +7,8 @@ BiLSTMTagger zoo module is trained on a synthetic token-tagging task
 predictions through TPULearner/TPUModel.
 """
 
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
 import numpy as np
 
 from mmlspark_tpu.core.table import DataTable

@@ -7,6 +7,8 @@ layer before the head, the pooled features feed a GBDT classifier, and
 the pipeline separates bright-vs-dark image classes.
 """
 
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
 import tempfile
 
 import numpy as np

@@ -8,6 +8,8 @@ via the mesh — the same script scales from this host to a TPU pod by
 virtue of jax.sharding alone.
 """
 
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
 import numpy as np
 import jax
 

@@ -1,0 +1,9 @@
+"""Put the repo root on sys.path so examples run from anywhere
+(`import _pathsetup` works because the script's own directory is always
+on sys.path, for both direct execution and runpy.run_path)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))

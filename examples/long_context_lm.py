@@ -9,6 +9,8 @@ Ulysses on a pod; gradients are bit-checked against dense attention in
 tests/test_ring_attention.py.
 """
 
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
 import numpy as np
 import jax
 import jax.numpy as jnp

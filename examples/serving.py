@@ -8,6 +8,8 @@ the connection that accepted each request (reply-by-uuid). Poison
 requests get per-row 500s without failing their batchmates.
 """
 
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
 import json
 import urllib.error
 import urllib.request

@@ -73,6 +73,7 @@ class Booster:
         self.params = dict(params)
         self.best_iteration = int(best_iteration)
         self.tree_depths = list(tree_depths or [])
+        self._f64_flag: Optional[bool] = None   # _needs_f64_inference cache
 
     # -- inference ----------------------------------------------------------
 
@@ -91,7 +92,13 @@ class Booster:
         data gaps ('f32_unsafe' in params). Fallback for models saved
         without the flag: thresholds beyond f32's 24-bit integer range
         (timestamps/IDs), or PER-FEATURE threshold spacing below the
-        f32 rounding band. Such forests score on host in float64."""
+        f32 rounding band. Such forests score on host in float64.
+        Cached — trees are immutable after construction."""
+        if self._f64_flag is None:
+            self._f64_flag = self._compute_f64_flag()
+        return self._f64_flag
+
+    def _compute_f64_flag(self) -> bool:
         if "f32_unsafe" in self.params:
             return bool(self.params["f32_unsafe"])
         if not self.trees:

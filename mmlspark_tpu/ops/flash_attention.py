@@ -79,24 +79,12 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
 
 def _dense_reference(q, k, v, causal, q_offset, k_offset):
-    """Local dense attention with identical semantics (incl. zeroed
-    fully-masked rows) — used ONLY to build the backward pass; calling
-    ring_attention.attention here would re-dispatch to flash and
-    recurse."""
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32),
-                   preferred_element_type=jnp.float32) * scale
-    if causal:
-        qpos = q_offset + jnp.arange(q.shape[1])
-        kpos = k_offset + jnp.arange(k.shape[1])
-        mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    if causal:
-        p = jnp.where(mask.any(-1)[None, None, :, None], p, 0.0)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    """The shared dense path (ring_attention.dense_attention) — imported
+    lazily so the backward and the forward dispatch can never diverge.
+    Calling ring_attention.attention here would re-dispatch to flash and
+    recurse; dense_attention is the kernel-free half."""
+    from mmlspark_tpu.parallel.ring_attention import dense_attention
+    return dense_attention(q, k, v, causal, q_offset, k_offset)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

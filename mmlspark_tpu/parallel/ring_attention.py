@@ -60,27 +60,17 @@ def _finalize(m, l, o):
     return o / l_safe.transpose(0, 2, 1)[..., None]
 
 
-# sequences at least this long route to the Pallas flash kernel on TPU
-# (below it, one fused XLA einsum is faster than the kernel's grid)
+# sequences at least this long route to the Pallas flash kernel on TPU;
+# set to a huge value (ra.FLASH_MIN_LEN = 1 << 62) to force the dense
+# einsum everywhere (the escape hatch if a TPU generation's Mosaic
+# lowering misbehaves). Below it, one fused einsum beats the kernel grid.
 FLASH_MIN_LEN = 512
 
 
-def attention(q, k, v, causal: bool = False,
-              q_offset: int = 0, k_offset: int = 0) -> jnp.ndarray:
-    """Plain (single-device) attention, the numerics reference.
-
-    q (B, Lq, H, D); k/v (B, Lk, H, D). Offsets give global positions for
-    causal masking of sequence shards. Long sequences on TPU run the
-    Pallas flash kernel (O(L) memory, scores never leave VMEM — see
-    ops/flash_attention.py); short ones use the fused XLA einsum."""
-    if (jax.default_backend() in ("tpu", "axon")
-            and isinstance(q_offset, int) and isinstance(k_offset, int)
-            and q.shape[1] >= FLASH_MIN_LEN
-            and k.shape[1] >= FLASH_MIN_LEN):
-        from mmlspark_tpu.ops.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal,
-                               q_offset=int(q_offset),
-                               k_offset=int(k_offset))
+def dense_attention(q, k, v, causal: bool = False,
+                    q_offset=0, k_offset=0) -> jnp.ndarray:
+    """The dense einsum path — the numerics reference the flash kernel
+    (forward) and its custom_vjp backward are both held to."""
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
     s = _block_scores(q.astype(jnp.float32), k.astype(jnp.float32), scale)
     if causal:
@@ -96,6 +86,25 @@ def attention(q, k, v, causal: bool = False,
         p = jnp.where(mask.any(-1)[None, None, :, None], p, 0.0)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
                       ).astype(q.dtype)
+
+
+def attention(q, k, v, causal: bool = False,
+              q_offset: int = 0, k_offset: int = 0) -> jnp.ndarray:
+    """Plain (single-device) attention.
+
+    q (B, Lq, H, D); k/v (B, Lk, H, D). Offsets give global positions for
+    causal masking of sequence shards. Long sequences on TPU run the
+    Pallas flash kernel (O(L) memory, scores never leave VMEM — see
+    ops/flash_attention.py); short ones use the fused XLA einsum."""
+    if (jax.default_backend() in ("tpu", "axon")
+            and isinstance(q_offset, int) and isinstance(k_offset, int)
+            and q.shape[1] >= FLASH_MIN_LEN
+            and k.shape[1] >= FLASH_MIN_LEN):
+        from mmlspark_tpu.ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, causal=causal,
+                               q_offset=int(q_offset),
+                               k_offset=int(k_offset))
+    return dense_attention(q, k, v, causal, q_offset, k_offset)
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False

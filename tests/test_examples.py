@@ -21,6 +21,9 @@ def test_example_runs(script):
         "import jax;"
         "jax.config.update('jax_platforms','cpu');"
         "jax.config.update('jax_num_cpu_devices',8);"
+        # runpy.run_path does NOT add the script's directory to sys.path
+        # (direct execution does) — add it so `import _pathsetup` works
+        f"import sys; sys.path.insert(0, {EXAMPLES!r});"
         f"import runpy; runpy.run_path({path!r}, run_name='__main__')")
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_"))}

@@ -13,12 +13,6 @@ from mmlspark_tpu.ops.flash_attention import flash_attention
 from mmlspark_tpu.parallel.ring_attention import attention
 
 
-def _rand(b, l, h, d, seed=0, dtype=np.float32):
-    rng = np.random.default_rng(seed)
-    return (jnp.asarray(rng.normal(size=(b, l, h, d)), dtype)
-            for _ in range(1)).__next__()
-
-
 def _qkv(b, lq, lk, h, d, seed=0, dtype=np.float32):
     rng = np.random.default_rng(seed)
     mk = lambda l: jnp.asarray(  # noqa: E731
@@ -80,6 +74,19 @@ def test_gradients_match_dense():
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.skipif(
+    __import__("jax").default_backend() not in ("tpu", "axon"),
+    reason="compiled Mosaic kernel needs a real TPU (tests pin CPU)")
+def test_compiled_kernel_on_tpu():
+    # the non-interpret path: first Mosaic lowering must not wait for
+    # production — run this file directly on a TPU host to exercise it
+    q, k, v = _qkv(1, 1024, 1024, 4, 32, seed=5)
+    ref = attention(q, k, v, causal=True)   # also flash (>=512), compiled
+    got = flash_attention(q, k, v, causal=True, interpret=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
 
 
 def test_bfloat16_inputs():

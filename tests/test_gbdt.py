@@ -97,6 +97,7 @@ class TestBinning:
         legacy.trees["threshold"] = np.where(
             legacy.trees["is_leaf"], 0.0,
             1.7e9 + legacy.trees["threshold"])
+        legacy._f64_flag = None   # the verdict is cached; trees mutated
         assert legacy._needs_f64_inference()
         # cross-feature near-equal thresholds: per-feature grouping
         # avoids the false positive
