@@ -164,20 +164,27 @@ class HTTPRepo:
     layout LocalRepo publishes: ``<base>/index.json`` + ``<name>.msgpack``.
     """
 
-    handles_retries = True   # retry policy lives in the HTTP filesystem
+    handles_retries = True   # this repo owns its whole retry policy
 
     def __init__(self, base_url: str, retries: int = 3):
         self.base_url = base_url.rstrip("/")
         self._fs = None
         self.retries = retries
 
-    def _fetch(self, rel: str) -> bytes:
-        # retry policy lives in ONE layer — the HTTP filesystem — so
-        # downloader-level wrapping doesn't multiply attempts
+    def _filesystem(self):
         from mmlspark_tpu.utils.filesystem import HTTPFileSystem
         if self._fs is None:
-            self._fs = HTTPFileSystem(retries=self.retries)
-        return self._fs.read_bytes(f"{self.base_url}/{rel}")
+            # single transport attempt per try — OUR retry loop wraps
+            # fetch+verify together so corrupted-but-200 downloads are
+            # also re-fetched, without multiplying attempts
+            self._fs = HTTPFileSystem(retries=1)
+        return self._fs
+
+    def _fetch(self, rel: str) -> bytes:
+        fs = self._filesystem()
+        url = f"{self.base_url}/{rel}"
+        return retry_with_backoff(lambda: fs.read_bytes(url),
+                                  times=self.retries)
 
     def _load_index(self) -> Dict[str, Dict[str, Any]]:
         return json.loads(self._fetch("index.json").decode())
@@ -195,12 +202,19 @@ class HTTPRepo:
         return ModelSchema.from_json(idx[name])
 
     def read_blob(self, schema: ModelSchema, verify: bool = True) -> bytes:
-        blob = self._fetch(f"{schema.name}.msgpack")
-        if verify and hashlib.sha256(blob).hexdigest() != schema.sha256:
-            raise IOError(
-                f"sha256 mismatch for {schema.name} fetched from "
-                f"{self.base_url} (corrupt or tampered download)")
-        return blob
+        fs = self._filesystem()
+        url = f"{self.base_url}/{schema.name}.msgpack"
+
+        def fetch_and_verify() -> bytes:
+            blob = fs.read_bytes(url)
+            if verify and hashlib.sha256(blob).hexdigest() != schema.sha256:
+                raise IOError(
+                    f"sha256 mismatch for {schema.name} fetched from "
+                    f"{self.base_url} (corrupt or tampered download)")
+            return blob
+
+        # hash failures re-fetch too: a truncated 200 body is transient
+        return retry_with_backoff(fetch_and_verify, times=self.retries)
 
 
 class ModelDownloader:

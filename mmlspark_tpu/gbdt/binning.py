@@ -97,9 +97,11 @@ class BinMapper:
 
     def f32_safe(self) -> bool:
         """True when binning/threshold comparison can run in float32
-        without changing any assignment: every boundary's distance to
-        the data values it separates (computed from the TRUE gaps at fit
-        time) dominates the f32 rounding band around it. Timestamps/IDs
+        without changing assignments: every boundary's distance to the
+        data values it separates (measured on the fit SAMPLE — up to
+        sample_cnt rows, so unsampled rows inside a cut's f32 band can
+        still flip by one bin; the 8x-eps margin keeps that band narrow)
+        dominates the f32 rounding band around it. Timestamps/IDs
         (>24-bit mantissa) and features with sub-f32-resolution
         distinctions both fail and stay in f64."""
         return self.f32_values_safe

@@ -298,6 +298,15 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         p["hist_method"] = ("pallas"
                             if jax.default_backend() in ("tpu", "axon")
                             else "scatter")
+    if p["hist_method"] == "pallas" and int(p["max_bin"]) + 1 > 2048:
+        # beyond ~2048 bins the kernel's minimum block (c=128, fc=8)
+        # cannot fit the VMEM one-hot budget; onehot streams through HBM
+        # instead of failing Mosaic allocation
+        log_msg = (f"max_bin={p['max_bin']} exceeds the Pallas kernel's "
+                   f"VMEM tiling range; using the onehot path")
+        import logging
+        logging.getLogger("mmlspark_tpu.gbdt").warning(log_msg)
+        p["hist_method"] = "onehot"
 
     objective = get_objective(
         p["objective"], num_class=p["num_class"], alpha=p["alpha"],

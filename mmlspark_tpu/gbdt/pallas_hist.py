@@ -119,6 +119,14 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     fc = max(8, (elems // b_pad) // 8 * 8)
     fc = min(fc, f + ((-f) % 8))
     pad_feats = (-f) % fc
+    if c * fc * b_pad * 4 > 2 * VMEM_ONEHOT_BYTES:
+        # the fc/row floors could not respect the budget (huge num_bins)
+        # — fail loudly rather than letting Mosaic's allocator throw a
+        # cryptic compile error (booster routes such configs to onehot)
+        raise ValueError(
+            f"num_bins={num_bins} is beyond the Pallas histogram's VMEM "
+            f"tiling range (block {c}x{fc}x{b_pad}); use "
+            f"hist_method='onehot'")
 
     if pad_rows:
         bins = jnp.pad(bins, ((0, 0), (0, pad_rows)))

@@ -230,10 +230,16 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     "pass a sequence of DataTables or a zero-arg callable "
                     "returning a fresh iterator, not a one-shot generator")
             factory = table if callable(table) else (lambda: iter(table))
-            n = sum(len(t) for t in factory())   # one metadata pass
+            # one metadata pass: count rows AND grab the first shard for
+            # shapes/schema (IO-backed factories pay this pass once, not
+            # twice)
+            n, first_shard = 0, None
+            for t in factory():
+                if first_shard is None:
+                    first_shard = t
+                n += len(t)
             if n == 0:
                 raise ValueError("empty shard stream")
-            first_shard = next(iter(factory()))
             x0, y0 = table_to_xy(first_shard, fcol, lcol, input_shape)
             sample_x, sample_y = x0[:1], y0[:1].astype(y_cast)
             schema_src = first_shard

@@ -35,7 +35,8 @@ def confusion_matrix(table, y_col: str, y_hat_col: str,
     k = len(labels)
     cm = np.zeros((k, k), dtype=np.int64)
     for t, p in zip(y, y_hat):
-        cm[index[t], index[p]] += 1
+        if t in index and p in index:   # explicit labels may exclude rows
+            cm[index[t], index[p]] += 1
     accuracy = float(np.mean(y == y_hat))
     with np.errstate(invalid="ignore", divide="ignore"):
         cmn = np.nan_to_num(cm / cm.sum(axis=1, keepdims=True))

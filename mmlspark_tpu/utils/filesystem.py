@@ -17,7 +17,7 @@ import os
 import urllib.error
 import urllib.parse
 import urllib.request
-from typing import Callable, Dict, Iterator, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional
 
 
 class FileSystem:
@@ -145,19 +145,3 @@ def get_filesystem(path: str) -> FileSystem:
 
 def read_bytes(path: str) -> bytes:
     return get_filesystem(path).read_bytes(path)
-
-
-def iter_remote_binary_files(path: str, pattern: Optional[str] = None,
-                             recursive: bool = True,
-                             sample_ratio: float = 1.0,
-                             seed: int = 0) -> Iterator[Tuple[str, bytes]]:
-    """(path, bytes) pairs from any registered filesystem — the remote
-    branch of the binary reader (local paths keep the richer
-    zip-inspecting iterator in file_utils)."""
-    import random
-    rng = random.Random(seed)
-    fs = get_filesystem(path)
-    for p in fs.list_files(path, pattern, recursive):
-        if sample_ratio < 1.0 and rng.random() > sample_ratio:
-            continue
-        yield p, fs.read_bytes(p)

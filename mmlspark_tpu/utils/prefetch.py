@@ -33,16 +33,29 @@ class ThreadedPrefetcher:
         self._err: Optional[BaseException] = None
         self._stop = threading.Event()
 
+        def put_or_abort(item) -> bool:
+            """Stop-aware put: never blocks forever once close() ran
+            (a plain put could fill the queue after close's drain and
+            pin prepared device batches for the process lifetime)."""
+            while not self._stop.is_set():
+                try:
+                    self._q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def worker():
             try:
                 for item in source:
                     if self._stop.is_set():
                         return
-                    self._q.put(prepare(item))
+                    if not put_or_abort(prepare(item)):
+                        return
             except BaseException as e:  # noqa: BLE001 — forwarded to consumer
                 self._err = e
             finally:
-                self._q.put(_SENTINEL)
+                put_or_abort(_SENTINEL)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -59,12 +72,18 @@ class ThreadedPrefetcher:
         return item
 
     def close(self) -> None:
-        """Stop the worker and drain (for early exit)."""
+        """Stop the worker and drain (for early exit). Keeps draining
+        until the worker thread has exited so no prepared item can slip
+        into the queue after a one-shot drain and linger in HBM."""
         self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                self._thread.join(timeout=0.05)
         while True:
             try:
-                if self._q.get_nowait() is _SENTINEL:
-                    break
+                self._q.get_nowait()
             except queue.Empty:
                 break
 

@@ -556,3 +556,16 @@ class TestEstimatorStages:
         out_schema = clf.transform_schema(t.schema)
         assert "probability" in out_schema.names
         assert "prediction" in out_schema.names
+
+
+class TestLargeBinCounts:
+    def test_huge_max_bin_routes_to_onehot(self):
+        # VMEM tiling can't hold >2048 bins; 'pallas' must degrade to
+        # onehot instead of failing Mosaic allocation on TPU
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(3000, 2))
+        y = (X[:, 0] > 0).astype(float)
+        b = train({"objective": "binary", "num_iterations": 3,
+                   "max_bin": 4095, "hist_method": "pallas"}, X, y)
+        assert b.params["hist_method"] == "onehot"
+        assert np.isfinite(b.predict(X)).all()
