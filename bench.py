@@ -4,25 +4,38 @@ lightgbm headline path). BASELINE.json names exactly these two.
 
 CIFAR (ref: notebooks/gpu/401 — BrainScript ConvNet on 32x32x3 CIFAR-10,
 parallelTrain on a 4-GPU Azure N-series VM). The reference publishes no
-absolute numbers, so the baseline constant is the commonly-reported
-single-K80 CNTK ConvNet throughput for that hardware class, ~1000
-imgs/sec.
+absolute numbers, so the primary vs_baseline constant is the
+commonly-reported single-K80 CNTK ConvNet throughput for that hardware
+class, ~1000 imgs/sec. A measured in-image torch-CPU baseline (run
+``python tools/measure_baseline.py``, stored in BASELINE.json under
+"measured") is reported alongside when present.
+
+The training feed is DEVICE-RESIDENT (``TPULearner(dataFeed='device')``):
+the padded dataset lives in HBM, each epoch is shuffled on device, and the
+steady-state step consumes only a scalar index — so the number measures
+the chip, not host feed scheduling. MFU is computed from XLA's own
+cost-analysis FLOPs of the compiled train step against the chip's bf16
+peak (imgs/sec stays the headline; MFU makes it auditable).
+
+A ResNet-20 config (the notebook-301/401 model family) runs as a second
+training metric — the model where the MXU actually works.
 
 GBDT (ref: docs/lightgbm.md:16-18 — LightGBM-on-Spark "10-30% faster"
 than SparkML GBT on HIGGS, no absolute number). Config mirrors the
 LightGBM HIGGS benchmark shape: 1M rows x 28 features, binary objective,
-63 leaves, 63 bins, 40 iterations. Baseline constant: native LightGBM on
-a 16-core CPU node runs this config in ~35 s wall-clock (the
-order-of-magnitude from LightGBM's published experiments, scaled to 1M
-rows); no lightgbm binary exists in this image to re-measure. Wall-clock
-vs_baseline is baseline/ours, so >= 1.0 means we are faster.
+63 leaves, 63 bins, 40 iterations. vs_baseline prefers the MEASURED
+in-image sklearn HistGradientBoosting wall-clock on the identical config
+(BASELINE.json "measured"); the historical ~35 s LightGBM-CPU constant is
+the fallback and stays in the JSON as context. Wall-clock vs_baseline is
+baseline/ours, so >= 1.0 means we are faster.
 
-Prints ONE JSON line: the CIFAR headline with the GBDT result under
+Prints ONE JSON line: the CIFAR headline with the other results under
 "secondary". Runs on whatever jax.devices() provides (the real TPU chip
 under axon).
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -34,17 +47,40 @@ BASELINE_IMGS_PER_SEC_PER_CHIP = 1000.0
 
 # native LightGBM, 16-core CPU node, 1M x 28 HIGGS subsample, 63 leaves /
 # 63 bins / 40 iters (docs/lightgbm.md publishes no absolute number; see
-# module docstring)
+# module docstring). Fallback when no measured baseline exists.
 BASELINE_HIGGS_WALL_S = 35.0
 
 BATCH = 512
-STEPS_TARGET = 60
+STEPS_TARGET = 240
 
 HIGGS_N, HIGGS_F = 1_000_000, 28
 HIGGS_VALID_N = 100_000
 
 
-def bench_cifar():
+def _measured_baselines() -> dict:
+    """Measured baselines from BASELINE.json — only if they were measured
+    on THIS machine (else a different box's numbers would masquerade as a
+    measured-vs-measured comparison; rerun tools/measure_baseline.py)."""
+    import platform
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.json")
+    try:
+        with open(path) as f:
+            measured = json.load(f).get("measured", {})
+    except Exception:
+        return {}
+    here = f"{platform.machine()}, {os.cpu_count()} cores"
+    if measured.get("machine") != here:
+        print(f"# measured baselines are from {measured.get('machine')!r}, "
+              f"this is {here!r}; falling back to documented constants",
+              flush=True)
+        return {}
+    return measured
+
+
+def _train_throughput(network_spec: dict, steps_target: int) -> dict:
+    """Train on synthetic CIFAR-shaped data with the device-resident feed;
+    return imgs/sec/chip + MFU from the learner's own timing."""
     import jax
 
     from mmlspark_tpu.core.table import DataTable
@@ -61,23 +97,40 @@ def bench_cifar():
     table = DataTable({"features": x.reshape(n, -1), "label": y})
 
     steps_per_epoch = n // BATCH
-    epochs = max(1, STEPS_TARGET // steps_per_epoch)
+    epochs = max(1, steps_target // steps_per_epoch)
 
-    # notebook-401 ConvNet shape: 3 conv layers + dense, bf16 on the MXU
     learner = TPULearner(
-        networkSpec={"type": "convnet", "conv_features": [64, 64, 64],
-                     "dense_features": [256], "num_classes": 10},
+        networkSpec=network_spec,
         inputShape=[32, 32, 3],
         batchSize=BATCH, learningRate=0.1, computeDtype="bfloat16",
-        epochs=epochs, logEvery=1000)
+        epochs=epochs, logEvery=10_000, dataFeed="device")
     learner.set_mesh(mesh)
-
     learner.fit(table)
 
-    # steady-state throughput measured by the learner itself: device-synced
-    # at the first-step boundary (after compile) and at the final state, so
-    # async dispatch can't inflate or deflate the number
-    return learner.timing["examples_per_sec"] / n_chips
+    t = learner.timing
+    out = {
+        "imgs_per_sec_per_chip": t["examples_per_sec"] / n_chips,
+        "steps_timed": t["steps_timed"],
+    }
+    if "tflops_per_sec_per_chip" in t:
+        out["tflops_per_sec_per_chip"] = round(t["tflops_per_sec_per_chip"], 2)
+    if "mfu" in t:
+        out["mfu"] = round(t["mfu"], 4)
+    return out
+
+
+def bench_cifar() -> dict:
+    # notebook-401 ConvNet shape: 3 conv layers + dense, bf16 on the MXU
+    return _train_throughput(
+        {"type": "convnet", "conv_features": [64, 64, 64],
+         "dense_features": [256], "num_classes": 10}, STEPS_TARGET)
+
+
+def bench_resnet() -> dict:
+    # notebook-301/401 model family: CIFAR ResNet-20 (stage_sizes 3,3,3)
+    return _train_throughput(
+        {"type": "resnet", "stage_sizes": [3, 3, 3], "width": 16,
+         "num_classes": 10}, STEPS_TARGET // 2)
 
 
 def bench_higgs_gbdt():
@@ -108,24 +161,54 @@ def bench_higgs_gbdt():
 
 
 def main():
-    per_chip = bench_cifar()
+    measured = _measured_baselines()
+    cifar = bench_cifar()
+    resnet = bench_resnet()
     higgs_wall, higgs_auc, hist_method = bench_higgs_gbdt()
 
-    print(json.dumps({
+    per_chip = cifar["imgs_per_sec_per_chip"]
+    gbdt_base = measured.get("higgs1m_sklearn_hgb_wall_s")
+    gbdt_source = "measured:sklearn_hist_gradient_boosting"
+    if not gbdt_base:
+        gbdt_base, gbdt_source = BASELINE_HIGGS_WALL_S, "constant:lightgbm_cpu"
+
+    result = {
         "metric": "cifar10_convnet_train_imgs_per_sec_per_chip",
         "value": round(per_chip, 1),
         "unit": "imgs/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+        "feed": "device-resident",
         "secondary": {
             "metric": "higgs1m_gbdt_train_wall_clock",
             "value": round(higgs_wall, 1),
             "unit": "s",
-            "vs_baseline": round(BASELINE_HIGGS_WALL_S / higgs_wall, 3),
-            "holdout_auc": round(higgs_auc, 4),
+            "vs_baseline": round(gbdt_base / higgs_wall, 3),
+            "baseline_wall_s": gbdt_base,
+            "baseline_source": gbdt_source,
+            # AUC of the synthetic separable logit, NOT real HIGGS model
+            # quality (accuracy gates live in tests/test_benchmarks.py)
+            "synthetic_holdout_auc": round(higgs_auc, 4),
             "hist_method": hist_method,
             "config": f"{HIGGS_N}x{HIGGS_F}, 63 leaves, 63 bins, 40 iters",
         },
-    }))
+    }
+    for key in ("tflops_per_sec_per_chip", "mfu"):
+        if key in cifar:
+            result[key] = cifar[key]
+    resnet_entry = {
+        "metric": "cifar10_resnet20_train_imgs_per_sec_per_chip",
+        "value": round(resnet["imgs_per_sec_per_chip"], 1),
+        "unit": "imgs/sec/chip",
+    }
+    for key in ("tflops_per_sec_per_chip", "mfu"):
+        if key in resnet:
+            resnet_entry[key] = resnet[key]
+    result["secondary_resnet"] = resnet_entry
+    if measured.get("cifar_convnet_torch_cpu_imgs_per_sec"):
+        result["cpu_measured_baseline_imgs_per_sec"] = measured[
+            "cifar_convnet_torch_cpu_imgs_per_sec"]
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

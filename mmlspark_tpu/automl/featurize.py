@@ -40,14 +40,23 @@ class Featurize(Estimator):
     outputCol = ColParam("assembled features column", default="features")
     oneHotEncodeCategoricals = BoolParam("one-hot index columns",
                                          default=False)
-    # The reference defaults to 262144 (Featurize.scala:13-19) but keeps
-    # hashing-TF output *sparse*; this build materializes dense rows at the
-    # device boundary (~2 MB/row float64 at 2^18 — an OOM footgun), so the
-    # default is 2^12. Set it higher explicitly if you can afford N x width.
+    # The reference defaults to 262144 (Featurize.scala:13-19) and keeps
+    # hashing-TF output *sparse*. Dense mode lowers the default to 2^12
+    # (dense 2^18 is ~2 MB/row); sparse=True restores the reference
+    # behavior: CSR assembly at the full 262144 width, never densified.
     numberOfFeatures = IntParam("hash width for token columns",
                                 default=1 << 12)
+    sparse = BoolParam(
+        "assemble a CSR sparse features column (hash width defaults to "
+        "the reference's 262144 when unset; ref: Featurize.scala:13-19)",
+        default=False)
     allowImages = BoolParam("parity param (image passthrough)",
                             default=False)
+
+    def _hash_width(self) -> int:
+        if self.get("sparse") and "numberOfFeatures" not in self._paramMap:
+            return 1 << 18    # the reference's sparse default
+        return self.get("numberOfFeatures")
 
     def fit(self, table: DataTable) -> "FeaturizeModel":
         cols = self.get_or_none("featureColumns")
@@ -83,7 +92,8 @@ class Featurize(Estimator):
                                   "levels": levels})
             elif f.tag == LIST:
                 specs.append({"col": c, "kind": "hash",
-                              "size": self.get("numberOfFeatures")})
+                              "size": self._hash_width(),
+                              "sparse": self.get("sparse")})
             elif f.tag == VECTOR:
                 specs.append({"col": c, "kind": "vector"})
             # other tags (struct/bytes/object) are skipped, like the
@@ -132,6 +142,16 @@ class FeaturizeModel(Model):
                 parts.append(oh)
             elif kind == "hash":
                 m = spec["size"]
+                if spec.get("sparse"):
+                    # reference behavior: 262144-wide hashed text stays a
+                    # SparseVector end to end (Featurize.scala:13-19) —
+                    # here a CSR block that never densifies
+                    from mmlspark_tpu.core.sparse import CSRMatrix
+                    from mmlspark_tpu.stages.text import _hash_counts
+                    parts.append(CSRMatrix.from_rows(
+                        (_hash_counts(toks, m, False)
+                         for toks in table[c]), num_cols=m))
+                    continue
                 # float32 halves the dense-materialization footprint; TF
                 # counts are small integers so no precision is lost
                 mat = np.zeros((n, m), dtype=np.float32)
@@ -148,12 +168,21 @@ class FeaturizeModel(Model):
                         [np.asarray(v, dtype=np.float32) for v in col]))
         if not parts:
             raise ValueError("no featurizable columns found")
-        feats = np.concatenate(parts, axis=1)
-        return table.with_column(self.get("outputCol"), feats,
-                                 Field(self.get("outputCol"), VECTOR))
+        from mmlspark_tpu.core.sparse import CSRMatrix as _CSR, hstack
+        if any(isinstance(p, _CSR) for p in parts):
+            feats: Any = hstack(parts)
+            field = Field(self.get("outputCol"), VECTOR, {"sparse": True})
+        else:
+            feats = np.concatenate(parts, axis=1)
+            field = Field(self.get("outputCol"), VECTOR)
+        return table.with_column(self.get("outputCol"), feats, field)
 
     def transform_schema(self, schema: Schema) -> Schema:
-        return schema.add_or_replace(Field(self.get("outputCol"), VECTOR))
+        sparse = any(s.get("sparse") and s.get("kind") == "hash"
+                     for s in (self.get("specs") or []))
+        meta = {"sparse": True} if sparse else {}
+        return schema.add_or_replace(
+            Field(self.get("outputCol"), VECTOR, meta))
 
 
 class AssembleFeatures(Estimator):

@@ -20,6 +20,7 @@ guesses from file extensions.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 import shutil
@@ -247,8 +248,28 @@ def save_stage(stage, path: str, overwrite: bool = True) -> None:
         "complex_params": complex_kinds,
         "extra": _json_safe(extra),
     }
+    markers = _numerics_markers(stage)
+    if markers:
+        meta["numerics_markers"] = markers
     with open(os.path.join(path, "metadata.json"), "w") as f:
         json.dump(meta, f, indent=1)
+
+
+def _numerics_markers(stage) -> Dict[str, str]:
+    """Version markers for numerics-affecting architecture changes, so a
+    checkpoint trained under older numerics fails loudly on load instead
+    of silently degrading (e.g. the ResNet stride-2 padding change —
+    see models/networks.py ResNetBlock)."""
+    markers: Dict[str, str] = {}
+    try:
+        from mmlspark_tpu.models.networks import ResNet
+        for value in stage._paramMap.values():
+            module = getattr(value, "module", value)
+            if isinstance(module, ResNet):
+                markers["resnet_padding"] = "explicit11-torch-compat"
+    except Exception:
+        pass
+    return markers
 
 
 def load_stage(path: str):
@@ -281,4 +302,19 @@ def load_stage(path: str):
         stage._paramMap[name] = value
     if hasattr(stage, "_load_extra"):
         stage._load_extra(os.path.join(path, "extra"), meta.get("extra", {}))
+    expected = _numerics_markers(stage)
+    saved = meta.get("numerics_markers", {})
+    for key, current in expected.items():
+        if saved.get(key) != current:
+            # loud on both channels: warnings for interactive callers,
+            # error-level log for services where warnings are swallowed
+            import warnings
+            msg = (
+                f"stage {cls_name} was saved before the {key!r} numerics "
+                f"change (saved marker {saved.get(key)!r}, current "
+                f"{current!r}): a ResNet checkpoint trained under the old "
+                f"stride-2 padding will produce shifted activations — "
+                f"retrain or re-import it (models/networks.py ResNetBlock)")
+            warnings.warn(msg, stacklevel=2)
+            logging.getLogger("mmlspark_tpu.serialize").error(msg)
     return stage

@@ -32,6 +32,12 @@ def _is_sequence(x) -> bool:
 
 def _infer_field(name: str, data: ColumnData) -> Field:
     """Infer a Field from column data."""
+    from mmlspark_tpu.core.sparse import CSRMatrix
+    if isinstance(data, CSRMatrix):
+        # sparse vector column (the reference's SparseVector analog,
+        # ref: Featurize.scala:13-19 — 262144-wide hashed features stay
+        # sparse end to end)
+        return Field(name, S.VECTOR, {"sparse": True})
     if isinstance(data, np.ndarray):
         if data.ndim == 1:
             return Field(name, S.tag_for_numpy(data.dtype))
@@ -72,6 +78,9 @@ def _infer_field(name: str, data: ColumnData) -> Field:
 
 def _normalize_column(data: Any, n_rows: Optional[int]) -> ColumnData:
     """Coerce input to a canonical column representation."""
+    from mmlspark_tpu.core.sparse import CSRMatrix
+    if isinstance(data, CSRMatrix):
+        return data   # first-class sparse column, never densified
     if isinstance(data, np.ndarray):
         return data
     if isinstance(data, (list, tuple)):
@@ -107,8 +116,13 @@ def _normalize_column(data: Any, n_rows: Optional[int]) -> ColumnData:
 
 def features_matrix(table: "DataTable", col: str) -> np.ndarray:
     """Vector column -> dense (N, F) float64 matrix (the shared coercion
-    every model stage uses to feed features to the device)."""
+    every model stage uses to feed features to the device). Sparse
+    columns densify HERE and only here — sparse-aware stages should read
+    the CSRMatrix via ``table.column`` instead."""
+    from mmlspark_tpu.core.sparse import CSRMatrix
     c = table.column(col)
+    if isinstance(c, CSRMatrix):
+        return c.toarray().astype(np.float64)
     if isinstance(c, np.ndarray) and c.ndim == 2:
         return np.asarray(c, dtype=np.float64)
     return np.stack([np.asarray(v, dtype=np.float64) for v in c])
@@ -196,9 +210,13 @@ class DataTable:
                 raise ValueError(
                     f"concat: table {i} columns {t.column_names} != "
                     f"table 0 columns {base.column_names}")
+        from mmlspark_tpu.core.sparse import CSRMatrix, vstack
         cols: Dict[str, ColumnData] = {}
         for name in base.column_names:
             parts = [t._columns[name] for t in tables]
+            if all(isinstance(p, CSRMatrix) for p in parts):
+                cols[name] = vstack(parts)
+                continue
             if all(isinstance(p, np.ndarray) for p in parts):
                 try:
                     cols[name] = np.concatenate(parts, axis=0)
@@ -296,9 +314,12 @@ class DataTable:
                          num_shards=self.num_shards)
 
     def _take_indices(self, idx) -> "DataTable":
+        from mmlspark_tpu.core.sparse import CSRMatrix
         cols: Dict[str, ColumnData] = {}
         for n, c in self._columns.items():
-            if isinstance(c, np.ndarray):
+            if isinstance(c, CSRMatrix):
+                cols[n] = c.take(np.asarray(idx))
+            elif isinstance(c, np.ndarray):
                 cols[n] = c[idx]
             else:
                 cols[n] = [c[i] for i in idx]
@@ -414,11 +435,14 @@ class DataTable:
         """Save to a directory (npz for array columns, pickle for complex)."""
         import os, pickle, json
         os.makedirs(path, exist_ok=True)
+        from mmlspark_tpu.core.sparse import CSRMatrix
         arrays = {}
         objects = {}
         for n, c in self._columns.items():
             if isinstance(c, np.ndarray) and c.dtype != object:
                 arrays[n] = c
+            elif isinstance(c, CSRMatrix):
+                objects[n] = c   # picklable as-is; list(c) would densify
             else:
                 objects[n] = list(c)
         np.savez(os.path.join(path, "columns.npz"), **arrays)

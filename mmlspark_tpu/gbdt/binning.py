@@ -48,16 +48,97 @@ class BinMapper:
     @staticmethod
     def fit(X: np.ndarray, max_bin: int = 255,
             sample_cnt: int = 200_000, seed: int = 2) -> "BinMapper":
-        X = np.asarray(X, dtype=np.float64)
+        X_full = X = np.asarray(X, dtype=np.float64)
         n, f = X.shape
+        sampled_idx = None
         if n > sample_cnt:
             rng = np.random.default_rng(seed)
-            idx = rng.choice(n, size=sample_cnt, replace=False)
-            X = X[idx]
+            sampled_idx = rng.choice(n, size=sample_cnt, replace=False)
+            X = X[sampled_idx]
         results = [_feature_bounds(X[:, j], max_bin) for j in range(f)]
         bounds = [b for b, _ in results]
         safe = all(ok for _, ok in results)
+        if safe and sampled_idx is not None:
+            # the gap-based safety above is certified on the SAMPLE only;
+            # unsampled rows inside a cut's f32 rounding band could still
+            # flip one bin on the f32 device path. Spot-check a holdout of
+            # unsampled rows: if any bins differently in f32, drop to f64.
+            mask = np.ones(n, dtype=bool)
+            mask[sampled_idx] = False
+            rest = np.flatnonzero(mask)
+            if len(rest) > 50_000:
+                rest = rng.choice(rest, size=50_000, replace=False)
+            hold = X_full[rest]
+            for j, ub in enumerate(bounds):
+                if not len(ub):
+                    continue
+                col = hold[:, j]
+                ok = ~np.isnan(col)   # NaN maps to bin 0 in either dtype
+                b64 = np.searchsorted(ub, col[ok], side="left")
+                b32 = np.searchsorted(ub.astype(np.float32),
+                                      col[ok].astype(np.float32),
+                                      side="left")
+                if not np.array_equal(b64, b32):
+                    import logging
+                    logging.getLogger("mmlspark_tpu.gbdt").info(
+                        "feature %d: unsampled rows bin differently in "
+                        "f32; using the f64 binning path", j)
+                    safe = False
+                    break
         return BinMapper(bounds, max_bin, f32_values_safe=safe)
+
+    @staticmethod
+    def fit_sparse(csr, max_bin: int = 255, sample_cnt: int = 200_000,
+                   seed: int = 2) -> "BinMapper":
+        """Fit boundaries directly from a CSRMatrix — per-feature
+        nonzeros come from a one-shot CSC view and the implicit zeros
+        enter the frequency histogram analytically, so no dense float
+        matrix ever exists (the LGBM_DatasetCreateFromCSR analog,
+        ref: LightGBMUtils.scala:283-351)."""
+        n = csr.shape[0]
+        if n > sample_cnt:
+            rng = np.random.default_rng(seed)
+            csr = csr.take(rng.choice(n, size=sample_cnt, replace=False))
+            n = sample_cnt
+        col_ptr, _, vals = csr.csc()
+        bounds: List[np.ndarray] = []
+        safe = True
+        for j in range(csr.shape[1]):
+            v = vals[col_ptr[j]:col_ptr[j + 1]]
+            v = v[np.isfinite(v)]
+            distinct, counts = np.unique(v, return_counts=True)
+            counts = counts.astype(np.int64)
+            zeros = n - (int(col_ptr[j + 1]) - int(col_ptr[j]))
+            if zeros > 0:
+                pos = int(np.searchsorted(distinct, 0.0))
+                if pos < len(distinct) and distinct[pos] == 0.0:
+                    counts[pos] += zeros
+                else:
+                    distinct = np.insert(distinct, pos, 0.0)
+                    counts = np.insert(counts, pos, zeros)
+            b, ok = _bounds_from_counts(np.asarray(distinct, np.float64),
+                                        counts, max_bin)
+            bounds.append(b)
+            safe = safe and ok
+        return BinMapper(bounds, max_bin, f32_values_safe=safe)
+
+    def transform_sparse(self, csr) -> np.ndarray:
+        """CSRMatrix -> FEATURES-MAJOR (F, N) int32 bins without a dense
+        float matrix: every row starts in its feature's zero bin, then
+        only the nonzeros are re-binned via searchsorted."""
+        n, f = csr.shape
+        out = np.empty((f, n), np.int32)
+        col_ptr, rows, vals = csr.csc()
+        for j in range(f):
+            ub = self.upper_bounds[j]
+            out[j, :] = np.searchsorted(ub, 0.0, side="left")
+            lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
+            if hi > lo:
+                b = np.searchsorted(ub, vals[lo:hi], side="left"
+                                    ).astype(np.int32)
+                b[np.isnan(vals[lo:hi])] = 0
+                out[j, rows[lo:hi]] = b
+        return out
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Raw features -> int32 bin indices, shape (N, F).
@@ -148,6 +229,14 @@ def _feature_bounds(col: np.ndarray, max_bin: int):
     if col.size == 0:
         return np.empty(0), True
     distinct, counts = np.unique(col, return_counts=True)
+    return _bounds_from_counts(distinct, counts, max_bin)
+
+
+def _bounds_from_counts(distinct: np.ndarray, counts: np.ndarray,
+                        max_bin: int):
+    """Equal-frequency cuts from a (sorted distinct values, counts)
+    histogram — shared by the dense column path and the sparse path
+    (which merges the implicit-zeros count in without materializing)."""
     if len(distinct) <= 1:
         return np.empty(0), True
     if len(distinct) <= max_bin:

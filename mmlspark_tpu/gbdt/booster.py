@@ -54,7 +54,7 @@ DEFAULTS: Dict[str, Any] = {
     "alpha": 0.9,                      # quantile / huber
     "tweedie_variance_power": 1.5,
     "hist_method": "auto",  # 'auto' | 'scatter' | 'onehot' | 'pallas'
-    "parallelism": "serial",           # 'serial' | 'data'
+    "parallelism": "serial",   # 'serial' | 'data' | 'feature'
 }
 
 
@@ -125,7 +125,18 @@ class Booster:
 
     def raw_score(self, X: np.ndarray,
                   num_iteration: Optional[int] = None) -> np.ndarray:
-        """Raw margin scores, shape (N,) or (K, N) for multiclass."""
+        """Raw margin scores, shape (N,) or (K, N) for multiclass.
+        CSRMatrix inputs score through chunked densification (8192 rows
+        at a time) — bounded memory at any feature width."""
+        from mmlspark_tpu.core.sparse import CSRMatrix
+        if isinstance(X, CSRMatrix):
+            if X.shape[0] == 0:
+                return self.raw_score(
+                    np.zeros((0, len(self.feature_names))), num_iteration)
+            outs = [self.raw_score(X[lo:min(lo + 8192, X.shape[0])]
+                                   .toarray(), num_iteration)
+                    for lo in range(0, X.shape[0], 8192)]
+            return np.concatenate(outs, axis=-1)
         n = np.asarray(X).shape[0]
         K = self.num_class
         it = self._resolve_iterations(num_iteration)
@@ -313,8 +324,27 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         tweedie_variance_power=p["tweedie_variance_power"])
     K = objective.num_class
 
-    # 1) bin on host, once (dense or streaming-shard input)
-    if y is None and not isinstance(X, np.ndarray):
+    # 1) bin on host, once (dense or streaming-shard input).
+    # Streaming = an iterable of shards passed WITHOUT y; disambiguate
+    # carefully so dense list-of-lists and mislabeled generators get a
+    # clear error instead of a confusing unpack/object-cast failure.
+    from mmlspark_tpu.core.sparse import CSRMatrix as _CSRMatrix
+    streaming = y is None and not isinstance(X, (np.ndarray, _CSRMatrix))
+    if streaming and isinstance(X, (list, tuple)):
+        try:
+            X = np.asarray(X, dtype=np.float64)   # dense rows as lists
+            streaming = False
+        except (TypeError, ValueError):
+            pass   # a genuine list of shard tuples / DataTables
+    if not streaming and y is None:
+        raise ValueError("y is required when X is a dense matrix")
+    if y is not None and not isinstance(X, np.ndarray) \
+            and hasattr(X, "__next__"):
+        raise ValueError(
+            "iterator X with a separate y is ambiguous: streaming mode "
+            "passes y=None and the iterator yields "
+            "(X_shard, y_shard[, w_shard]) tuples")
+    if streaming:
         if sample_weight is not None:
             raise ValueError(
                 "pass per-shard weights inside the shard tuples in "
@@ -326,28 +356,52 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             X, p["max_bin"], p["seed"])
         n, f = bins_np.shape
     else:
-        X = np.asarray(X, dtype=np.float64)
+        from mmlspark_tpu.core.sparse import CSRMatrix
         y = np.asarray(y, dtype=np.float64)
-        n, f = X.shape
-        w_base = (np.ones(n) if sample_weight is None
-                  else np.asarray(sample_weight, dtype=np.float64))
-        mapper = BinMapper.fit(X, max_bin=p["max_bin"], seed=p["seed"])
-        bins_np = None   # dense path bins on device (below)
+        if isinstance(X, CSRMatrix):
+            # CSR ingestion: bin straight from the sparse structure —
+            # the dense FLOAT matrix never exists (the
+            # LGBM_DatasetCreateFromCSR analog, ref:
+            # LightGBMUtils.scala:283-351). The engine's HBM layout is
+            # still a dense (F, N) int bin matrix; guard its footprint.
+            n, f = X.shape
+            if f * n * 4 > 8 << 30:
+                raise ValueError(
+                    f"binned matrix for CSR input would need "
+                    f"{f * n * 4 / 2**30:.1f} GB ({f} features x {n} "
+                    f"rows); reduce the feature width (hashing) first")
+            w_base = (np.ones(n) if sample_weight is None
+                      else np.asarray(sample_weight, dtype=np.float64))
+            mapper = BinMapper.fit_sparse(
+                X, max_bin=p["max_bin"], seed=p["seed"])
+            # (F, N) natively; the .T view re-transposes to the row-major
+            # shape the shared code expects and is undone at zero cost by
+            # the ascontiguousarray(bins_np.T) below
+            bins_np = mapper.transform_sparse(X).T
+        else:
+            X = np.asarray(X, dtype=np.float64)
+            n, f = X.shape
+            w_base = (np.ones(n) if sample_weight is None
+                      else np.asarray(sample_weight, dtype=np.float64))
+            mapper = BinMapper.fit(X, max_bin=p["max_bin"], seed=p["seed"])
+            bins_np = None   # dense path bins on device (below)
     if feature_names is None:
         feature_names = [f"Column_{i}" for i in range(f)]
     num_bins = int(mapper.num_bins.max())
 
-    # 2) data-parallel layout
+    # 2) parallel layout (tree_learner modes, ref: TrainParams.scala:26)
     data_parallel = p["parallelism"] == "data"
+    feature_parallel = p["parallelism"] == "feature"
     axis_name = None
     n_shards = 1
-    if data_parallel:
+    if data_parallel or feature_parallel:
         if mesh is None:
             mesh = mesh_lib.make_mesh()
         axis_name = mesh_lib.DATA_AXIS
         n_shards = mesh.shape[axis_name]
 
-    pad = (-n) % max(n_shards, 1)
+    # rows pad to the shard count only when rows are sharded
+    pad = (-n) % max(n_shards if data_parallel else 1, 1)
     if pad:
         y_pad = np.pad(y, (0, pad))
         w_pad = np.pad(w_base, (0, pad))  # zero weight → padding inert
@@ -366,16 +420,24 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # record f32 safety on the model so inference picks the right walk
     # (warm start below ORs in the base model's flag)
     p["f32_unsafe"] = not mapper.f32_safe()
-    if bins_np is None and (data_parallel or not mapper.f32_safe()):
+    if bins_np is None and (data_parallel or feature_parallel
+                            or not mapper.f32_safe()):
         bins_np = mapper.transform(X)
+    # feature-parallel shards the (F, N) feature dim: pad F to the shard
+    # count with always-masked dummy features (fmask 0 keeps them out of
+    # every split search)
+    f_pad = (-f) % n_shards if feature_parallel else 0
+    f_eff = f + f_pad
     if bins_np is None:
         ub = jnp.asarray(mapper.threshold_matrix(num_bins), jnp.float32)
         bins_dev = _device_binning(jnp.asarray(X, jnp.float32), ub, pad)
     else:
         if pad:
             bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
-        bins_dev = jnp.asarray(
-            np.ascontiguousarray(bins_np.T), jnp.int32)
+        bins_t = np.ascontiguousarray(bins_np.T)
+        if f_pad:
+            bins_t = np.pad(bins_t, ((0, f_pad), (0, 0)))
+        bins_dev = jnp.asarray(bins_t, jnp.int32)
 
     # 3) init scores — fresh start or warm start from a base forest
     base_model: Optional[Booster] = None
@@ -426,7 +488,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     step_fn = _make_step(
         (p["objective"], K, float(p["alpha"]),
          float(p["tweedie_variance_power"])),
-        gp, lr, K, axis_name, mesh)
+        gp, lr, K, axis_name, mesh,
+        "feature" if feature_parallel else "data")
 
     scores_np = (base_scores if base_model is not None
                  else np.broadcast_to(
@@ -442,6 +505,14 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         scores = jax.device_put(
             jnp.asarray(scores_np, jnp.float32),
             jax.sharding.NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS)))
+    elif feature_parallel:
+        repl = jax.sharding.NamedSharding(mesh, P())
+        bins_d = jax.device_put(
+            bins_dev,
+            jax.sharding.NamedSharding(
+                mesh, P(mesh_lib.DATA_AXIS, None)))   # FEATURES on axis
+        y_d = jax.device_put(jnp.asarray(y_pad, jnp.float32), repl)
+        scores = jax.device_put(jnp.asarray(scores_np, jnp.float32), repl)
     else:
         bins_d = bins_dev
         y_d = jnp.asarray(y_pad, jnp.float32)
@@ -456,9 +527,14 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     esr = int(p["early_stopping_round"])
     use_valid = valid is not None and esr > 0
     if use_valid:
-        bins_v = jnp.asarray(
-            mapper.transform(np.asarray(valid[0], dtype=np.float64))
-            .astype(np.float32))
+        from mmlspark_tpu.core.sparse import CSRMatrix as _CSR
+        if isinstance(valid[0], _CSR):
+            bins_v = jnp.asarray(
+                mapper.transform_sparse(valid[0]).T.astype(np.float32))
+        else:
+            bins_v = jnp.asarray(
+                mapper.transform(np.asarray(valid[0], dtype=np.float64))
+                .astype(np.float32))
         yv = jnp.asarray(np.asarray(valid[1], dtype=np.float32))
         if base_model is not None:
             v_scores = jnp.asarray(_base_raw_kn(
@@ -494,7 +570,9 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     ff_active = p["feature_fraction"] < 1.0
     w_d = _maybe_shard(jnp.asarray(w_pad, jnp.float32), mesh,
                        data_parallel)
-    fmask = jnp.ones(f, jnp.float32)
+    fmask_base = np.zeros(f_eff, np.float32)
+    fmask_base[:f] = 1.0          # padded dummy features stay masked
+    fmask = jnp.asarray(fmask_base)
     trees_done = 0
     for it in range(n_iter):
         # bagging (ref: TrainParams baggingFraction/baggingFreq —
@@ -508,7 +586,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         if ff_active:
             k = max(1, int(np.ceil(p["feature_fraction"] * f)))
             chosen = rng.choice(f, size=k, replace=False)
-            fmask_np = np.zeros(f, np.float32)
+            fmask_np = np.zeros(f_eff, np.float32)
             fmask_np[chosen] = 1.0
             fmask = jnp.asarray(fmask_np)
 
@@ -666,11 +744,16 @@ def _tree_depth(tree_host: Dict[str, np.ndarray]) -> int:
 @functools.lru_cache(maxsize=64)
 def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
                lr: float, K: int, axis_name: Optional[str],
-               mesh: Optional[Mesh]):
+               mesh: Optional[Mesh], parallel_mode: str = "data"):
     """Build the per-iteration jitted step:
     gradients → K trees → score update. Returns
     (new_scores, tuple_of_K_trees). lru_cached so a second train() with
-    the same config hits the XLA compile cache."""
+    the same config hits the XLA compile cache.
+
+    ``parallel_mode`` picks the tree_learner sharding (ref:
+    TrainParams.scala:26): 'data' shards rows over the mesh axis,
+    'feature' shards the (F, N) binned matrix's FEATURE dim and
+    replicates rows (see tree.grow_tree)."""
     name, num_class, alpha, rho = obj_key
     objective = get_objective(name, num_class=num_class, alpha=alpha,
                               tweedie_variance_power=rho)
@@ -687,7 +770,8 @@ def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
         new_scores = scores
         for k in range(K):
             tree, leaf_of_row, leaf_vals, _ = grow_tree(
-                bins, grad[k], hess[k], w, fmask, gp, axis_name)
+                bins, grad[k], hess[k], w, fmask, gp, axis_name,
+                parallel_mode)
             new_scores = new_scores.at[k].add(lr * leaf_vals[leaf_of_row])
             forest = Tree(*[
                 getattr(forest, fld).at[base + k].set(getattr(tree, fld))
@@ -699,10 +783,17 @@ def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
 
     d = mesh_lib.DATA_AXIS
     tree_spec = Tree(*([P()] * len(Tree._fields)))
+    if parallel_mode == "feature":
+        # features sharded, rows replicated; tree/scores replicated
+        in_specs = (P(d, None), P(), P(), P(), P(d), tree_spec, P())
+        out_specs = (P(), tree_spec)
+    else:
+        in_specs = (P(None, d), P(None, d), P(d), P(d), P(None),
+                    tree_spec, P())
+        out_specs = (P(None, d), tree_spec)
     mapped = shard_map(
         step, mesh=mesh,
-        in_specs=(P(None, d), P(None, d), P(d), P(d), P(None),
-                  tree_spec, P()),
-        out_specs=(P(None, d), tree_spec),
+        in_specs=in_specs,
+        out_specs=out_specs,
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(1, 5))

@@ -59,8 +59,10 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         "device histogram strategy ('auto' = pallas MXU kernel on TPU, "
         "scatter elsewhere)", default="auto")
     parallelism = EnumParam(
-        ["serial", "data"],
-        "tree learner parallelism (ref: TrainParams.scala:26)",
+        ["serial", "data", "feature"],
+        "tree learner parallelism: 'data' shards rows, 'feature' shards "
+        "the feature axis — the wide-data mode "
+        "(ref: TrainParams.scala:26 tree_learner=data/feature)",
         default="serial")
     validationData = TableParam("held-out table for early stopping",
                                 default=None)
@@ -92,7 +94,11 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         }
 
     def _features_matrix(self, table: DataTable) -> np.ndarray:
+        from mmlspark_tpu.core.sparse import CSRMatrix
         from mmlspark_tpu.core.table import features_matrix
+        col = table.column(self.get_features_col())
+        if isinstance(col, CSRMatrix):
+            return col    # booster.train bins CSR directly, no densify
         return features_matrix(table, self.get_features_col())
 
     def _fit_arrays(self, table: DataTable):

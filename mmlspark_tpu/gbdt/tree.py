@@ -72,10 +72,11 @@ def _split_gain(g, h, l1, l2):
     return num * num / (h + l2)
 
 
-@partial(jax.jit, static_argnames=("p", "axis_name"))
+@partial(jax.jit, static_argnames=("p", "axis_name", "parallel_mode"))
 def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               weight: jnp.ndarray, feature_mask: jnp.ndarray,
-              p: GrowParams, axis_name: Optional[str] = None):
+              p: GrowParams, axis_name: Optional[str] = None,
+              parallel_mode: str = "data"):
     """Grow one tree; returns (Tree, leaf_of_row, leaf_values_per_slot).
 
     bins is FEATURES-MAJOR (F, N) int32 — row-major (N, F) would make
@@ -94,11 +95,27 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     O(N·F[·B]) instead of O(L·N·F[·B]) — the difference between
     feasible and infeasible at HIGGS scale (255 leaves) for the
     MXU matmul formulations.
+
+    Distribution (``axis_name`` set, inside shard_map) follows the
+    reference's ``tree_learner`` modes (ref: TrainParams.scala:26):
+
+    - ``parallel_mode='data'``: rows sharded; histograms psum over the
+      axis; every device sees identical totals and grows the same tree.
+    - ``parallel_mode='feature'``: FEATURES sharded, rows replicated —
+      the wide-data mode. Histograms stay local (disjoint features);
+      each device proposes its best local split, candidates are
+      all_gather'd and argmax'd (LightGBM's split-communication step),
+      and the winning feature's OWNER broadcasts the row partition via
+      psum (LightGBM feature-parallel broadcasts exactly this bitmap).
+      Feature ids in the returned tree are GLOBAL
+      (device_index * local_F + local id).
     """
     f, n = bins.shape
     L = p.num_leaves
     M = 2 * L - 1
     B = p.num_bins
+    feat_par = parallel_mode == "feature" and axis_name is not None
+    hist_axis = None if feat_par else axis_name
 
     min_hess = p.min_sum_hessian_in_leaf
     min_data = float(p.min_data_in_leaf)
@@ -108,7 +125,7 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         """(3, F, B) histogram of the rows selected by mask_weight."""
         h = build_histogram(bins, grad, hess, mask_weight, zero_leaf,
                             1, B, method=p.hist_method,
-                            axis_name=axis_name)       # (3, 1, F, B)
+                            axis_name=hist_axis)       # (3, 1, F, B)
         return h[:, 0]
 
     def best_split(hist, depth_ok):
@@ -131,8 +148,30 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         gain = jnp.where(ok, gain, NEG_INF)
         flat = jnp.argmax(gain)
         bf, bb = jnp.unravel_index(flat, gain.shape)
-        return (gain.reshape(-1)[flat], bf.astype(jnp.int32),
-                bb.astype(jnp.int32), CL[bf, bb], C)
+        gain_v, cl_v = gain.reshape(-1)[flat], CL[bf, bb]
+        bf, bb = bf.astype(jnp.int32), bb.astype(jnp.int32)
+        if feat_par:
+            # exchange candidates; every device argmaxes the same table
+            # so split decisions stay identical (tie → lowest device id)
+            bf_g = lax.axis_index(axis_name) * f + bf
+            cand = jnp.stack([gain_v, bf_g.astype(jnp.float32),
+                              bb.astype(jnp.float32), cl_v])
+            allc = lax.all_gather(cand, axis_name)       # (n_dev, 4)
+            win = jnp.argmax(allc[:, 0])
+            return (allc[win, 0], allc[win, 1].astype(jnp.int32),
+                    allc[win, 2].astype(jnp.int32), allc[win, 3], C)
+        return gain_v, bf, bb, cl_v, C
+
+    def split_indicator(leaf_of_row, bl, bf, bb):
+        """rows of leaf ``bl`` that go RIGHT under split (bf, bb); in
+        feature-parallel mode only the owner holds column bf, so it
+        computes the bitmap and psum broadcasts it to the other shards."""
+        if feat_par:
+            owner = bf // f
+            ind = (bins[bf % f] > bb) & (leaf_of_row == bl)
+            ind = jnp.where(lax.axis_index(axis_name) == owner, ind, False)
+            return lax.psum(ind.astype(jnp.float32), axis_name) > 0
+        return (leaf_of_row == bl) & (bins[bf] > bb)
 
     # root: slot 0 holds all rows (its children sit at depth 1, legal for
     # any max_depth >= 1, so the root's candidate is never depth-blocked)
@@ -172,7 +211,7 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             & (best_gain > NEG_INF / 2)
 
         new_leaf = st["n_leaves"]
-        goes_right = (st["leaf_of_row"] == bl) & (bins[bf] > bb)
+        goes_right = split_indicator(st["leaf_of_row"], bl, bf, bb)
         leaf_of_row2 = jnp.where(goes_right & do, new_leaf,
                                  st["leaf_of_row"])
 
@@ -235,7 +274,8 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     seg = st["leaf_of_row"]
     g_leaf = jax.ops.segment_sum(grad * weight, seg, num_segments=L)
     h_leaf = jax.ops.segment_sum(hess * weight, seg, num_segments=L)
-    if axis_name is not None:
+    if axis_name is not None and not feat_par:
+        # feature-parallel replicates rows, so leaf sums are already total
         g_leaf = lax.psum(g_leaf, axis_name)
         h_leaf = lax.psum(h_leaf, axis_name)
     leaf_values = _leaf_output(g_leaf, h_leaf, p.lambda_l1, p.lambda_l2)

@@ -88,6 +88,40 @@ def make_optimizer(name: str, lr: float, *, momentum: float = 0.9,
 # ---------------------------------------------------------------------------
 
 
+# bf16 peak FLOP/s per chip by device kind — used only to report MFU
+# alongside measured throughput (public figures; unknown kinds -> None)
+_PEAK_BF16_FLOPS = {
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(device_kind: str) -> Optional[float]:
+    """Best-effort bf16 peak for MFU reporting; None when unknown."""
+    for kind, peak in _PEAK_BF16_FLOPS.items():
+        if device_kind.startswith(kind) or kind in device_kind:
+            return peak
+    return None
+
+
+def _step_flops(compiled) -> Optional[float]:
+    """Per-step FLOPs from XLA's cost analysis of a compiled step."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:  # backend without cost analysis
+        return None
+
+
 def fsdp_sharding_rule(mesh: Mesh, axis: str = mesh_lib.FSDP_AXIS
                        ) -> Callable[[jnp.ndarray], NamedSharding]:
     """Shard each leaf's largest dim divisible by the axis size; replicate
@@ -166,6 +200,14 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
     resume = BoolParam("resume from latest checkpoint if present",
                        default=True)
     logEvery = IntParam("steps between loss logs", default=50)
+    dataFeed = EnumParam(
+        ["host", "device"],
+        "'host' streams minibatches through a prefetch thread; 'device' "
+        "places the whole (padded) dataset in HBM once and shuffles on "
+        "device per epoch, so the steady-state step consumes only a "
+        "scalar index from the host — the MXU-bound mode for datasets "
+        "that fit in HBM (single-process, in-memory tables only)",
+        default="host")
     profileDir = StringParam(
         "emit a jax.profiler xplane trace of the training loop here "
         "('' = off; SURVEY §5 profiler upgrade)", default="")
@@ -289,6 +331,13 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                 n = n_min
         else:
             local_batch = batch_size
+        device_feed = self.get("dataFeed") == "device"
+        if device_feed and (streaming or proc_count > 1):
+            raise ValueError(
+                "dataFeed='device' needs the whole dataset resident in "
+                "this process's HBM: pass an in-memory DataTable and run "
+                "single-process (use dataFeed='host' for streaming or "
+                "multi-host training)")
         steps_per_epoch = max(1, (n + local_batch - 1) // local_batch)
         total_steps = steps_per_epoch * self.get("epochs")
 
@@ -500,7 +549,14 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             keep = 0 if final else 1
             while len(pending) > keep:
                 step_, epoch_, dev_loss, t = pending.pop(0)
-                lv = float(dev_loss)
+                if isinstance(dev_loss, tuple):
+                    # device-feed chunks log (loss_vector, index); resolve
+                    # via a plain transfer — indexing with jnp would
+                    # compile an eager gather mid-loop
+                    arr, j = dev_loss
+                    lv = float(np.asarray(arr)[j])
+                else:
+                    lv = float(dev_loss)
                 self.history.append({"step": step_, "loss": lv,
                                      "epoch": epoch_, "time": t})
                 logger.info("step %d/%d loss %.4f", step_, total_steps, lv)
@@ -509,51 +565,267 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
 
         global_step = start_step
         t_first = None
+        t_loop_start = _time.time()
+        first_timed_step = start_step
         examples_timed = 0   # true (unpadded) rows after the warmup step
+        flops_per_step: Optional[float] = None
         # CPU backend: async dispatch racing ahead starves XLA's
         # in-process collective rendezvous on small hosts (7/8 devices
         # join, the 8th's thunk never gets a pool thread -> fatal
         # timeout). Serialize steps there; TPU keeps async dispatch.
         sync_each_step = jax.default_backend() == "cpu"
-        feed = make_prefetcher(index_stream(), make_batch, depth=2)
-        try:
-            with maybe_trace(self.get("profileDir")):
-                for epoch, global_step, true_len, batch in feed:
-                    state, loss = jit_step(state, batch)
-                    if sync_each_step:
-                        loss.block_until_ready()
-                    if t_first is None:
-                        # block on the compile+first step so steady-state
-                        # timing starts after warmup
-                        loss.block_until_ready()
-                        t_first = _time.time()
-                        first_timed_step = global_step
-                    else:
-                        examples_timed += true_len
-                    if global_step % log_every == 0 or \
-                            global_step == total_steps:
+
+        def step_bookkeeping(loss, true_rows, epoch):
+            """Per-step timing/logging/checkpoint shared by both feed
+            modes (reads global_step/state from the enclosing scope)."""
+            nonlocal t_first, first_timed_step, examples_timed
+            if sync_each_step:
+                loss.block_until_ready()
+            if t_first is None:
+                # sync the compile+first step via value transfer (the
+                # tunnel backend's readiness can run ahead of execution)
+                float(loss)
+                t_first = _time.time()
+                first_timed_step = global_step
+            else:
+                examples_timed += true_rows
+            if global_step % log_every == 0 or global_step == total_steps:
+                pending.append((global_step, epoch, loss, _time.time()))
+                flush_logs()
+            if ckpt_dir and global_step % ckpt_every == 0:
+                _save_checkpoint(ckpt_dir, global_step, state)
+
+        if device_feed:
+            # Pad once to full batches; per-epoch shuffle happens ON
+            # DEVICE: a host permutation (4 bytes/row) gathers the padded
+            # dataset into an (steps, batch, ...) epoch tensor, and each
+            # step then reads only a scalar batch index from the host —
+            # the steady state is chip-bound, not feed-bound.
+            n_pad = steps_per_epoch * local_batch
+            pad = n_pad - n
+            if pad:
+                x_p = np.concatenate(
+                    [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                y_p = np.concatenate(
+                    [y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+            else:
+                x_p, y_p = x, y
+            w_p = (np.arange(n_pad) < n).astype(np.float32)
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+                hbm_limit = stats.get("bytes_limit")
+            except Exception:
+                hbm_limit = None
+            # resident twice: the row-major copy + the epoch tensor. Only
+            # the data axis shards the rows — other mesh axes replicate
+            # them, so per-chip residency divides by the data size alone.
+            want = 2 * (x_p.nbytes + y_p.nbytes + w_p.nbytes)
+            per_chip = want / mesh.shape.get(mesh_lib.DATA_AXIS, 1)
+            if hbm_limit and per_chip > 0.6 * hbm_limit:
+                logger.warning(
+                    "dataFeed='device' will hold ~%.1f GB per chip in HBM "
+                    "(limit %.1f GB/chip); consider dataFeed='host'",
+                    per_chip / 2**30, hbm_limit / 2**30)
+            repl = NamedSharding(mesh, P())
+
+            def _row_sh(nd):
+                return NamedSharding(mesh, P(*((mesh_lib.DATA_AXIS,)
+                                               + (None,) * (nd - 1))))
+
+            x_dev = jax.device_put(x_p, _row_sh(x_p.ndim))
+            y_dev = jax.device_put(y_p, _row_sh(y_p.ndim))
+            w_dev = jax.device_put(w_p, _row_sh(1))
+            row_shardings = (_row_sh(x_p.ndim), _row_sh(y_p.ndim),
+                             _row_sh(1))
+            base_key = jax.random.PRNGKey(self.get("seed") + 17)
+
+            def run_chunk(st, xf, yf, wf, epoch_s, start, length):
+                """``length`` consecutive steps as ONE device program:
+                the epoch permutation is derived on device from the epoch
+                index (fold_in — deterministic, so resume replays it),
+                the shuffled epoch tensors never exist on the host, and
+                the scan body reads one batch per step. The host
+                dispatches once per chunk with two scalars; nothing else
+                crosses the tunnel, so tiny step times can't become
+                host-dispatch-bound (one-time eager-op compiles cost
+                ~0.7 s each through the remote backend — the loop must
+                not contain any)."""
+                perm = jax.random.permutation(
+                    jax.random.fold_in(base_key, epoch_s), n_pad)
+                # gather ONLY this chunk's rows (checkpoint-segmented
+                # chunks would otherwise re-gather the full epoch tensor
+                # once per segment)
+                sel = jax.lax.dynamic_slice_in_dim(
+                    perm, start * local_batch, length * local_batch)
+
+                def g(a):
+                    return a[sel].reshape(
+                        (length, local_batch) + a.shape[1:])
+                xs, ys, ws = g(xf), g(yf), g(wf)
+
+                def body(carry, b):
+                    batch = {"x": xs[b], "y": ys[b], "w": ws[b]}
+                    return train_step(carry, batch)
+                st, losses = jax.lax.scan(
+                    body, st, jnp.arange(length))
+                # true (unpadded) rows this chunk — padding carries w=0
+                cnt = (ws > 0).sum()
+                return st, losses, cnt
+
+            chunk_fns: Dict[int, Any] = {}   # scan length -> jitted fn
+
+            def get_chunk_fn(length):
+                if length not in chunk_fns:
+                    def f(st, xf, yf, wf, e, s0, _len=length):
+                        return run_chunk(st, xf, yf, wf, e, s0, _len)
+                    chunk_fns[length] = jax.jit(
+                        f,
+                        in_shardings=(state_sharding,) + row_shardings
+                        + (None, None),
+                        out_shardings=(state_sharding, None, None),
+                        donate_argnums=(0,))
+                return chunk_fns[length]
+
+            # (device count scalar, counted-in-steady-state?) per chunk;
+            # resolved after the clock stops
+            chunk_counts: List[Tuple[Any, bool]] = []
+
+            def chunk_bookkeeping(losses, cnt, length, epoch):
+                """Chunk analog of step_bookkeeping. All values stay on
+                device; the only host interaction is np.asarray transfers
+                (never eager jnp ops, which would compile mid-loop)."""
+                nonlocal t_first, first_timed_step
+                if sync_each_step or t_first is None:
+                    # sync via VALUE TRANSFER, not block_until_ready: the
+                    # experimental tunnel backend has been observed to
+                    # report readiness before remote execution completes,
+                    # but the loss bytes cannot arrive early
+                    np.asarray(losses)
+                chunk_counts.append((cnt, t_first is not None))
+                if t_first is None:
+                    # timing starts after the compile+first chunk
+                    t_first = _time.time()
+                    first_timed_step = global_step
+                base = global_step - length
+                for j in range(length):
+                    gs = base + j + 1
+                    if gs % log_every == 0 or gs == total_steps:
                         pending.append(
-                            (global_step, epoch, loss, _time.time()))
-                        flush_logs()
-                    if ckpt_dir and global_step % ckpt_every == 0:
-                        _save_checkpoint(ckpt_dir, global_step, state)
-        finally:
-            # abnormal exit must not leave the worker blocked in put()
-            # pinning prefetched batches in HBM
-            feed.close()
+                            (gs, epoch, (losses, j), _time.time()))
+                flush_logs()
+                if ckpt_dir and global_step % ckpt_every == 0:
+                    _save_checkpoint(ckpt_dir, global_step, state)
+
+            with maybe_trace(self.get("profileDir")):
+                for epoch in range(epochs):
+                    if (epoch + 1) * steps_per_epoch <= start_step:
+                        global_step = (epoch + 1) * steps_per_epoch
+                        continue
+                    base = epoch * steps_per_epoch
+                    i = max(0, start_step - base)   # resume mid-epoch
+                    while i < steps_per_epoch:
+                        seg_end = steps_per_epoch
+                        if ckpt_dir:
+                            # segment at checkpoint boundaries so saves
+                            # land exactly every checkpointEvery steps
+                            cur = base + i
+                            nxt = (cur // ckpt_every + 1) * ckpt_every
+                            seg_end = min(seg_end, nxt - base)
+                        length = seg_end - i
+                        fn = get_chunk_fn(length)
+                        if flops_per_step is None:
+                            # cost-analyze ONE bare train_step (XLA's
+                            # analysis counts a scan body once, so
+                            # analyzing the chunk would under-report by
+                            # the scan length); lowered from avals, one
+                            # extra compile before timing starts
+                            batch_sds = {
+                                "x": jax.ShapeDtypeStruct(
+                                    (local_batch,) + x_p.shape[1:],
+                                    x_p.dtype),
+                                "y": jax.ShapeDtypeStruct(
+                                    (local_batch,) + y_p.shape[1:],
+                                    y_p.dtype),
+                                "w": jax.ShapeDtypeStruct(
+                                    (local_batch,), jnp.float32),
+                            }
+                            probe = jax.jit(
+                                train_step,
+                                in_shardings=(state_sharding,
+                                              data_sharding),
+                                out_shardings=(state_sharding, None))
+                            flops_per_step = _step_flops(
+                                probe.lower(state, batch_sds).compile())
+                            flops_per_step = flops_per_step or -1.0
+                        state, losses, cnt = fn(
+                            state, x_dev, y_dev, w_dev,
+                            np.int32(epoch), np.int32(i))
+                        global_step = base + seg_end
+                        chunk_bookkeeping(losses, cnt, length, epoch)
+                        i = seg_end
+        else:
+            feed = make_prefetcher(index_stream(), make_batch, depth=2)
+            try:
+                with maybe_trace(self.get("profileDir")):
+                    for epoch, global_step, true_len, batch in feed:
+                        state, loss = jit_step(state, batch)
+                        step_bookkeeping(loss, true_len, epoch)
+            finally:
+                # abnormal exit must not leave the worker blocked in put()
+                # pinning prefetched batches in HBM
+                feed.close()
         state = jax.block_until_ready(state)
+        # belt-and-braces completion barrier: fetch a real VALUE from the
+        # final state (see chunk_bookkeeping — the tunnel backend's
+        # readiness signal has been observed to run ahead of execution;
+        # transferred bytes cannot)
+        np.asarray(state["step"])
         t_end = _time.time()
+        if device_feed:
+            # resolve the deferred per-chunk row counts (transfers only,
+            # after the clock stops so they can't skew the measurement)
+            examples_timed = proc_count * int(sum(
+                float(np.asarray(c)) for c, timed in chunk_counts
+                if timed))
+            if t_first is not None and global_step == first_timed_step:
+                # single-chunk run: the whole fit was "warmup", so report
+                # the full wall including the first chunk (compile time
+                # excluded is impossible here — flag it)
+                examples_timed = proc_count * int(sum(
+                    float(np.asarray(c)) for c, _ in chunk_counts))
+                first_timed_step = start_step
+                t_first = t_loop_start
+                self_timing_includes_compile = True
+            else:
+                self_timing_includes_compile = False
+        else:
+            self_timing_includes_compile = False
         flush_logs(final=True)
         steps_timed = global_step - (first_timed_step if t_first else 0)
         if t_first is not None and steps_timed > 0:
+            wall = t_end - t_first
             self.timing = {
                 "steps_timed": steps_timed,
-                "wall_s": t_end - t_first,
+                "wall_s": wall,
                 # true rows only — padding of partial batches is masked
                 # compute, counting it would inflate the metric
-                "examples_per_sec":
-                    examples_timed / max(t_end - t_first, 1e-9),
+                "examples_per_sec": examples_timed / max(wall, 1e-9),
             }
+            if self_timing_includes_compile:
+                self.timing["includes_compile"] = True
+            if flops_per_step and flops_per_step > 0:
+                # XLA cost analysis reports the PER-DEVICE cost of the
+                # SPMD-partitioned module (verified empirically on a
+                # data-sharded matmul), so per-chip rates need no further
+                # division by chip count
+                tflops = flops_per_step * steps_timed / max(wall, 1e-9) / 1e12
+                self.timing["flops_per_step_per_chip"] = flops_per_step
+                self.timing["model_flops_per_step"] = (
+                    flops_per_step * int(mesh.devices.size))
+                self.timing["tflops_per_sec_per_chip"] = tflops
+                peak = peak_flops_per_chip(jax.devices()[0].device_kind)
+                if peak:
+                    self.timing["mfu"] = tflops * 1e12 / peak
         if ckpt_dir:
             _save_checkpoint(ckpt_dir, global_step, state)
 

@@ -55,6 +55,47 @@ def _fit_logistic(X, y, lr, l2, n_steps: int, num_class: int):
     return params
 
 
+@partial(jax.jit, static_argnames=("n_steps", "num_class", "d"))
+def _fit_logistic_sparse(idx, val, y, lr, l2, n_steps: int,
+                         num_class: int, d: int):
+    """Sparse logistic regression: features arrive as padded (N, max_nnz)
+    ``idx``/``val`` gather batches (CSRMatrix.padded_batch) and the
+    matmul is W[idx] * val — embedding-style, so a 262144-wide hashed
+    text matrix (ref: Featurize.scala:13-19) trains without a dense
+    (N, D) activation ever existing. Autodiff turns the gather into the
+    scatter-add gradient automatically. Padding entries (idx 0, val 0)
+    contribute nothing."""
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+    zero = {"W": jnp.zeros((d, num_class)), "b": jnp.zeros(num_class)}
+
+    def loss_fn(p):
+        rows = p["W"][idx]                                  # (N, m, K)
+        logits = jnp.einsum("nm,nmk->nk", val, rows) + p["b"]
+        logp = jax.nn.log_softmax(logits)
+        return (-jnp.mean(jnp.sum(onehot * logp, axis=1))
+                + l2 * jnp.sum(p["W"] ** 2))
+
+    def body(i, carry):
+        params, vel = carry
+        g = jax.grad(loss_fn)(params)
+        vel = jax.tree_util.tree_map(lambda vv, gg: 0.9 * vv - lr * gg,
+                                     vel, g)
+        params = jax.tree_util.tree_map(lambda p, vv: p + vv, params, vel)
+        return params, vel
+
+    params, _ = lax.fori_loop(0, n_steps, body, (zero, dict(zero)))
+    return params
+
+
+def _sparse_logits(csr, W: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Host-side CSR @ W + b without densifying (inference path)."""
+    n = csr.shape[0]
+    rows = np.repeat(np.arange(n), np.diff(csr.indptr).astype(np.int64))
+    logits = np.zeros((n, W.shape[1]), np.float64)
+    np.add.at(logits, rows, W[csr.indices] * csr.data[:, None])
+    return logits + b
+
+
 @partial(jax.jit, static_argnames=("n_steps",))
 def _fit_linear(X, y, lr, l2, n_steps: int):
     n, d = X.shape
@@ -96,21 +137,36 @@ class TPULogisticRegression(Estimator, HasFeaturesCol, HasLabelCol,
     stepSize = FloatParam("learning rate", default=0.5)
 
     def fit(self, table: DataTable) -> "TPULogisticRegressionModel":
-        X = _features_matrix(table, self.get_features_col())
+        from mmlspark_tpu.core.sparse import CSRMatrix
         y = np.asarray(table[self.get_label_col()], dtype=np.float64)
         num_class = int(y.max()) + 1 if len(y) else 2
         num_class = max(num_class, 2)
-        mu, sd = _Standardizer.compute(X)
-        Xs = (X - mu) / sd
-        params = _fit_logistic(
-            jnp.asarray(Xs, jnp.float32), jnp.asarray(y, jnp.float32),
-            self.get("stepSize"), self.get("regParam"),
-            self.get("maxIter"), num_class)
-        model = TPULogisticRegressionModel(
-            weights={"W": np.asarray(params["W"]),
-                     "b": np.asarray(params["b"]),
-                     "mu": mu, "sd": sd},
-            )
+        feats = table.column(self.get_features_col())
+        if isinstance(feats, CSRMatrix):
+            # sparse path: no standardization (it would densify — the
+            # reference's hashed-text pipeline does the same), gather
+            # batches instead of a dense matrix
+            max_nnz = max(1, feats.max_row_nnz())
+            idx, val, _ = feats.padded_batch(0, len(feats), max_nnz)
+            params = _fit_logistic_sparse(
+                jnp.asarray(idx), jnp.asarray(val),
+                jnp.asarray(y, jnp.float32),
+                self.get("stepSize"), self.get("regParam"),
+                self.get("maxIter"), num_class, feats.shape[1])
+            weights = {"W": np.asarray(params["W"]),
+                       "b": np.asarray(params["b"])}
+        else:
+            X = _features_matrix(table, self.get_features_col())
+            mu, sd = _Standardizer.compute(X)
+            Xs = (X - mu) / sd
+            params = _fit_logistic(
+                jnp.asarray(Xs, jnp.float32), jnp.asarray(y, jnp.float32),
+                self.get("stepSize"), self.get("regParam"),
+                self.get("maxIter"), num_class)
+            weights = {"W": np.asarray(params["W"]),
+                       "b": np.asarray(params["b"]),
+                       "mu": mu, "sd": sd}
+        model = TPULogisticRegressionModel(weights=weights)
         model.set("featuresCol", self.get_features_col())
         model.set("predictionCol", self.get_prediction_col())
         return model
@@ -120,10 +176,17 @@ class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("W/b/mu/sd arrays", default=None)
 
     def transform(self, table: DataTable) -> DataTable:
+        from mmlspark_tpu.core.sparse import CSRMatrix
         w = self.get("weights")
-        X = _features_matrix(table, self.get_features_col())
-        Xs = (X - w["mu"]) / w["sd"]
-        logits = Xs @ w["W"] + w["b"]
+        feats = table.column(self.get_features_col())
+        if isinstance(feats, CSRMatrix) and "mu" not in w:
+            logits = _sparse_logits(feats, np.asarray(w["W"]),
+                                    np.asarray(w["b"]))
+        else:
+            X = _features_matrix(table, self.get_features_col())
+            if "mu" in w:
+                X = (X - w["mu"]) / w["sd"]
+            logits = X @ w["W"] + w["b"]
         e = np.exp(logits - logits.max(axis=1, keepdims=True))
         prob = e / e.sum(axis=1, keepdims=True)
         pred = prob.argmax(axis=1).astype(np.float64)

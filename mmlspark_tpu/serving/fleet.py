@@ -16,6 +16,7 @@ range of a table, funneling work to exactly one consumer per host.
 
 from __future__ import annotations
 
+import itertools
 import json
 import urllib.request
 from typing import Any, Dict, List
@@ -57,7 +58,9 @@ class ServingFleet:
             # partial construction must not leak threads/bound ports
             self.stop_all()
             raise
-        self._next = 0
+        # itertools.count: next() is atomic under the GIL, so
+        # concurrent client threads can't tear the round-robin
+        self._next = itertools.count()
         log.info("fleet of %d engines: %s", n_engines, self.addresses)
 
     @property
@@ -67,8 +70,7 @@ class ServingFleet:
     def post(self, payload: Any, timeout: float = 30.0) -> Dict[str, Any]:
         """Round-robin client — the stand-in for an external load
         balancer in tests/examples."""
-        addr = self.addresses[self._next % len(self.engines)]
-        self._next += 1
+        addr = self.addresses[next(self._next) % len(self.engines)]
         body = payload if isinstance(payload, bytes) \
             else json.dumps(payload).encode()
         req = urllib.request.Request(

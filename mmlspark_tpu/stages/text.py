@@ -102,33 +102,53 @@ class NGram(Transformer, HasInputCol, HasOutputCol):
 
 class HashingTF(Transformer, HasInputCol, HasOutputCol):
     """Feature hashing to a fixed-width count vector
-    (ref: TextFeaturizer numFeatures default 262144 / 2^18; lowered here
-    to 2^12 because this build materializes dense float32 rows — 2^18
-    dense is ~1 MB/row, an OOM footgun the reference's sparse vectors
-    never hit. Set numFeatures explicitly for reference-width hashing)."""
+    (ref: TextFeaturizer numFeatures default 262144 / 2^18; the dense
+    default here is 2^12 because a dense 2^18 row is ~1 MB — but set
+    ``sparse=True`` for the reference's native behavior: CSR output at
+    any width with no dense materialization, the analog of the
+    reference's SparseVector output, Featurize.scala:13-19)."""
 
     numFeatures = IntParam("hash space size", default=1 << 12)
     binary = BoolParam("presence instead of counts", default=False)
+    sparse = BoolParam("emit a CSR sparse column instead of dense rows",
+                       default=False)
 
     def transform(self, table: DataTable) -> DataTable:
         m = self.get("numFeatures")
         binary = self.get("binary")
+        out_col = self.get_output_col()
+        if self.get("sparse"):
+            from mmlspark_tpu.core.sparse import CSRMatrix
+            csr = CSRMatrix.from_rows(
+                (_hash_counts(toks, m, binary)
+                 for toks in table[self.get_input_col()]),
+                num_cols=m)
+            return table.with_column(
+                out_col, csr, Field(out_col, VECTOR, {"sparse": True}))
         rows = []
         for toks in table[self.get_input_col()]:
             v = np.zeros(m, dtype=np.float32)
-            for t in toks:
-                idx = _stable_hash(t) % m
-                if binary:
-                    v[idx] = 1.0
-                else:
-                    v[idx] += 1.0
+            for idx, cnt in _hash_counts(toks, m, binary).items():
+                v[idx] = cnt
             rows.append(v)
         arr = np.stack(rows) if rows else np.zeros((0, m), np.float32)
-        return table.with_column(self.get_output_col(), arr,
-                                 Field(self.get_output_col(), VECTOR))
+        return table.with_column(out_col, arr, Field(out_col, VECTOR))
 
     def transform_schema(self, schema: Schema) -> Schema:
-        return schema.add_or_replace(Field(self.get_output_col(), VECTOR))
+        meta = {"sparse": True} if self.get("sparse") else {}
+        return schema.add_or_replace(
+            Field(self.get_output_col(), VECTOR, meta))
+
+
+def _hash_counts(toks, m: int, binary: bool) -> dict:
+    out: dict = {}
+    for t in toks or []:
+        idx = _stable_hash(str(t)) % m
+        if binary:
+            out[idx] = 1.0
+        else:
+            out[idx] = out.get(idx, 0.0) + 1.0
+    return out
 
 
 def _stable_hash(s: str) -> int:

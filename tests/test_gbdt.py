@@ -569,3 +569,56 @@ class TestLargeBinCounts:
                    "max_bin": 4095, "hist_method": "pallas"}, X, y)
         assert b.params["hist_method"] == "onehot"
         assert np.isfinite(b.predict(X)).all()
+
+
+class TestFeatureParallel:
+    """tree_learner='feature': feature-axis sharding, all_gather'd split
+    candidates, owner-broadcast row partitions
+    (ref: TrainParams.scala:26 tree_learner=feature)."""
+
+    def test_fp_identical_to_serial(self, cpu_mesh_devices):
+        rng = np.random.default_rng(0)
+        n, f = 2000, 37          # F not divisible by 8 -> exercises padding
+        X = rng.normal(size=(n, f))
+        y = (X[:, 0] * 2 + X[:, 1] * X[:, 2] > 0).astype(float)
+        mesh = mesh_lib.make_mesh()
+        kw = {"objective": "binary", "num_iterations": 6,
+              "num_leaves": 15, "max_bin": 31, "min_data_in_leaf": 5}
+        bs = train(kw, X, y)
+        bf = train({**kw, "parallelism": "feature"}, X, y, mesh=mesh)
+        # rows are replicated, decisions exchanged exactly -> identical
+        for k in ("feature", "bin_threshold", "left", "right"):
+            np.testing.assert_array_equal(bs.trees[k], bf.trees[k])
+        np.testing.assert_allclose(bs.predict(X), bf.predict(X),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fp_with_sampling_and_esr(self, cpu_mesh_devices):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(1200, 24))
+        y = X[:, 0] * 3 + np.sin(X[:, 1]) + rng.normal(
+            scale=0.1, size=1200)
+        mesh = mesh_lib.make_mesh()
+        b = train({"objective": "regression", "num_iterations": 30,
+                   "num_leaves": 15, "parallelism": "feature",
+                   "feature_fraction": 0.7, "bagging_fraction": 0.8,
+                   "bagging_freq": 1, "early_stopping_round": 5},
+                  X[:1000], y[:1000], mesh=mesh,
+                  valid=(X[1000:], y[1000:]))
+        pred = b.predict(X[1000:])
+        ss_res = np.sum((pred - y[1000:]) ** 2)
+        ss_tot = np.sum((y[1000:] - y[1000:].mean()) ** 2)
+        assert 1 - ss_res / ss_tot > 0.8
+
+    def test_fp_estimator_stage(self, cpu_mesh_devices):
+        from mmlspark_tpu.gbdt.estimators import TPUBoostClassifier
+        from mmlspark_tpu.core.table import DataTable
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(600, 12))
+        y = (X[:, 0] + X[:, 3] > 0).astype(np.int64)
+        t = DataTable({"features": X.astype(np.float32), "label": y})
+        clf = TPUBoostClassifier(numIterations=8, numLeaves=15,
+                                 parallelism="feature", labelCol="label")
+        model = clf.fit(t)
+        out = model.transform(t)
+        acc = np.mean(np.asarray(out["prediction"]) == y)
+        assert acc > 0.9

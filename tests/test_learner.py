@@ -199,3 +199,54 @@ def test_bilstm_tagger_smoke():
     assert scores.shape == (n, T, K)
     acc = float(np.mean(np.argmax(scores, -1) == tags))
     assert acc > 0.8, f"token accuracy {acc}"
+
+
+def test_device_feed_matches_host_quality():
+    t = _toy_table(seed=6)
+    common = dict(
+        networkSpec={"type": "mlp", "features": [32], "num_classes": 4},
+        epochs=8, batchSize=64, learningRate=0.05,
+        computeDtype="float32", logEvery=1000)
+    learner = TPULearner(**common, dataFeed="device")
+    learner.set_mesh(mesh_lib.make_mesh({"data": 8}))
+    model = learner.fit(t)
+    assert _accuracy(model, t) > 0.9
+    # device feed reports XLA cost-analysis FLOPs for MFU auditing
+    assert learner.timing.get("model_flops_per_step", 0) > 0
+    assert "tflops_per_sec_per_chip" in learner.timing
+
+
+def test_device_feed_checkpoint_resume(tmp_path):
+    t = _toy_table(seed=7)
+    ck = str(tmp_path / "ckpt")
+    common = dict(
+        networkSpec={"type": "mlp", "features": [16], "num_classes": 4},
+        epochs=4, batchSize=64, learningRate=0.05, computeDtype="float32",
+        schedule="constant", dataFeed="device",
+        checkpointDir=ck, checkpointEvery=4, logEvery=1000, seed=9)
+    full = TPULearner(**common).fit(t)
+
+    import shutil
+    shutil.rmtree(ck)
+    TPULearner(**{**common, "epochs": 2}).fit(t)
+    resumed = TPULearner(**common).fit(t)
+
+    f = np.asarray(full.transform(t)["scores"])
+    r = np.asarray(resumed.transform(t)["scores"])
+    np.testing.assert_allclose(f, r, rtol=1e-3, atol=1e-3)
+
+
+def test_device_feed_rejects_streaming_and_remainder_is_masked():
+    t = _toy_table(n=100, seed=8)  # 100 rows, batch 64 -> padded batch
+    learner = TPULearner(
+        networkSpec={"type": "mlp", "features": [16], "num_classes": 4},
+        epochs=6, batchSize=64, learningRate=0.05, computeDtype="float32",
+        logEvery=1000, dataFeed="device")
+    model = learner.fit(t)
+    assert _accuracy(model, t) > 0.8
+    shards = [t.slice(0, 50), t.slice(50, 100)]
+    bad = TPULearner(
+        networkSpec={"type": "mlp", "features": [16], "num_classes": 4},
+        epochs=1, batchSize=64, dataFeed="device")
+    with pytest.raises(ValueError, match="device"):
+        bad.fit(shards)

@@ -1,0 +1,198 @@
+"""Sparse feature path: CSRMatrix columns, sparse HashingTF/Featurize at
+the reference's 262,144 hash width, sparse logistic regression, and GBDT
+binning straight from CSR (ref: Featurize.scala:13-19 — 262144 sparse
+hashed features; LightGBMUtils.scala:283-351 — CSR dataset creation)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.sparse import CSRMatrix, hstack, vstack
+from mmlspark_tpu.core.table import DataTable, features_matrix
+
+
+def _rand_csr(n, d, density=0.05, seed=0):
+    rng = np.random.default_rng(seed)
+    dense = np.where(rng.random((n, d)) < density,
+                     rng.normal(size=(n, d)), 0.0).astype(np.float32)
+    return dense, CSRMatrix.from_dense(dense)
+
+
+class TestCSRMatrix:
+    def test_dense_roundtrip(self):
+        dense, csr = _rand_csr(40, 17)
+        np.testing.assert_array_equal(csr.toarray(), dense)
+        assert csr.nnz == np.count_nonzero(dense)
+
+    def test_row_access_and_slice(self):
+        dense, csr = _rand_csr(30, 9, seed=1)
+        np.testing.assert_array_equal(csr[7], dense[7])
+        np.testing.assert_array_equal(csr[5:20].toarray(), dense[5:20])
+        idx = np.asarray([3, 28, 1, 1])
+        np.testing.assert_array_equal(csr.take(idx).toarray(), dense[idx])
+
+    def test_csc_view(self):
+        dense, csr = _rand_csr(25, 6, seed=2)
+        col_ptr, rows, vals = csr.csc()
+        for j in range(6):
+            got = np.zeros(25, np.float32)
+            got[rows[col_ptr[j]:col_ptr[j + 1]]] = \
+                vals[col_ptr[j]:col_ptr[j + 1]]
+            np.testing.assert_array_equal(got, dense[:, j])
+
+    def test_hstack_mixed(self):
+        dense, csr = _rand_csr(12, 5, seed=3)
+        extra = np.arange(12.0, dtype=np.float32)
+        out = hstack([csr, extra, dense])
+        np.testing.assert_array_equal(
+            out.toarray(),
+            np.concatenate([dense, extra[:, None], dense], axis=1))
+
+    def test_vstack(self):
+        d1, c1 = _rand_csr(8, 4, seed=4)
+        d2, c2 = _rand_csr(5, 4, seed=5)
+        np.testing.assert_array_equal(
+            vstack([c1, c2]).toarray(), np.concatenate([d1, d2]))
+
+    def test_padded_batch(self):
+        dense, csr = _rand_csr(10, 8, density=0.3, seed=6)
+        m = csr.max_row_nnz()
+        idx, val, lens = csr.padded_batch(2, 7, m)
+        for i in range(5):
+            row = np.zeros(8, np.float32)
+            np.add.at(row, idx[i, :lens[i]], val[i, :lens[i]])
+            np.testing.assert_array_equal(row, dense[2 + i])
+
+
+class TestSparseTable:
+    def test_column_integration(self):
+        dense, csr = _rand_csr(20, 6, seed=7)
+        t = DataTable({"features": csr, "label": np.arange(20)})
+        assert len(t) == 20
+        assert t.schema["features"].meta.get("sparse") is True
+        np.testing.assert_array_equal(t.row(3)["features"], dense[3])
+        s = t.slice(5, 15)
+        np.testing.assert_array_equal(s["features"].toarray(), dense[5:15])
+        np.testing.assert_array_equal(
+            features_matrix(t, "features"), dense.astype(np.float64))
+
+    def test_concat_and_save_load(self, tmp_path):
+        d1, c1 = _rand_csr(8, 4, seed=8)
+        d2, c2 = _rand_csr(6, 4, seed=9)
+        t = DataTable.concat([DataTable({"f": c1}), DataTable({"f": c2})])
+        assert isinstance(t["f"], CSRMatrix)
+        np.testing.assert_array_equal(
+            t["f"].toarray(), np.concatenate([d1, d2]))
+        p = str(tmp_path / "t")
+        t.save(p)
+        t2 = DataTable.load(p)
+        assert isinstance(t2["f"], CSRMatrix)
+        np.testing.assert_array_equal(t2["f"].toarray(),
+                                      np.concatenate([d1, d2]))
+
+
+def _token_table(n=400, seed=0):
+    """Two-class token docs where class-specific words decide labels."""
+    rng = np.random.default_rng(seed)
+    vocab_a = [f"apple{i}" for i in range(50)]
+    vocab_b = [f"bird{i}" for i in range(50)]
+    common = [f"the{i}" for i in range(30)]
+    docs, labels = [], []
+    for i in range(n):
+        y = int(rng.random() < 0.5)
+        pool = vocab_a if y else vocab_b
+        docs.append(list(rng.choice(pool, size=8))
+                    + list(rng.choice(common, size=4)))
+        labels.append(y)
+    return DataTable({"tokens": docs, "label": np.asarray(labels)})
+
+
+class TestSparseTextPipeline:
+    def test_hashing_tf_sparse_matches_dense(self):
+        from mmlspark_tpu.stages.text import HashingTF
+        t = _token_table(50)
+        dense = HashingTF(inputCol="tokens", outputCol="tf",
+                          numFeatures=1 << 10).transform(t)
+        sparse = HashingTF(inputCol="tokens", outputCol="tf",
+                           numFeatures=1 << 10, sparse=True).transform(t)
+        assert isinstance(sparse["tf"], CSRMatrix)
+        np.testing.assert_array_equal(sparse["tf"].toarray(), dense["tf"])
+
+    def test_featurize_reference_width_never_densifies(self):
+        """The VERDICT 'done' criterion: Featurize at 262,144 sparse hash
+        width trains a text classifier with no dense (N, D) matrix."""
+        from mmlspark_tpu.automl.featurize import Featurize
+        from mmlspark_tpu.models.linear import TPULogisticRegression
+
+        t = _token_table(400)
+        model = Featurize(featureColumns=["tokens"],
+                          sparse=True).fit(t)
+        ft = model.transform(t)
+        feats = ft["features"]
+        assert isinstance(feats, CSRMatrix)
+        assert feats.shape[1] == 1 << 18     # reference default width
+        # dense would be 400 * 262144 * 4 = 420 MB; CSR is tiny
+        assert feats.nnz < 400 * 16
+
+        clf = TPULogisticRegression(labelCol="label", maxIter=150)
+        fitted = clf.fit(ft)
+        out = fitted.transform(ft)
+        acc = np.mean(np.asarray(out["prediction"])
+                      == np.asarray(t["label"]))
+        assert acc > 0.97, acc
+
+    def test_sparse_logreg_holdout(self):
+        from mmlspark_tpu.automl.featurize import Featurize
+        from mmlspark_tpu.models.linear import TPULogisticRegression
+        tr, te = _token_table(500, seed=1), _token_table(200, seed=2)
+        fm = Featurize(featureColumns=["tokens"], sparse=True,
+                       numberOfFeatures=1 << 14).fit(tr)
+        clf = TPULogisticRegression(labelCol="label", maxIter=150)
+        fitted = clf.fit(fm.transform(tr))
+        out = fitted.transform(fm.transform(te))
+        acc = np.mean(np.asarray(out["prediction"])
+                      == np.asarray(te["label"]))
+        assert acc > 0.95, acc
+
+
+class TestSparseGBDT:
+    def test_csr_train_matches_dense(self):
+        from mmlspark_tpu.gbdt.booster import train
+        rng = np.random.default_rng(0)
+        dense = np.where(rng.random((1500, 20)) < 0.3,
+                         rng.normal(size=(1500, 20)), 0.0)
+        y = (dense[:, 0] + dense[:, 1] * 2 > 0).astype(float)
+        csr = CSRMatrix.from_dense(dense.astype(np.float32))
+        kw = {"objective": "binary", "num_iterations": 10,
+              "num_leaves": 15, "min_data_in_leaf": 5,
+              "hist_method": "scatter"}
+        b_dense = train(kw, dense, y)
+        b_csr = train(kw, csr, y)
+        pd_ = b_dense.predict(dense)
+        pc = b_csr.predict(csr)
+        # same cuts (sparse fit sees identical value histograms) ->
+        # near-identical models; predictions via CSR chunked path
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, pc) > 0.97
+        assert abs(roc_auc_score(y, pd_) - roc_auc_score(y, pc)) < 0.01
+
+    def test_csr_estimator_stage(self):
+        from mmlspark_tpu.gbdt.estimators import TPUBoostClassifier
+        rng = np.random.default_rng(1)
+        dense = np.where(rng.random((800, 30)) < 0.2,
+                         rng.normal(size=(800, 30)), 0.0)
+        y = (dense[:, 2] - dense[:, 5] > 0).astype(np.int64)
+        t = DataTable({"features": CSRMatrix.from_dense(
+            dense.astype(np.float32)), "label": y})
+        clf = TPUBoostClassifier(numIterations=10, numLeaves=15,
+                                 minDataInLeaf=5, labelCol="label",
+                                 histMethod="scatter")
+        model = clf.fit(t)
+        out = model.transform(t)
+        acc = np.mean(np.asarray(out["prediction"]) == y)
+        assert acc > 0.9
+
+    def test_csr_no_y_clear_error(self):
+        from mmlspark_tpu.gbdt.booster import train
+        _, csr = _rand_csr(10, 3)
+        with pytest.raises(ValueError, match="y is required"):
+            train({"num_iterations": 2}, csr, None)

@@ -1,0 +1,116 @@
+"""Measure the in-image CPU baselines that back bench.py's vs_baseline.
+
+The reference publishes no absolute wall-clock numbers (BASELINE.md), so
+round-2 benches compared against folklore constants. This script replaces
+them with measured-vs-measured comparisons on THIS machine:
+
+1. ``higgs1m_sklearn_hgb_wall_s`` — sklearn HistGradientBoosting on the
+   exact HIGGS-shaped config bench.py times for the GBDT engine
+   (1M x 28, 63 leaves, 63 bins-ish, 40 iterations, min 50 rows/leaf,
+   identical synthetic data seed). sklearn's HGB is the strongest
+   CPU histogram-GBDT available in-image (no lightgbm binary exists here).
+2. ``cifar_convnet_torch_cpu_imgs_per_sec`` — torch (CPU) training
+   throughput of the same notebook-401 ConvNet shape bench.py trains
+   (3x conv64-3x3 + maxpool, dense 256, 10 classes, batch 512).
+
+Results land in BASELINE.json under "measured" with the machine + date;
+bench.py prefers them over the historical constants automatically.
+
+Run: ``python tools/measure_baseline.py`` (takes a few minutes).
+"""
+
+import json
+import os
+import platform
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HIGGS_N, HIGGS_F = 1_000_000, 28
+
+
+def measure_hgb() -> dict:
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(HIGGS_N, HIGGS_F)).astype(np.float32)
+    logit = (X[:, 0] * 1.5 + X[:, 1] * X[:, 2]
+             + 0.5 * np.sin(3 * X[:, 3])
+             + rng.normal(scale=0.5, size=HIGGS_N))
+    y = (logit > 0).astype(np.int64)
+
+    clf = HistGradientBoostingClassifier(
+        max_iter=40, max_leaf_nodes=63, max_bins=63,
+        min_samples_leaf=50, early_stopping=False, random_state=0)
+    t0 = time.time()
+    clf.fit(X, y)
+    wall = time.time() - t0
+    return {"higgs1m_sklearn_hgb_wall_s": round(wall, 1),
+            "higgs1m_sklearn_hgb_config":
+                "HistGradientBoostingClassifier(max_iter=40, "
+                "max_leaf_nodes=63, max_bins=63, min_samples_leaf=50)"}
+
+
+def measure_torch_convnet() -> dict:
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+
+    model = nn.Sequential(
+        nn.Conv2d(3, 64, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Conv2d(64, 64, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Conv2d(64, 64, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2),
+        nn.Flatten(), nn.Linear(64 * 4 * 4, 256), nn.ReLU(),
+        nn.Linear(256, 10))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    loss_fn = nn.CrossEntropyLoss()
+
+    batch = 512
+    x = torch.randn(batch, 3, 32, 32)
+    y = torch.randint(0, 10, (batch,))
+
+    def step():
+        opt.zero_grad()
+        loss_fn(model(x), y).backward()
+        opt.step()
+
+    for _ in range(3):  # warmup
+        step()
+    steps = 20
+    t0 = time.time()
+    for _ in range(steps):
+        step()
+    wall = time.time() - t0
+    return {"cifar_convnet_torch_cpu_imgs_per_sec":
+                round(steps * batch / wall, 1),
+            "cifar_convnet_torch_cpu_config":
+                f"batch {batch}, 3x conv64-3x3+pool, dense 256, "
+                f"SGD momentum, {os.cpu_count()} cores"}
+
+
+def main():
+    measured = {}
+    print("measuring sklearn HistGradientBoosting (1M x 28, 40 iters)...")
+    measured.update(measure_hgb())
+    print(f"  -> {measured['higgs1m_sklearn_hgb_wall_s']} s")
+    print("measuring torch-CPU ConvNet throughput...")
+    measured.update(measure_torch_convnet())
+    print(f"  -> {measured['cifar_convnet_torch_cpu_imgs_per_sec']} imgs/s")
+    measured["machine"] = f"{platform.machine()}, {os.cpu_count()} cores"
+    measured["date"] = time.strftime("%Y-%m-%d")
+
+    path = os.path.join(ROOT, "BASELINE.json")
+    with open(path) as f:
+        doc = json.load(f)
+    doc["measured"] = measured
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(f"wrote measured baselines to {path}")
+
+
+if __name__ == "__main__":
+    main()
