@@ -258,17 +258,24 @@ def save_stage(stage, path: str, overwrite: bool = True) -> None:
 def _numerics_markers(stage) -> Dict[str, str]:
     """Version markers for numerics-affecting architecture changes, so a
     checkpoint trained under older numerics fails loudly on load instead
-    of silently degrading (e.g. the ResNet stride-2 padding change —
-    see models/networks.py ResNetBlock)."""
+    of silently degrading. Generic hook: any stage, param value, or
+    wrapped flax module may expose ``numerics_markers() -> dict`` (see
+    models/networks.py ResNet for the stride-2 padding example); the
+    serializer aggregates them without knowing any model class."""
     markers: Dict[str, str] = {}
-    try:
-        from mmlspark_tpu.models.networks import ResNet
-        for value in stage._paramMap.values():
-            module = getattr(value, "module", value)
-            if isinstance(module, ResNet):
-                markers["resnet_padding"] = "explicit11-torch-compat"
-    except Exception:
-        pass
+
+    def collect(obj) -> None:
+        hook = getattr(obj, "numerics_markers", None)
+        if callable(hook):
+            try:
+                markers.update(hook())
+            except Exception:
+                pass
+
+    collect(stage)
+    for value in stage._paramMap.values():
+        collect(value)
+        collect(getattr(value, "module", None))
     return markers
 
 
@@ -312,9 +319,9 @@ def load_stage(path: str):
             msg = (
                 f"stage {cls_name} was saved before the {key!r} numerics "
                 f"change (saved marker {saved.get(key)!r}, current "
-                f"{current!r}): a ResNet checkpoint trained under the old "
-                f"stride-2 padding will produce shifted activations — "
-                f"retrain or re-import it (models/networks.py ResNetBlock)")
+                f"{current!r}): weights trained under the old numerics "
+                f"will produce degraded outputs — retrain or re-import "
+                f"the checkpoint")
             warnings.warn(msg, stacklevel=2)
             logging.getLogger("mmlspark_tpu.serialize").error(msg)
     return stage

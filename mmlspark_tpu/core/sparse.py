@@ -179,39 +179,21 @@ class CSRMatrix:
         b = stop - start
         idx = np.zeros((b, max_nnz), np.int32)
         val = np.zeros((b, max_nnz), np.float32)
-        lens = np.zeros(b, np.int32)
-        for i in range(b):
-            lo, hi = self.indptr[start + i], self.indptr[start + i + 1]
-            k = min(int(hi - lo), max_nnz)
-            idx[i, :k] = self.indices[lo:lo + k]
-            val[i, :k] = self.data[lo:lo + k]
-            lens[i] = k
-        return idx, val, lens
+        counts = np.minimum(
+            np.diff(self.indptr[start:stop + 1]), max_nnz).astype(np.int64)
+        nnz = int(counts.sum())
+        within = (np.arange(nnz)
+                  - np.repeat(np.cumsum(counts) - counts, counts))
+        gather = np.repeat(self.indptr[start:stop], counts) + within
+        out_pos = np.repeat(np.arange(b) * max_nnz, counts) + within
+        idx.ravel()[out_pos] = self.indices[gather]
+        val.ravel()[out_pos] = self.data[gather]
+        return idx, val, counts.astype(np.int32)
 
     def max_row_nnz(self) -> int:
         if self.shape[0] == 0:
             return 0
         return int(np.max(np.diff(self.indptr)))
-
-    def row_norms_sq(self) -> np.ndarray:
-        """Per-row squared L2 norm without densifying."""
-        sq = self.data.astype(np.float64) ** 2
-        return np.add.reduceat(
-            np.concatenate([sq, [0.0]]),
-            np.minimum(self.indptr[:-1], len(sq)))[:self.shape[0]] \
-            * (np.diff(self.indptr) > 0)
-
-    # -- persistence --------------------------------------------------------
-
-    def to_npz_dict(self) -> Dict[str, np.ndarray]:
-        return {"data": self.data, "indices": self.indices,
-                "indptr": self.indptr,
-                "shape": np.asarray(self.shape, np.int64)}
-
-    @staticmethod
-    def from_npz_dict(d: Dict[str, np.ndarray]) -> "CSRMatrix":
-        return CSRMatrix(d["data"], d["indices"], d["indptr"],
-                         tuple(d["shape"]))
 
 
 def vstack(blocks: Sequence["CSRMatrix"]) -> CSRMatrix:

@@ -214,8 +214,15 @@ class DataTable:
         cols: Dict[str, ColumnData] = {}
         for name in base.column_names:
             parts = [t._columns[name] for t in tables]
-            if all(isinstance(p, CSRMatrix) for p in parts):
-                cols[name] = vstack(parts)
+            if any(isinstance(p, CSRMatrix) for p in parts):
+                # mixed sparse/dense parts: lift dense blocks to CSR so
+                # the result stays sparse (falling through would densify
+                # row-by-row into a Python list and break the schema's
+                # sparse flag)
+                cols[name] = vstack([
+                    p if isinstance(p, CSRMatrix)
+                    else CSRMatrix.from_dense(np.asarray(p, np.float32))
+                    for p in parts])
                 continue
             if all(isinstance(p, np.ndarray) for p in parts):
                 try:

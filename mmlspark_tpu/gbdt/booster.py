@@ -133,9 +133,12 @@ class Booster:
             if X.shape[0] == 0:
                 return self.raw_score(
                     np.zeros((0, len(self.feature_names))), num_iteration)
-            outs = [self.raw_score(X[lo:min(lo + 8192, X.shape[0])]
+            # rows per chunk from a ~256 MB dense budget, so memory stays
+            # bounded at ANY feature width
+            step = max(1, min(8192, (256 << 20) // (4 * X.shape[1])))
+            outs = [self.raw_score(X[lo:min(lo + step, X.shape[0])]
                                    .toarray(), num_iteration)
-                    for lo in range(0, X.shape[0], 8192)]
+                    for lo in range(0, X.shape[0], step)]
             return np.concatenate(outs, axis=-1)
         n = np.asarray(X).shape[0]
         K = self.num_class
@@ -255,26 +258,158 @@ class Booster:
 # ---------------------------------------------------------------------------
 
 
-def _bin_stream(shards, max_bin: int, seed: int):
-    """Streaming ingestion: ``shards`` yields (X, y[, w]) tuples. Bin
-    boundaries are fitted on the FIRST shard's sample (LightGBM also
-    fits its BinMapper on a head sample), then every shard is binned as
-    it arrives — only the int32 binned matrix is retained on host, so
-    the raw float features never need to fit in RAM at once."""
-    mapper = None
+_RESERVOIR_CAP = 200_000
+
+
+def _reservoir_rows(shard_iter, cap: int, seed: int) -> np.ndarray:
+    """Uniform row sample across an entire shard stream (bounded memory,
+    one pass) — vectorized Algorithm R over row blocks. This is the
+    LightGBM BinMapper discipline: sample the WHOLE dataset, not the
+    head (ref: LGBM bin_construct_sample_cnt over the full data)."""
+    rng = np.random.default_rng(seed ^ 0x5EED)
+    buf: Optional[np.ndarray] = None
+    seen = 0
+    for shard in shard_iter:
+        Xs = np.asarray(shard[0], dtype=np.float64)
+        i = 0
+        if buf is None:
+            take = min(cap, len(Xs))
+            buf = Xs[:take].copy()
+            seen = take
+            i = take
+        elif len(buf) < cap:
+            take = min(cap - len(buf), len(Xs))
+            buf = np.concatenate([buf, Xs[:take]])
+            seen += take
+            i = take
+        rest = Xs[i:]
+        if len(rest):
+            t = seen + np.arange(1, len(rest) + 1)
+            accept = rng.random(len(rest)) < (cap / t)
+            n_acc = int(accept.sum())
+            if n_acc:
+                buf[rng.integers(0, cap, size=n_acc)] = rest[accept]
+            seen += len(rest)
+    if buf is None:
+        raise ValueError("empty shard stream")
+    return buf
+
+
+def _multihost_mapper(X, streaming: bool, max_bin: int, seed: int,
+                      nproc: int) -> BinMapper:
+    """Identical bin boundaries on every host: each host reservoir- or
+    choice-samples its LOCAL shard, the samples are allgathered, and
+    every host fits the SAME mapper on the gathered rows — the
+    distributed BinMapper agreement LightGBM reaches inside its native
+    allreduce ring (ref: TrainUtils.scala:207 LGBM_NetworkInit +
+    LGBM_DatasetCreateFromMat)."""
+    from jax.experimental import multihost_utils
+    from mmlspark_tpu.core.sparse import CSRMatrix
+    cap = max(1000, _RESERVOIR_CAP // nproc)
+    rng = np.random.default_rng(seed)
+    if streaming:
+        if not (isinstance(X, (list, tuple)) or callable(X)):
+            raise ValueError(
+                "multi-host streaming GBDT needs a replayable shard "
+                "sequence (list or zero-arg factory), not a one-shot "
+                "generator: bin boundaries must be agreed across hosts "
+                "before any shard is binned")
+        fac = X if callable(X) else (lambda: iter(X))
+        sample = _reservoir_rows(
+            ((np.asarray(s[0], np.float64),) for s in fac()), cap, seed)
+    elif isinstance(X, CSRMatrix):
+        # the gathered sample is dense — budget rows by bytes so wide
+        # hashed features can't OOM before the binned-matrix guard runs
+        cap = min(cap, max(100, (256 << 20) // (X.shape[1] * 8)))
+        idx = rng.choice(X.shape[0], size=min(X.shape[0], cap),
+                         replace=False)
+        sample = X.take(idx).toarray().astype(np.float64)
+    else:
+        Xa = np.asarray(X, dtype=np.float64)
+        idx = rng.choice(len(Xa), size=min(len(Xa), cap), replace=False)
+        sample = Xa[idx]
+    s_len = int(np.min(np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(sample)]))).ravel()))
+    # f32 on the wire (the collective's default dtype); boundaries stay
+    # identical everywhere because every host fits the same bytes
+    gathered = np.asarray(multihost_utils.process_allgather(
+        np.ascontiguousarray(sample[:s_len], dtype=np.float32)))
+    gathered = gathered.reshape(-1, sample.shape[1]).astype(np.float64)
+    return BinMapper.fit(gathered, max_bin=max_bin,
+                         sample_cnt=len(gathered), seed=seed)
+
+
+def _bin_stream(shards, max_bin: int, seed: int,
+                mapper: Optional[BinMapper] = None):
+    """Streaming ingestion: ``shards`` yields (X, y[, w]) tuples; only
+    the int32 binned matrix is retained on host, so the raw floats never
+    need to fit in RAM at once.
+
+    Bin-boundary fidelity (LightGBM samples across the WHOLE dataset):
+    replayable inputs (list/tuple or zero-arg factory) get an exact
+    two-pass treatment — reservoir-sample all shards, fit, then bin.
+    One-shot generators can only be binned with boundaries from the
+    first shard; a reservoir accumulated alongside then MEASURES the
+    drift a skewed shard order introduced and warns loudly when the
+    first-shard boundaries disagree with full-stream boundaries."""
+    replayable = isinstance(shards, (list, tuple)) or callable(shards)
+    factory = (shards if callable(shards)
+               else (lambda: iter(shards)) if replayable else None)
+
+    forced = mapper is not None
+    if forced:
+        stream = factory() if replayable else shards
+    elif replayable:
+        sample = _reservoir_rows(factory(), _RESERVOIR_CAP, seed)
+        mapper = BinMapper.fit(sample, max_bin=max_bin, seed=seed)
+        stream = factory()
+    else:
+        stream = shards
+
+    rng = np.random.default_rng(seed ^ 0x5EED)
+    res_buf: Optional[np.ndarray] = None
+    res_seen = 0
     bins_parts, y_parts, w_parts = [], [], []
-    for shard in shards:
+    for shard in stream:
         Xs = np.asarray(shard[0], dtype=np.float64)
         ys = np.asarray(shard[1], dtype=np.float64)
         ws = (np.asarray(shard[2], dtype=np.float64) if len(shard) > 2
               else np.ones(len(ys)))
         if mapper is None:
             mapper = BinMapper.fit(Xs, max_bin=max_bin, seed=seed)
+        if not replayable and not forced:
+            # accumulate the full-stream reservoir for the drift check
+            i = 0
+            if res_buf is None:
+                take = min(_RESERVOIR_CAP, len(Xs))
+                res_buf, res_seen, i = Xs[:take].copy(), take, take
+            rest = Xs[i:]
+            if len(rest):
+                t = res_seen + np.arange(1, len(rest) + 1)
+                accept = rng.random(len(rest)) < (_RESERVOIR_CAP / t)
+                n_acc = int(accept.sum())
+                if n_acc and len(res_buf) >= 1:
+                    res_buf[rng.integers(0, len(res_buf), size=n_acc)] \
+                        = rest[accept]
+                res_seen += len(rest)
         bins_parts.append(mapper.transform(Xs))
         y_parts.append(ys)
         w_parts.append(ws)
     if mapper is None:
         raise ValueError("empty shard stream")
+    if not replayable and res_buf is not None and res_seen > len(res_buf):
+        # did the one-shot stream's first shard misrepresent the data?
+        full_mapper = BinMapper.fit(res_buf, max_bin=max_bin, seed=seed)
+        drift = float(np.mean(mapper.transform(res_buf)
+                              != full_mapper.transform(res_buf)))
+        if drift > 0.01:
+            import logging
+            logging.getLogger("mmlspark_tpu.gbdt").warning(
+                "streaming binning drift: %.1f%% of sampled cells bin "
+                "differently under first-shard vs full-stream "
+                "boundaries — the shard order looks skewed/sorted. "
+                "Pass a list or zero-arg factory of shards for exact "
+                "two-pass quantiles.", 100 * drift)
     return (mapper, np.concatenate(bins_parts), np.concatenate(y_parts),
             np.concatenate(w_parts))
 
@@ -344,6 +479,22 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             "iterator X with a separate y is ambiguous: streaming mode "
             "passes y=None and the iterator yields "
             "(X_shard, y_shard[, w_shard]) tuples")
+    # multi-host data-parallel: every process calls train() with its OWN
+    # row shard; bin boundaries are agreed from allgathered samples and
+    # the global binned matrix is assembled from per-process shards (the
+    # LightGBM worker-partition flow, ref: TrainUtils.scala:188-214)
+    from mmlspark_tpu.parallel import distributed as dist
+    proc_info = dist.host_info()
+    multi_host = (p["parallelism"] == "data"
+                  and proc_info.process_count > 1)
+    if p["parallelism"] == "feature" and proc_info.process_count > 1:
+        raise NotImplementedError(
+            "tree_learner='feature' currently shards features within "
+            "one process's mesh; use parallelism='data' across hosts")
+    forced_mapper = (_multihost_mapper(
+        X, streaming, p["max_bin"], p["seed"], proc_info.process_count)
+        if multi_host else None)
+
     if streaming:
         if sample_weight is not None:
             raise ValueError(
@@ -353,7 +504,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             # fail fast — before consuming the (possibly huge) stream
             raise ValueError("init_model warm start requires dense X")
         mapper, bins_np, y, w_base = _bin_stream(
-            X, p["max_bin"], p["seed"])
+            X, p["max_bin"], p["seed"], mapper=forced_mapper)
         n, f = bins_np.shape
     else:
         from mmlspark_tpu.core.sparse import CSRMatrix
@@ -372,7 +523,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                     f"rows); reduce the feature width (hashing) first")
             w_base = (np.ones(n) if sample_weight is None
                       else np.asarray(sample_weight, dtype=np.float64))
-            mapper = BinMapper.fit_sparse(
+            mapper = forced_mapper or BinMapper.fit_sparse(
                 X, max_bin=p["max_bin"], seed=p["seed"])
             # (F, N) natively; the .T view re-transposes to the row-major
             # shape the shared code expects and is undone at zero cost by
@@ -383,7 +534,9 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             n, f = X.shape
             w_base = (np.ones(n) if sample_weight is None
                       else np.asarray(sample_weight, dtype=np.float64))
-            mapper = BinMapper.fit(X, max_bin=p["max_bin"], seed=p["seed"])
+            mapper = (forced_mapper or
+                      BinMapper.fit(X, max_bin=p["max_bin"],
+                                    seed=p["seed"]))
             bins_np = None   # dense path bins on device (below)
     if feature_names is None:
         feature_names = [f"Column_{i}" for i in range(f)]
@@ -400,8 +553,32 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         axis_name = mesh_lib.DATA_AXIS
         n_shards = mesh.shape[axis_name]
 
-    # rows pad to the shard count only when rows are sharded
-    pad = (-n) % max(n_shards if data_parallel else 1, 1)
+    if multi_host:
+        # hosts truncate to the global-min LOCAL row count so every
+        # process contributes an identically-shaped shard to the global
+        # arrays (ragged shards would break make_array_from_process_
+        # local_data and desynchronize the training loop)
+        from jax.experimental import multihost_utils
+        n_all = np.asarray(multihost_utils.process_allgather(
+            np.asarray([n]))).ravel()
+        n_min = int(n_all.min())
+        if n_min != n:
+            import logging
+            logging.getLogger("mmlspark_tpu.gbdt").warning(
+                "host shards are unequal (%s); truncating to %d rows "
+                "per host", n_all.tolist(), n_min)
+            y, w_base = y[:n_min], w_base[:n_min]
+            if bins_np is not None:
+                bins_np = bins_np[:n_min]
+            if isinstance(X, np.ndarray):
+                X = X[:n_min]
+            n = n_min
+        # pad LOCAL rows to this process's device count; the global
+        # row count is then divisible by the full data axis
+        pad = (-n) % max(len(jax.local_devices()), 1)
+    else:
+        # rows pad to the shard count only when rows are sharded
+        pad = (-n) % max(n_shards if data_parallel else 1, 1)
     if pad:
         y_pad = np.pad(y, (0, pad))
         w_pad = np.pad(w_base, (0, pad))  # zero weight → padding inert
@@ -437,7 +614,10 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         bins_t = np.ascontiguousarray(bins_np.T)
         if f_pad:
             bins_t = np.pad(bins_t, ((0, f_pad), (0, 0)))
-        bins_dev = jnp.asarray(bins_t, jnp.int32)
+        # multi-host keeps numpy — the global array is assembled from
+        # per-process shards below
+        bins_dev = (bins_t.astype(np.int32) if multi_host
+                    else jnp.asarray(bins_t, jnp.int32))
 
     # 3) init scores — fresh start or warm start from a base forest
     base_model: Optional[Booster] = None
@@ -468,7 +648,19 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         base_scores = np.pad(_base_raw_kn(base_model, X, K),
                              ((0, 0), (0, pad)))
     elif p["boost_from_average"]:
-        init_score = objective.init_score(y, w_base)
+        if multi_host:
+            # the init score must agree across hosts (quantile/average
+            # objectives need the GLOBAL label distribution)
+            from jax.experimental import multihost_utils
+            y_g = np.asarray(multihost_utils.process_allgather(
+                np.ascontiguousarray(y, dtype=np.float32))).reshape(-1)
+            w_g = np.asarray(multihost_utils.process_allgather(
+                np.ascontiguousarray(w_base, dtype=np.float32))
+            ).reshape(-1)
+            init_score = objective.init_score(
+                y_g.astype(np.float64), w_g.astype(np.float64))
+        else:
+            init_score = objective.init_score(y, w_base)
     else:
         init_score = np.zeros(K)
 
@@ -495,7 +687,19 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                  else np.broadcast_to(
                      np.asarray(init_score, np.float32)[:, None],
                      (K, n_padded)))
-    if data_parallel:
+    if multi_host:
+        # assemble GLOBAL arrays from each process's local shard — the
+        # collective-mesh replacement for the reference's per-worker
+        # native Dataset + socket ring (ref: TrainUtils.scala:188-214)
+        col_sh = jax.sharding.NamedSharding(
+            mesh, P(None, mesh_lib.DATA_AXIS))
+        row_sh = jax.sharding.NamedSharding(mesh, P(mesh_lib.DATA_AXIS))
+        bins_d = jax.make_array_from_process_local_data(col_sh, bins_dev)
+        y_d = jax.make_array_from_process_local_data(
+            row_sh, np.asarray(y_pad, np.float32))
+        scores = jax.make_array_from_process_local_data(
+            col_sh, np.asarray(scores_np, np.float32))
+    elif data_parallel:
         shard = mesh_lib.data_sharding(mesh)
         bins_d = jax.device_put(
             bins_dev,
@@ -536,15 +740,31 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                 mapper.transform(np.asarray(valid[0], dtype=np.float64))
                 .astype(np.float32))
         yv = jnp.asarray(np.asarray(valid[1], dtype=np.float32))
+        if multi_host:
+            # every host must pass IDENTICAL valid data; lift it (and
+            # the running scores below) to replicated global arrays so
+            # the per-iteration scoring ops run on the global mesh
+            _repl = jax.sharding.NamedSharding(mesh, P())
+            bins_v = jax.make_array_from_process_local_data(
+                _repl, np.asarray(bins_v))
+            yv = jax.make_array_from_process_local_data(
+                _repl, np.asarray(yv))
         if base_model is not None:
-            v_scores = jnp.asarray(_base_raw_kn(
-                base_model, np.asarray(valid[0], dtype=np.float64), K))
+            v_scores_np = _base_raw_kn(
+                base_model, np.asarray(valid[0], dtype=np.float64), K)
         else:
-            v_scores = jnp.broadcast_to(
-                jnp.asarray(init_score, jnp.float32)[:, None],
+            v_scores_np = np.broadcast_to(
+                np.asarray(init_score, np.float32)[:, None],
                 (K, bins_v.shape[0]))
+        if multi_host:
+            v_scores = jax.make_array_from_process_local_data(
+                _repl, np.ascontiguousarray(v_scores_np, np.float32))
+        else:
+            v_scores = jnp.asarray(v_scores_np, jnp.float32)
     best_loss = np.inf
     best_iter = -1
+    pending_val: List[Tuple[int, Any]] = []
+    esr_sync = max(1, min(esr, 8)) if esr > 0 else 1
     # one fixed walk length -> one predict_trees compile for the whole
     # run (leaves self-loop, extra steps are no-ops)
     valid_depth = int(p["max_depth"]) if int(p["max_depth"]) > 0 \
@@ -563,24 +783,34 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                  "right": jnp.int32, "value": jnp.float32,
                  "is_leaf": jnp.bool_, "gain": jnp.float32,
                  "count": jnp.float32}
-    forest = Tree(**{fld: jnp.zeros((t_cap, M), dt)
+    # numpy buffers in multi-host mode: jit treats them as replicated
+    # inputs on the global mesh (a committed local jnp array would not
+    # be addressable across processes)
+    _zeros = np.zeros if multi_host else jnp.zeros
+    forest = Tree(**{fld: _zeros((t_cap, M), dt)
                      for fld, dt in _f_dtypes.items()})
 
     bag_active = p["bagging_fraction"] < 1.0 and p["bagging_freq"] > 0
     ff_active = p["feature_fraction"] < 1.0
-    w_d = _maybe_shard(jnp.asarray(w_pad, jnp.float32), mesh,
-                       data_parallel)
+    def _rows_global(w_np):
+        if multi_host:
+            return jax.make_array_from_process_local_data(
+                jax.sharding.NamedSharding(mesh, P(mesh_lib.DATA_AXIS)),
+                np.asarray(w_np, np.float32))
+        return _maybe_shard(jnp.asarray(w_np, jnp.float32), mesh,
+                            data_parallel)
+
+    w_d = _rows_global(w_pad)
     fmask_base = np.zeros(f_eff, np.float32)
     fmask_base[:f] = 1.0          # padded dummy features stay masked
-    fmask = jnp.asarray(fmask_base)
+    fmask = fmask_base   # numpy: replicated-safe for jit
     trees_done = 0
     for it in range(n_iter):
         # bagging (ref: TrainParams baggingFraction/baggingFreq —
         # LightGBM resamples every `freq` iters and reuses the bag between)
         if bag_active and it % p["bagging_freq"] == 0:
             keep = rng.random(n_padded) < p["bagging_fraction"]
-            w_d = _maybe_shard(jnp.asarray(w_pad * keep, jnp.float32),
-                               mesh, data_parallel)
+            w_d = _rows_global(w_pad * keep)
 
         # feature subsampling per tree
         if ff_active:
@@ -588,14 +818,14 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             chosen = rng.choice(f, size=k, replace=False)
             fmask_np = np.zeros(f_eff, np.float32)
             fmask_np[chosen] = 1.0
-            fmask = jnp.asarray(fmask_np)
+            fmask = fmask_np
 
         scores, forest = step_fn(bins_d, scores, y_d, w_d, fmask,
-                                 forest, jnp.int32(it * K))
+                                 forest, np.int32(it * K))
         trees_done = (it + 1) * K
 
         if use_valid:
-            row = jnp.int32(it * K)
+            row = np.int32(it * K)
             for k_cls in range(K):
                 sl = lambda a: lax.dynamic_slice_in_dim(  # noqa: E731
                     a, row + k_cls, 1, axis=0)
@@ -606,11 +836,26 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                     max_depth=valid_depth)
                 v_scores = v_scores.at[k_cls].add(lr * tv[0])
             vs = v_scores[0] if K == 1 else v_scores
-            cur = float(objective.loss(vs, yv))
-            if cur < best_loss - 1e-12:
-                best_loss, best_iter = cur, it + 1
-            elif it + 1 - best_iter >= esr:
-                break
+            # ASYNC early stopping: the loss stays a device scalar and
+            # the host reads a batch of them every few iterations, so
+            # esr no longer re-serializes the loop per iteration (the
+            # reads are ~free by then — those steps finished long ago).
+            # Worst case trains esr_sync-1 extra trees past the stop
+            # point; best_iteration stays exact, so predictions are
+            # unaffected (extra trees are truncated at scoring time).
+            pending_val.append((it, objective.loss(vs, yv)))
+            if len(pending_val) >= esr_sync or it == n_iter - 1:
+                stop = False
+                for it_, dev_loss in pending_val:
+                    cur = float(dev_loss)
+                    if cur < best_loss - 1e-12:
+                        best_loss, best_iter = cur, it_ + 1
+                    elif it_ + 1 - best_iter >= esr:
+                        stop = True
+                        break
+                pending_val.clear()
+                if stop:
+                    break
 
     if trees_done:
         # one device->host transfer for the whole forest

@@ -309,15 +309,13 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     f"batchSize {batch_size} must divide evenly over "
                     f"{proc_count} processes")
             local_batch = batch_size // proc_count
-            if streaming:
-                raise NotImplementedError(
-                    "streaming shard ingestion is single-host for now: "
-                    "hosts cannot agree on step counts without knowing "
-                    "every shard's size up front (ragged streams would "
-                    "deadlock the global-batch collectives)")
             # agree on a common step count: ragged shards would make one
             # host enter a collective the others never reach. Truncate
-            # every host to the global minimum row count.
+            # every host to the global minimum row count — streaming
+            # already counted its rows in the metadata pass, so the same
+            # agreement covers ragged shard streams (each host caps its
+            # per-epoch consumption at n_min; the batching then yields
+            # identical step counts and batch shapes on every host).
             from jax.experimental import multihost_utils
             n_all = np.asarray(multihost_utils.process_allgather(
                 np.asarray([n])))
@@ -327,7 +325,8 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     "host shards are unequal (%s); truncating to %d "
                     "rows per host so step counts agree",
                     n_all.ravel().tolist(), n_min)
-                x, y = x[:n_min], y[:n_min]
+                if not streaming:
+                    x, y = x[:n_min], y[:n_min]
                 n = n_min
         else:
             local_batch = batch_size
@@ -497,9 +496,17 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                         yield epoch, step, x[idx], y[idx]
                     continue
                 carry_x = carry_y = None
+                consumed = 0   # rows taken this epoch; capped at n so
+                #                multi-host ragged streams stay in step
                 for shard in factory():
+                    if consumed >= n:
+                        break
                     xs, ys = table_to_xy(shard, fcol, lcol, input_shape)
                     ys = ys.astype(y_cast)
+                    take = min(len(xs), n - consumed)
+                    if take < len(xs):
+                        xs, ys = xs[:take], ys[:take]
+                    consumed += take
                     perm = np_rng.permutation(len(xs))
                     xs, ys = xs[perm], ys[perm]
                     if carry_x is not None:

@@ -28,21 +28,9 @@ from mmlspark_tpu.core.stage import Estimator, Model
 from mmlspark_tpu.core.table import DataTable, features_matrix as _features_matrix
 
 
-@partial(jax.jit, static_argnames=("n_steps", "num_class"))
-def _fit_logistic(X, y, lr, l2, n_steps: int, num_class: int):
-    n, d = X.shape
-    W = jnp.zeros((d, num_class))
-    b = jnp.zeros(num_class)
-    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
-    m = {"W": W, "b": b}
-    v = {"W": W, "b": b}
-
-    def loss_fn(params):
-        logits = X @ params["W"] + params["b"]
-        logp = jax.nn.log_softmax(logits)
-        return (-jnp.mean(jnp.sum(onehot * logp, axis=1))
-                + l2 * jnp.sum(params["W"] ** 2))
-
+def _momentum_fit(loss_fn, init_params, lr, n_steps: int):
+    """Shared full-batch momentum-GD loop (one jitted fori_loop) used by
+    every linear model — dense and sparse paths optimize identically."""
     def body(i, carry):
         params, vel = carry
         g = jax.grad(loss_fn)(params)
@@ -51,8 +39,25 @@ def _fit_logistic(X, y, lr, l2, n_steps: int, num_class: int):
         params = jax.tree_util.tree_map(lambda p, vv: p + vv, params, vel)
         return params, vel
 
-    params, _ = lax.fori_loop(0, n_steps, body, (m, v))
+    zero_vel = jax.tree_util.tree_map(jnp.zeros_like, init_params)
+    params, _ = lax.fori_loop(0, n_steps, body, (init_params, zero_vel))
     return params
+
+
+@partial(jax.jit, static_argnames=("n_steps", "num_class"))
+def _fit_logistic(X, y, lr, l2, n_steps: int, num_class: int):
+    n, d = X.shape
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+
+    def loss_fn(params):
+        logits = X @ params["W"] + params["b"]
+        logp = jax.nn.log_softmax(logits)
+        return (-jnp.mean(jnp.sum(onehot * logp, axis=1))
+                + l2 * jnp.sum(params["W"] ** 2))
+
+    return _momentum_fit(
+        loss_fn, {"W": jnp.zeros((d, num_class)),
+                  "b": jnp.zeros(num_class)}, lr, n_steps)
 
 
 @partial(jax.jit, static_argnames=("n_steps", "num_class", "d"))
@@ -66,7 +71,6 @@ def _fit_logistic_sparse(idx, val, y, lr, l2, n_steps: int,
     scatter-add gradient automatically. Padding entries (idx 0, val 0)
     contribute nothing."""
     onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
-    zero = {"W": jnp.zeros((d, num_class)), "b": jnp.zeros(num_class)}
 
     def loss_fn(p):
         rows = p["W"][idx]                                  # (N, m, K)
@@ -75,16 +79,9 @@ def _fit_logistic_sparse(idx, val, y, lr, l2, n_steps: int,
         return (-jnp.mean(jnp.sum(onehot * logp, axis=1))
                 + l2 * jnp.sum(p["W"] ** 2))
 
-    def body(i, carry):
-        params, vel = carry
-        g = jax.grad(loss_fn)(params)
-        vel = jax.tree_util.tree_map(lambda vv, gg: 0.9 * vv - lr * gg,
-                                     vel, g)
-        params = jax.tree_util.tree_map(lambda p, vv: p + vv, params, vel)
-        return params, vel
-
-    params, _ = lax.fori_loop(0, n_steps, body, (zero, dict(zero)))
-    return params
+    return _momentum_fit(
+        loss_fn, {"W": jnp.zeros((d, num_class)),
+                  "b": jnp.zeros(num_class)}, lr, n_steps)
 
 
 def _sparse_logits(csr, W: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -99,22 +96,13 @@ def _sparse_logits(csr, W: np.ndarray, b: np.ndarray) -> np.ndarray:
 @partial(jax.jit, static_argnames=("n_steps",))
 def _fit_linear(X, y, lr, l2, n_steps: int):
     n, d = X.shape
-    params = {"w": jnp.zeros(d), "b": jnp.asarray(0.0)}
-    vel = {"w": jnp.zeros(d), "b": jnp.asarray(0.0)}
 
     def loss_fn(p):
         pred = X @ p["w"] + p["b"]
         return jnp.mean((pred - y) ** 2) + l2 * jnp.sum(p["w"] ** 2)
 
-    def body(i, carry):
-        p, v = carry
-        g = jax.grad(loss_fn)(p)
-        v = jax.tree_util.tree_map(lambda vv, gg: 0.9 * vv - lr * gg, v, g)
-        p = jax.tree_util.tree_map(lambda pp, vv: pp + vv, p, v)
-        return p, v
-
-    params, _ = lax.fori_loop(0, n_steps, body, (params, vel))
-    return params
+    return _momentum_fit(
+        loss_fn, {"w": jnp.zeros(d), "b": jnp.asarray(0.0)}, lr, n_steps)
 
 
 class _Standardizer:

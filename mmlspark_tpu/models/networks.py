@@ -155,6 +155,12 @@ class ResNet(nn.Module):
     def feature_layers(self) -> List[str]:
         return [f"stage{s}" for s in range(len(self.stage_sizes))] + ["pool"]
 
+    def numerics_markers(self) -> Dict[str, str]:
+        """Saved-stage numerics versioning (core/serialize.py hook):
+        checkpoints from before the explicit-(1,1)-padding change shift
+        one pixel at stride-2 stage entries — loading them must warn."""
+        return {"resnet_padding": "explicit11-torch-compat"}
+
 
 class BiLSTMTagger(nn.Module):
     """Bidirectional LSTM sequence tagger — the TPU twin of the notebook

@@ -10,8 +10,12 @@ TPU-native shape: model state is replicated by jax, so serving hosts are
 independent — each runs one ServingEngine and any TCP load balancer
 fronts them. ``ServingFleet`` manages N engines (the one-process
 simulation of that deployment and the orchestration utility on a real
-host group); ``PartitionConsolidator`` keeps each process's own row
-range of a table, funneling work to exactly one consumer per host.
+host group); the genuinely cross-process deployment — one engine per OS
+process with reply-routing and per-process counters — is exercised by
+tests/serving_worker.py + tests/test_distributed.py
+(test_cross_process_serving_fleet). ``PartitionConsolidator`` keeps each
+process's own row range of a table, funneling work to exactly one
+consumer per host.
 """
 
 from __future__ import annotations

@@ -82,6 +82,49 @@ def main() -> None:
     leaf = np.asarray(jax.tree_util.tree_leaves(
         model.get("weights"))[0]).ravel()[:3]
     print(f"TRAIN {pid} {','.join(f'{v:.6f}' for v in leaf)}", flush=True)
+
+    # STREAMING multi-host: each host feeds a RAGGED shard stream (40 vs
+    # 36 rows); hosts allgather their counts and truncate to the global
+    # minimum so step counts agree (VERDICT r2 item 5 — the restriction
+    # learner.py used to raise NotImplementedError for)
+    my_rows = 40 if pid == 0 else 40 - 4 * pid
+    lo = sum(40 if q == 0 else 40 - 4 * q for q in range(pid))
+    rows = np.arange(lo, lo + my_rows)
+    sx = gx[rows % 64]
+    sy = gy[rows % 64]
+    shards = [DataTable({"features": sx[k:k + 16], "label": sy[k:k + 16]})
+              for k in range(0, my_rows, 16)]
+    stream_learner = TPULearner(
+        networkSpec={"type": "mlp", "features": [8], "num_classes": 2},
+        epochs=4, batchSize=8 * nproc, learningRate=0.1,
+        computeDtype="float32", logEvery=1000,
+        meshAxes={"data": info.global_device_count})
+    smodel = stream_learner.fit(shards)
+    leaf = np.asarray(jax.tree_util.tree_leaves(
+        smodel.get("weights"))[0]).ravel()[:3]
+    print(f"STREAM {pid} {','.join(f'{v:.6f}' for v in leaf)}", flush=True)
+
+    # multi-host GBDT: every process feeds its LOCAL row shard; bin
+    # boundaries come from allgathered samples and histograms psum over
+    # the global mesh (the LightGBM worker-partition + allreduce-ring
+    # flow, ref: TrainUtils.scala:188-214). Hosts must grow IDENTICAL
+    # forests.
+    import hashlib
+    from mmlspark_tpu.gbdt.booster import train as gbdt_train
+
+    grng = np.random.default_rng(11)
+    GX = grng.normal(size=(400, 6))
+    GY = (GX[:, 0] + 0.5 * GX[:, 1] > 0).astype(float)
+    rows_lo, rows_hi = pid * 200, (pid + 1) * 200
+    booster = gbdt_train(
+        {"objective": "binary", "num_iterations": 5, "num_leaves": 7,
+         "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "data",
+         "hist_method": "scatter"},
+        GX[rows_lo:rows_hi], GY[rows_lo:rows_hi])
+    digest = hashlib.sha256(
+        booster.model_to_string().encode()).hexdigest()[:16]
+    auc_ok = int(np.mean((booster.predict(GX) > 0.5) == GY) > 0.9)
+    print(f"GBDT {pid} {digest},{auc_ok}", flush=True)
     print(f"OK {pid}", flush=True)
 
 

@@ -1,0 +1,60 @@
+"""One serving-host process for the cross-process serving test.
+
+The reference's serving is genuinely per-executor — one JVMSharedServer
+in every executor process with reply-by-uuid routing
+(ref: src/io/http/src/main/scala/DistributedHTTPSource.scala:96-266).
+This worker is the TPU-native equivalent of one executor: its own OS
+process, its own ServingEngine + port, its own counters. The parent test
+sprays requests across all workers and checks the reply-routing
+invariant and the fleet-wide counter aggregate.
+
+Usage: python serving_worker.py <port> <worker_id>
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    port, wid = int(sys.argv[1]), int(sys.argv[2])
+
+    from mmlspark_tpu.serving.server import HTTPSource, ServingEngine
+    from mmlspark_tpu.stages.basic import Lambda
+
+    stop = threading.Event()
+
+    def handle(table):
+        replies = []
+        for r in table["request"]:
+            body = json.loads(r["entity"].decode())
+            if body.get("__shutdown__"):
+                stop.set()
+                replies.append({"bye": wid})
+            else:
+                # replies carry the worker identity so the test can
+                # assert each answer returned through the SAME process
+                # that accepted it
+                replies.append({"echo": body["x"], "worker": wid})
+        return table.with_column("reply", replies)
+
+    source = HTTPSource(host="127.0.0.1", port=port)
+    engine = ServingEngine(source, Lambda.apply(handle),
+                           batch_size=8).start()
+    print(f"READY {wid} {source.address}", flush=True)
+
+    stop.wait(timeout=120)
+    time.sleep(0.3)   # let the shutdown reply flush
+    print(f"COUNTERS {wid} {source.requests_seen} "
+          f"{source.requests_accepted} {source.requests_answered}",
+          flush=True)
+    engine.stop()
+
+
+if __name__ == "__main__":
+    main()

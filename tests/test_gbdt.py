@@ -622,3 +622,70 @@ class TestFeatureParallel:
         out = model.transform(t)
         acc = np.mean(np.asarray(out["prediction"]) == y)
         assert acc > 0.9
+
+
+class TestStreamBinFidelity:
+    """Reservoir sampling across all shards before fixing bin boundaries
+    (ref: LightGBM BinMapper samples the whole dataset, not the head)."""
+
+    def _skewed_shards(self, n=6000, seed=0):
+        """Shards SORTED by the informative feature — the adversarial
+        order where first-shard binning collapses."""
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 5))
+        X[:, 0] = rng.exponential(scale=4.0, size=n)   # heavy tail
+        y = (X[:, 0] > np.median(X[:, 0])).astype(float)
+        order = np.argsort(X[:, 0])                    # worst case
+        X, y = X[order], y[order]
+        return [(X[i:i + 1000], y[i:i + 1000]) for i in range(0, n, 1000)], X, y
+
+    def test_replayable_stream_matches_dense_quality(self):
+        shards, X, y = self._skewed_shards()
+        kw = {"objective": "binary", "num_iterations": 15,
+              "num_leaves": 15, "max_bin": 31, "min_data_in_leaf": 5,
+              "hist_method": "scatter"}
+        b_dense = train(kw, X, y)
+        b_stream = train(kw, shards)       # replayable list -> two-pass
+        a_d = _auc(y, b_dense.predict(X))
+        a_s = _auc(y, b_stream.predict(X))
+        assert a_s > 0.99
+        assert abs(a_d - a_s) < 0.005, (a_d, a_s)
+
+    def test_factory_stream_two_pass(self):
+        shards, X, y = self._skewed_shards(seed=1)
+        b = train({"objective": "binary", "num_iterations": 10,
+                   "num_leaves": 15, "min_data_in_leaf": 5,
+                   "hist_method": "scatter"}, lambda: iter(shards))
+        assert _auc(y, b.predict(X)) > 0.99
+
+    def test_oneshot_skewed_stream_warns(self):
+        import logging
+        shards, X, y = self._skewed_shards(seed=2)
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append   # the pkg logger doesn't propagate
+        lg = logging.getLogger("mmlspark_tpu.gbdt")
+        lg.addHandler(handler)
+        try:
+            train({"objective": "binary", "num_iterations": 5,
+                   "num_leaves": 7, "hist_method": "scatter",
+                   "min_data_in_leaf": 5}, iter(shards))
+        finally:
+            lg.removeHandler(handler)
+        assert any("binning drift" in r.getMessage() for r in records)
+
+
+class TestAsyncEarlyStopping:
+    def test_esr_still_stops_and_best_iter_exact(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(1500, 8))
+        y = X[:, 0] * 2 + rng.normal(scale=0.3, size=1500)
+        kw = {"objective": "regression", "num_iterations": 200,
+              "num_leaves": 7, "learning_rate": 0.3,
+              "early_stopping_round": 5, "hist_method": "scatter",
+              "min_data_in_leaf": 5}
+        b = train(kw, X[:1200], y[:1200], valid=(X[1200:], y[1200:]))
+        # overfits quickly at lr=0.3 -> must stop well before 200
+        assert 0 < b.best_iteration < 150
+        # at most esr_sync-1 extra trees trained past the stop point
+        assert b.num_trees <= b.best_iteration + 5 + 8
