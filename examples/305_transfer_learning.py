@@ -64,5 +64,52 @@ def main():
     assert acc > 0.9
 
 
+def imagenet_checkpoint_demo():
+    """The published-checkpoint flow: a torchvision-resnet18-layout
+    checkpoint (here: an in-image torch twin standing in for the real
+    download — the layout/numerics are pinned by
+    tests/test_torchvision_import.py) imports into the flax ImageNet
+    ResNet, publishes through the zoo, and featurizes images via layer
+    cutting (ref: ModelDownloader.scala:209, ImageFeaturizer.scala:91)."""
+    import torch
+
+    from mmlspark_tpu.importers.torch_import import (
+        TORCHVISION_RESNET18_SPEC, import_torchvision_resnet)
+    from mmlspark_tpu.testing.torch_models import build_torch_resnet18
+
+    torch.manual_seed(0)
+    twin = build_torch_resnet18().eval()
+    with tempfile.TemporaryDirectory() as root:
+        # "download": a real torchvision/HF file (.pth or .safetensors)
+        # drops into this exact call
+        ckpt = f"{root}/resnet18.pth"
+        torch.save(twin.state_dict(), ckpt)
+        variables = import_torchvision_resnet(ckpt)
+
+        repo = LocalRepo(f"{root}/repo")
+        module = build_network(TORCHVISION_RESNET18_SPEC)
+        schema = repo.publish(
+            "ResNet18_ImageNet", TORCHVISION_RESNET18_SPEC, variables,
+            dataset="ImageNet", model_type="vision/classification",
+            input_shape=[224, 224, 3],
+            layer_names=module.feature_layers())
+        downloader = ModelDownloader(f"{root}/cache", repo=repo)
+        featurizer = ImageFeaturizer.from_model_schema(
+            schema, downloader, cutOutputLayers=1)   # 512-d embeddings
+
+        table = make_images(n=24)
+        feats = featurizer.transform(table)
+    emb = np.asarray(feats["features"])
+    print(f"imported-backbone embeddings: {emb.shape}")
+    assert emb.shape[1] == 512
+
+    head = TPUBoostClassifier(numIterations=15, maxBin=32,
+                              minDataInLeaf=2).fit(feats)
+    acc = (head.transform(feats)["prediction"] == table["label"]).mean()
+    print(f"imported-backbone transfer accuracy: {acc:.3f}")
+    assert acc > 0.9
+
+
 if __name__ == "__main__":
     main()
+    imagenet_checkpoint_demo()

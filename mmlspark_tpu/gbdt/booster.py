@@ -325,9 +325,10 @@ def _multihost_mapper(X, streaming: bool, max_bin: int, seed: int,
                          replace=False)
         sample = X.take(idx).toarray().astype(np.float64)
     else:
-        Xa = np.asarray(X, dtype=np.float64)
-        idx = rng.choice(len(Xa), size=min(len(Xa), cap), replace=False)
-        sample = Xa[idx]
+        n_loc = len(X)
+        idx = rng.choice(n_loc, size=min(n_loc, cap), replace=False)
+        sample = np.asarray(X[idx] if isinstance(X, np.ndarray)
+                            else np.asarray(X)[idx], dtype=np.float64)
     s_len = int(np.min(np.asarray(multihost_utils.process_allgather(
         np.asarray([len(sample)]))).ravel()))
     # f32 on the wire (the collective's default dtype); boundaries stay
@@ -369,6 +370,7 @@ def _bin_stream(shards, max_bin: int, seed: int,
     rng = np.random.default_rng(seed ^ 0x5EED)
     res_buf: Optional[np.ndarray] = None
     res_seen = 0
+    first_shard_rows = 0
     bins_parts, y_parts, w_parts = [], [], []
     for shard in stream:
         Xs = np.asarray(shard[0], dtype=np.float64)
@@ -377,12 +379,21 @@ def _bin_stream(shards, max_bin: int, seed: int,
               else np.ones(len(ys)))
         if mapper is None:
             mapper = BinMapper.fit(Xs, max_bin=max_bin, seed=seed)
+            first_shard_rows = len(Xs)
         if not replayable and not forced:
             # accumulate the full-stream reservoir for the drift check
+            # (same fill/top-up/replace discipline as _reservoir_rows —
+            # without the top-up the buffer would stay first-shard-sized
+            # and the "full-stream" sample would bias to the tail)
             i = 0
             if res_buf is None:
                 take = min(_RESERVOIR_CAP, len(Xs))
                 res_buf, res_seen, i = Xs[:take].copy(), take, take
+            elif len(res_buf) < _RESERVOIR_CAP:
+                take = min(_RESERVOIR_CAP - len(res_buf), len(Xs))
+                res_buf = np.concatenate([res_buf, Xs[:take]])
+                res_seen += take
+                i = take
             rest = Xs[i:]
             if len(rest):
                 t = res_seen + np.arange(1, len(rest) + 1)
@@ -397,7 +408,8 @@ def _bin_stream(shards, max_bin: int, seed: int,
         w_parts.append(ws)
     if mapper is None:
         raise ValueError("empty shard stream")
-    if not replayable and res_buf is not None and res_seen > len(res_buf):
+    if (not replayable and not forced and res_buf is not None
+            and res_seen > first_shard_rows):
         # did the one-shot stream's first shard misrepresent the data?
         full_mapper = BinMapper.fit(res_buf, max_bin=max_bin, seed=seed)
         drift = float(np.mean(mapper.transform(res_buf)
@@ -491,6 +503,13 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         raise NotImplementedError(
             "tree_learner='feature' currently shards features within "
             "one process's mesh; use parallelism='data' across hosts")
+    if p["parallelism"] == "serial" and proc_info.process_count > 1:
+        import logging
+        logging.getLogger("mmlspark_tpu.gbdt").warning(
+            "train() called under %d jax processes with "
+            "parallelism='serial': each host will fit an INDEPENDENT "
+            "model on its local data. Use parallelism='data' for one "
+            "globally-trained forest.", proc_info.process_count)
     forced_mapper = (_multihost_mapper(
         X, streaming, p["max_bin"], p["seed"], proc_info.process_count)
         if multi_host else None)
@@ -572,6 +591,10 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                 bins_np = bins_np[:n_min]
             if isinstance(X, np.ndarray):
                 X = X[:n_min]
+            else:
+                from mmlspark_tpu.core.sparse import CSRMatrix as _C
+                if isinstance(X, _C):
+                    X = X[:n_min]   # warm-start scoring needs same rows
             n = n_min
         # pad LOCAL rows to this process's device count; the global
         # row count is then divisible by the full data axis
@@ -733,22 +756,23 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     if use_valid:
         from mmlspark_tpu.core.sparse import CSRMatrix as _CSR
         if isinstance(valid[0], _CSR):
-            bins_v = jnp.asarray(
-                mapper.transform_sparse(valid[0]).T.astype(np.float32))
+            bins_v_np = mapper.transform_sparse(valid[0]).T \
+                .astype(np.float32)
         else:
-            bins_v = jnp.asarray(
-                mapper.transform(np.asarray(valid[0], dtype=np.float64))
-                .astype(np.float32))
-        yv = jnp.asarray(np.asarray(valid[1], dtype=np.float32))
+            bins_v_np = mapper.transform(
+                np.asarray(valid[0], dtype=np.float64)).astype(np.float32)
+        yv_np = np.asarray(valid[1], dtype=np.float32)
         if multi_host:
             # every host must pass IDENTICAL valid data; lift it (and
             # the running scores below) to replicated global arrays so
             # the per-iteration scoring ops run on the global mesh
             _repl = jax.sharding.NamedSharding(mesh, P())
             bins_v = jax.make_array_from_process_local_data(
-                _repl, np.asarray(bins_v))
-            yv = jax.make_array_from_process_local_data(
-                _repl, np.asarray(yv))
+                _repl, np.ascontiguousarray(bins_v_np))
+            yv = jax.make_array_from_process_local_data(_repl, yv_np)
+        else:
+            bins_v = jnp.asarray(bins_v_np)
+            yv = jnp.asarray(yv_np)
         if base_model is not None:
             v_scores_np = _base_raw_kn(
                 base_model, np.asarray(valid[0], dtype=np.float64), K)

@@ -10,7 +10,13 @@ variable pytrees for the zoo network specs.
 """
 
 from mmlspark_tpu.importers.torch_import import (
-    import_torch_checkpoint, load_torch_file,
+    TORCHVISION_RESNET18_SPEC, TORCHVISION_RESNET34_SPEC,
+    import_torch_checkpoint, import_torchvision_resnet,
+    load_checkpoint_file, load_safetensors_file, load_torch_file,
 )
 
-__all__ = ["import_torch_checkpoint", "load_torch_file"]
+__all__ = [
+    "TORCHVISION_RESNET18_SPEC", "TORCHVISION_RESNET34_SPEC",
+    "import_torch_checkpoint", "import_torchvision_resnet",
+    "load_checkpoint_file", "load_safetensors_file", "load_torch_file",
+]

@@ -15,8 +15,12 @@ Layout conversions (torch -> flax):
   - Embedding weight              -> embedding (unchanged)
 
 Name conventions accepted per family:
-  - resnet: torchvision CIFAR-ResNet style — ``conv1``/``bn1`` stem,
-    ``layer{s+1}.{b}.conv1/bn1/conv2/bn2[/downsample.0/.1]``, ``fc`` head.
+  - resnet: torchvision naming — ``conv1``/``bn1`` stem,
+    ``layer{s+1}.{b}.conv1/bn1/conv2/bn2[/downsample.0/.1]``, ``fc``
+    head. Covers BOTH CIFAR-style stage counts and the PUBLISHED
+    ImageNet checkpoints (``import_torchvision_resnet`` validates a
+    resnet18/34 file against the exact key/shape manifest; .pth and
+    .safetensors both load — see load_checkpoint_file).
   - convnet: ``conv{i}``, ``dense{i}``, ``head``.
   - mlp: ``dense{i}``, ``head``.
 """
@@ -51,6 +55,50 @@ def load_torch_file(path: str) -> Dict[str, Any]:
     if isinstance(obj, dict) and "state_dict" in obj:
         obj = obj["state_dict"]
     return obj
+
+
+_SAFETENSORS_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+
+def load_safetensors_file(path: str) -> Dict[str, np.ndarray]:
+    """Dependency-free safetensors reader (the format hugging-face zoo
+    checkpoints ship in): u64-LE header length, JSON header mapping
+    tensor name -> {dtype, shape, data_offsets}, then raw little-endian
+    tensor bytes. BF16 decodes via ml_dtypes (bundled with jax)."""
+    import json
+    import struct
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode("utf-8"))
+        blob = f.read()
+    out: Dict[str, np.ndarray] = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        lo, hi = meta["data_offsets"]
+        dt = meta["dtype"]
+        if dt == "BF16":
+            import ml_dtypes
+            arr = np.frombuffer(blob[lo:hi], dtype=ml_dtypes.bfloat16)
+        elif dt in _SAFETENSORS_DTYPES:
+            arr = np.frombuffer(blob[lo:hi], dtype=_SAFETENSORS_DTYPES[dt])
+        else:
+            raise ValueError(f"unsupported safetensors dtype {dt!r}")
+        out[name] = arr.reshape(meta["shape"]).astype(np.float32) \
+            if dt in ("F16", "BF16") else arr.reshape(meta["shape"])
+    return out
+
+
+def load_checkpoint_file(path: str) -> Dict[str, Any]:
+    """Extension-dispatched checkpoint reader: .safetensors or torch
+    .pt/.pth/.bin."""
+    if path.endswith(".safetensors"):
+        return load_safetensors_file(path)
+    return load_torch_file(path)
 
 
 class _TreeBuilder:
@@ -281,7 +329,7 @@ def import_torch_checkpoint(state_dict: Any, network_spec: Dict[str, Any],
     first dense kernel needs the conv-stack output shape).
     """
     if isinstance(state_dict, str):
-        state_dict = load_torch_file(state_dict)
+        state_dict = load_checkpoint_file(state_dict)
     kind = network_spec.get("type")
     if kind not in _IMPORTERS:
         raise NotImplementedError(
@@ -319,3 +367,90 @@ def _validate(variables: Dict[str, Any], network_spec: Dict[str, Any],
             f"imported variables do not match module structure:\n"
             f"  missing: {missing}\n  extra: {extra}\n"
             f"  shape mismatches (path, got, want): {bad}")
+
+
+# ---------------------------------------------------------------------------
+# published torchvision ImageNet ResNets (BasicBlock family)
+# ---------------------------------------------------------------------------
+
+# the exact spec whose flax twin (models/networks.ResNet stem='imagenet')
+# reproduces torchvision.models.resnet18 numerics
+TORCHVISION_RESNET18_SPEC: Dict[str, Any] = {
+    "type": "resnet", "stem": "imagenet", "stage_sizes": [2, 2, 2, 2],
+    "width": 64, "num_classes": 1000,
+}
+TORCHVISION_RESNET34_SPEC: Dict[str, Any] = {
+    "type": "resnet", "stem": "imagenet", "stage_sizes": [3, 4, 6, 3],
+    "width": 64, "num_classes": 1000,
+}
+
+
+def _torchvision_manifest(stage_sizes: List[int], num_classes: int
+                          ) -> Dict[str, tuple]:
+    """Key -> shape manifest of a torchvision BasicBlock ResNet
+    state_dict (the published resnet18/34 layout: ``conv1``/``bn1``
+    stem, ``layer{1-4}.{b}.conv1/bn1/conv2/bn2[.downsample.0/.1]``,
+    ``fc``; ref: ModelDownloader.scala:209 — zoo ingestion is anchored
+    on real published checkpoints)."""
+    m: Dict[str, tuple] = {"conv1.weight": (64, 3, 7, 7)}
+    for tag, c in (("bn1", 64),):
+        m[f"{tag}.weight"] = (c,)
+        m[f"{tag}.bias"] = (c,)
+        m[f"{tag}.running_mean"] = (c,)
+        m[f"{tag}.running_var"] = (c,)
+    cin = 64
+    for s, n_blocks in enumerate(stage_sizes):
+        cout = 64 * (2 ** s)
+        for blk in range(n_blocks):
+            t = f"layer{s + 1}.{blk}"
+            stride_block = blk == 0 and s > 0
+            m[f"{t}.conv1.weight"] = (cout, cin if blk == 0 else cout,
+                                      3, 3)
+            m[f"{t}.conv2.weight"] = (cout, cout, 3, 3)
+            for bn in ("bn1", "bn2"):
+                for suffix in ("weight", "bias", "running_mean",
+                               "running_var"):
+                    m[f"{t}.{bn}.{suffix}"] = (cout,)
+            if blk == 0 and (stride_block or cin != cout):
+                m[f"{t}.downsample.0.weight"] = (cout, cin, 1, 1)
+                for suffix in ("weight", "bias", "running_mean",
+                               "running_var"):
+                    m[f"{t}.downsample.1.{suffix}"] = (cout,)
+        cin = cout
+    m["fc.weight"] = (num_classes, cin)
+    m["fc.bias"] = (num_classes,)
+    return m
+
+
+def import_torchvision_resnet(source: Any,
+                              spec: Optional[Dict[str, Any]] = None
+                              ) -> Dict[str, Any]:
+    """Import a PUBLISHED torchvision BasicBlock-ResNet checkpoint
+    (resnet18 by default; pass TORCHVISION_RESNET34_SPEC for resnet34).
+
+    ``source`` is a state_dict, .pth, or .safetensors path. The
+    checkpoint is validated against the torchvision key/shape manifest
+    BEFORE conversion, so a wrong or truncated download fails with the
+    offending keys rather than a cryptic import error. Returns flax
+    variables for ``build_network(spec)`` — serve through TPUModel /
+    ImageFeaturizer like any zoo model (examples/301, 305)."""
+    spec = dict(spec or TORCHVISION_RESNET18_SPEC)
+    if isinstance(source, str):
+        source = load_checkpoint_file(source)
+    manifest = _torchvision_manifest(list(spec["stage_sizes"]),
+                                     int(spec["num_classes"]))
+    got = {k: tuple(np.asarray(_to_numpy(v)).shape)
+           for k, v in source.items()
+           if not k.endswith("num_batches_tracked")}
+    missing = sorted(set(manifest) - set(got))
+    extra = sorted(set(got) - set(manifest))
+    bad = [(k, got[k], manifest[k]) for k in got
+           if k in manifest and got[k] != manifest[k]]
+    if missing or extra or bad:
+        raise ValueError(
+            f"not a torchvision ResNet{'18' if spec['stage_sizes'] == [2, 2, 2, 2] else ''} "
+            f"state_dict:\n  missing: {missing[:6]}\n"
+            f"  unexpected: {extra[:6]}\n"
+            f"  shape mismatches (key, got, want): {bad[:6]}")
+    return import_torch_checkpoint(source, spec, strict=True,
+                                   validate_input_shape=[224, 224, 3])

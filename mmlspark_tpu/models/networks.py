@@ -125,20 +125,43 @@ class ResNetBlock(nn.Module):
 
 
 class ResNet(nn.Module):
-    """CIFAR-style ResNet (6n+2): stage_sizes=(3,3,3) -> ResNet-20."""
+    """ResNet family. ``stem='cifar'`` (default) is the CIFAR 6n+2
+    style: 3x3 stem, stage_sizes=(3,3,3) -> ResNet-20.
+    ``stem='imagenet'`` reproduces the torchvision ImageNet layout
+    bit-for-bit (7x7/stride-2/pad-3 stem + BatchNorm + 3x3/stride-2
+    maxpool with pad 1; stage_sizes=(2,2,2,2), width=64,
+    num_classes=1000 -> torchvision resnet18) so published torchvision
+    BasicBlock checkpoints import losslessly
+    (importers/torch_import.py; ref: ModelDownloader.scala:209 — the
+    reference's zoo is anchored on real published CNNs)."""
 
     stage_sizes: Sequence[int] = (3, 3, 3)
     width: int = 16
     num_classes: int = 10
+    stem: str = "cifar"      # 'cifar' | 'imagenet'
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False, capture: Optional[str] = None):
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (3, 3), use_bias=False, dtype=self.dtype,
-                    name="stem")(x)
-        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x)
-        x = nn.relu(x)
+        if self.stem == "imagenet":
+            # torchvision: Conv2d(7, stride 2, padding 3) -> BN -> ReLU
+            # -> MaxPool2d(3, stride 2, padding 1), with -inf padding so
+            # the pooled border matches torch exactly
+            x = nn.Conv(self.width, (7, 7), (2, 2),
+                        padding=((3, 3), (3, 3)), use_bias=False,
+                        dtype=self.dtype, name="stem")(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=((1, 1), (1, 1)))
+        else:
+            x = nn.Conv(self.width, (3, 3), use_bias=False,
+                        dtype=self.dtype, name="stem")(x)
+            x = nn.BatchNorm(use_running_average=not train,
+                             dtype=self.dtype)(x)
+            x = nn.relu(x)
         for s, n_blocks in enumerate(self.stage_sizes):
             for b in range(n_blocks):
                 strides = (2, 2) if (s > 0 and b == 0) else (1, 1)
