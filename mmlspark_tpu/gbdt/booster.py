@@ -610,37 +610,37 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     n_padded = n + pad
     # features-major (F, N) layout: per-split column reads become
     # contiguous rows and the Pallas kernel consumes it directly (see
-    # tree.grow_tree docstring). Dense serial-mode inputs are binned ON
-    # DEVICE (the transform + transpose of 1M+ rows would serialize on
-    # the host) when the bin boundaries survive the float32 cast;
-    # large-magnitude features (>24-bit mantissa, e.g. unix timestamps)
-    # collapse adjacent f32 boundaries and fall back to f64 host
-    # binning. Data-parallel mode also bins on host so each device only
-    # ever receives its own shard.
+    # tree.grow_tree docstring). Binning happens on HOST (the native
+    # OpenMP kernel; f64-exact for every feature scale) and the NARROW
+    # bin matrix ships to the device — at max_bin<=255 that is uint8,
+    # 4x fewer bytes than the f32 feature matrix, measured 2-4x faster
+    # and far less variable through the host->device link than shipping
+    # raw features for on-device binning.
     # record f32 safety on the model so inference picks the right walk
     # (warm start below ORs in the base model's flag)
     p["f32_unsafe"] = not mapper.f32_safe()
-    if bins_np is None and (data_parallel or feature_parallel
-                            or not mapper.f32_safe()):
+    if bins_np is None:
         bins_np = mapper.transform(X)
     # feature-parallel shards the (F, N) feature dim: pad F to the shard
     # count with always-masked dummy features (fmask 0 keeps them out of
     # every split search)
     f_pad = (-f) % n_shards if feature_parallel else 0
     f_eff = f + f_pad
-    if bins_np is None:
-        ub = jnp.asarray(mapper.threshold_matrix(num_bins), jnp.float32)
-        bins_dev = _device_binning(jnp.asarray(X, jnp.float32), ub, pad)
-    else:
-        if pad:
-            bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
-        bins_t = np.ascontiguousarray(bins_np.T)
-        if f_pad:
-            bins_t = np.pad(bins_t, ((0, f_pad), (0, 0)))
+    if pad:
+        bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
+    bins_t = np.ascontiguousarray(bins_np.T)
+    if f_pad:
+        bins_t = np.pad(bins_t, ((0, f_pad), (0, 0)))
+    if multi_host:
         # multi-host keeps numpy — the global array is assembled from
         # per-process shards below
-        bins_dev = (bins_t.astype(np.int32) if multi_host
-                    else jnp.asarray(bins_t, jnp.int32))
+        bins_dev = bins_t.astype(np.int32)
+    else:
+        narrow = (np.uint8 if num_bins <= 256
+                  else np.int16 if num_bins <= 32767 else np.int32)
+        # narrow dtype crosses the host->device link; the widen runs on
+        # device (eager asarray+astype — no per-call retrace)
+        bins_dev = jnp.asarray(bins_t.astype(narrow)).astype(jnp.int32)
 
     # 3) init scores — fresh start or warm start from a base forest
     base_model: Optional[Booster] = None
@@ -938,26 +938,6 @@ def _base_raw_kn(base_model: Booster, X: np.ndarray, K: int) -> np.ndarray:
     if K == 1:
         raw = raw[None, :]
     return np.asarray(raw, dtype=np.float32)
-
-
-@functools.partial(jax.jit, static_argnames=("pad",))
-def _device_binning(X: jnp.ndarray, ub: jnp.ndarray, pad: int):
-    """Raw (N, F) f32 features -> (F, N+pad) int32 bins ON DEVICE.
-
-    bin = #{bounds < x} (searchsorted 'left'), computed per feature as a
-    compare-reduce; NaN compares false everywhere -> bin 0, matching the
-    host BinMapper. Run on TPU so the 1M-row transform and the
-    features-major transpose never touch the (single-core) host."""
-    xt = X.T                                        # (F, N)
-
-    def one(args):
-        row, bounds = args
-        return (row[:, None] > bounds[None, :]).sum(-1).astype(jnp.int32)
-
-    bins = lax.map(one, (xt, ub))
-    if pad:
-        bins = jnp.pad(bins, ((0, 0), (0, pad)))
-    return bins
 
 
 def _pad_nodes(v: np.ndarray, m: int, key: str) -> np.ndarray:
