@@ -54,7 +54,8 @@ DEFAULTS: Dict[str, Any] = {
     "alpha": 0.9,                      # quantile / huber
     "tweedie_variance_power": 1.5,
     "hist_method": "auto",  # 'auto' | 'scatter' | 'onehot' | 'pallas'
-    "parallelism": "serial",   # 'serial' | 'data' | 'feature'
+    "parallelism": "serial",  # 'serial' | 'data' | 'feature' | 'voting'
+    "top_k": 20,               # voting-parallel candidates per worker
 }
 
 
@@ -497,7 +498,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # LightGBM worker-partition flow, ref: TrainUtils.scala:188-214)
     from mmlspark_tpu.parallel import distributed as dist
     proc_info = dist.host_info()
-    multi_host = (p["parallelism"] == "data"
+    multi_host = (p["parallelism"] in ("data", "voting")
                   and proc_info.process_count > 1)
     if p["parallelism"] == "feature" and proc_info.process_count > 1:
         raise NotImplementedError(
@@ -562,7 +563,9 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     num_bins = int(mapper.num_bins.max())
 
     # 2) parallel layout (tree_learner modes, ref: TrainParams.scala:26)
-    data_parallel = p["parallelism"] == "data"
+    # voting shards rows exactly like data-parallel; only the per-split
+    # collective differs (tree.grow_tree best_split_voting)
+    data_parallel = p["parallelism"] in ("data", "voting")
     feature_parallel = p["parallelism"] == "feature"
     axis_name = None
     n_shards = 1
@@ -694,7 +697,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         max_depth=int(p["max_depth"]),
         lambda_l1=float(p["lambda_l1"]), lambda_l2=float(p["lambda_l2"]),
         min_gain_to_split=float(p["min_gain_to_split"]),
-        hist_method=p["hist_method"])
+        hist_method=p["hist_method"],
+        voting_k=int(p["top_k"]))
     lr = float(p["learning_rate"])
 
     # jitted-step cache: keyed by objective config (not instance) so
@@ -704,7 +708,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         (p["objective"], K, float(p["alpha"]),
          float(p["tweedie_variance_power"])),
         gp, lr, K, axis_name, mesh,
-        "feature" if feature_parallel else "data")
+        p["parallelism"] if p["parallelism"] in ("feature", "voting")
+        else "data")
 
     scores_np = (base_scores if base_model is not None
                  else np.broadcast_to(

@@ -59,11 +59,13 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         "device histogram strategy ('auto' = pallas MXU kernel on TPU, "
         "scatter elsewhere)", default="auto")
     parallelism = EnumParam(
-        ["serial", "data", "feature"],
+        ["serial", "data", "feature", "voting"],
         "tree learner parallelism: 'data' shards rows, 'feature' shards "
-        "the feature axis — the wide-data mode "
-        "(ref: TrainParams.scala:26 tree_learner=data/feature)",
+        "the feature axis (the wide-data mode), 'voting' shards rows "
+        "but allreduces only voted candidate histograms (PV-tree) "
+        "(ref: TrainParams.scala:26 tree_learner=data/feature/voting)",
         default="serial")
+    topK = IntParam("voting-parallel candidates per worker", default=20)
     validationData = TableParam("held-out table for early stopping",
                                 default=None)
     initModelString = StringParam(
@@ -91,6 +93,7 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
             "seed": self.get("seed"),
             "hist_method": self.get("histMethod"),
             "parallelism": self.get("parallelism"),
+            "top_k": self.get("topK"),
         }
 
     def _features_matrix(self, table: DataTable) -> np.ndarray:
