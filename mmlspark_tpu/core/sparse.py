@@ -168,19 +168,27 @@ class CSRMatrix:
         """Column-concatenate with CSRMatrix / dense-2D blocks."""
         return hstack([self] + list(others))
 
-    def padded_batch(self, start: int, stop: int, max_nnz: int
+    def padded_batch(self, start: int, stop: int, max_nnz: int,
+                     allow_truncate: bool = False
                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """Rows [start, stop) as fixed-shape (B, max_nnz) ``indices`` /
         ``values`` with zero-padding (value 0 contributes nothing to a
         gather-accumulate) — the static-shape feed the jitted sparse
-        matmul consumes. Rows with more than ``max_nnz`` nonzeros keep
-        the first ``max_nnz`` (callers pick max_nnz from
+        matmul consumes. Rows with more than ``max_nnz`` nonzeros raise
+        unless ``allow_truncate`` (then the first ``max_nnz`` are kept —
+        silent feature loss otherwise; callers pick max_nnz from
         :meth:`max_row_nnz`)."""
         b = stop - start
         idx = np.zeros((b, max_nnz), np.int32)
         val = np.zeros((b, max_nnz), np.float32)
-        counts = np.minimum(
-            np.diff(self.indptr[start:stop + 1]), max_nnz).astype(np.int64)
+        row_nnz = np.diff(self.indptr[start:stop + 1])
+        if not allow_truncate and row_nnz.size and row_nnz.max() > max_nnz:
+            raise ValueError(
+                f"padded_batch(max_nnz={max_nnz}) would silently drop "
+                f"{int(np.maximum(row_nnz - max_nnz, 0).sum())} nonzeros "
+                f"(densest row has {int(row_nnz.max())}); raise max_nnz "
+                f"(see max_row_nnz()) or pass allow_truncate=True")
+        counts = np.minimum(row_nnz, max_nnz).astype(np.int64)
         nnz = int(counts.sum())
         within = (np.arange(nnz)
                   - np.repeat(np.cumsum(counts) - counts, counts))

@@ -48,13 +48,18 @@ class BinMapper:
     @staticmethod
     def fit(X: np.ndarray, max_bin: int = 255,
             sample_cnt: int = 200_000, seed: int = 2) -> "BinMapper":
-        X_full = X = np.asarray(X, dtype=np.float64)
-        n, f = X.shape
+        # sample BEFORE the f64 conversion: converting f32->f64 is exact
+        # per value, so boundaries are identical to converting the full
+        # matrix first — without materializing a second full-size copy
+        X_full = np.asarray(X)
+        n, f = X_full.shape
         sampled_idx = None
         if n > sample_cnt:
             rng = np.random.default_rng(seed)
             sampled_idx = rng.choice(n, size=sample_cnt, replace=False)
-            X = X[sampled_idx]
+            X = np.asarray(X_full[sampled_idx], dtype=np.float64)
+        else:
+            X = np.asarray(X_full, dtype=np.float64)
         results = [_feature_bounds(X[:, j], max_bin) for j in range(f)]
         bounds = [b for b, _ in results]
         safe = all(ok for _, ok in results)
@@ -63,28 +68,10 @@ class BinMapper:
             # unsampled rows inside a cut's f32 rounding band could still
             # flip one bin on the f32 device path. Spot-check a holdout of
             # unsampled rows: if any bins differently in f32, drop to f64.
-            mask = np.ones(n, dtype=bool)
-            mask[sampled_idx] = False
-            rest = np.flatnonzero(mask)
-            if len(rest) > 50_000:
-                rest = rng.choice(rest, size=50_000, replace=False)
+            rest = _holdout_rows(n, sampled_idx, rng)
             hold = X_full[rest]
-            for j, ub in enumerate(bounds):
-                if not len(ub):
-                    continue
-                col = hold[:, j]
-                ok = ~np.isnan(col)   # NaN maps to bin 0 in either dtype
-                b64 = np.searchsorted(ub, col[ok], side="left")
-                b32 = np.searchsorted(ub.astype(np.float32),
-                                      col[ok].astype(np.float32),
-                                      side="left")
-                if not np.array_equal(b64, b32):
-                    import logging
-                    logging.getLogger("mmlspark_tpu.gbdt").info(
-                        "feature %d: unsampled rows bin differently in "
-                        "f32; using the f64 binning path", j)
-                    safe = False
-                    break
+            safe = _holdout_f32_agrees(
+                bounds, ((j, hold[:, j]) for j in range(f)))
         return BinMapper(bounds, max_bin, f32_values_safe=safe)
 
     @staticmethod
@@ -94,11 +81,20 @@ class BinMapper:
         nonzeros come from a one-shot CSC view and the implicit zeros
         enter the frequency histogram analytically, so no dense float
         matrix ever exists (the LGBM_DatasetCreateFromCSR analog,
-        ref: LightGBMUtils.scala:283-351)."""
-        n = csr.shape[0]
+        ref: LightGBMUtils.scala:283-351).
+
+        f32 safety mirrors the dense fit: the gap check runs on the
+        sample, and when sampling occurred a holdout of UNSAMPLED rows
+        is spot-checked (f32 vs f64 binning) before the f32 inference
+        walk is allowed."""
+        full = csr
+        n_full = csr.shape[0]
+        n = n_full
+        sampled_idx = None
         if n > sample_cnt:
             rng = np.random.default_rng(seed)
-            csr = csr.take(rng.choice(n, size=sample_cnt, replace=False))
+            sampled_idx = rng.choice(n, size=sample_cnt, replace=False)
+            csr = csr.take(sampled_idx)
             n = sample_cnt
         col_ptr, _, vals = csr.csc()
         bounds: List[np.ndarray] = []
@@ -120,6 +116,16 @@ class BinMapper:
                                         counts, max_bin)
             bounds.append(b)
             safe = safe and ok
+        if safe and sampled_idx is not None:
+            # same unsampled-row holdout discipline as the dense fit:
+            # values inside a cut's f32 rounding band flip one bin on
+            # the f32 device path — verify none exist before claiming
+            # f32 safety (fall back to the f64 walk otherwise)
+            rest = _holdout_rows(n_full, sampled_idx, rng)
+            hold_ptr, _, hold_vals = full.take(rest).csc()
+            safe = _holdout_f32_agrees(
+                bounds, ((j, hold_vals[hold_ptr[j]:hold_ptr[j + 1]])
+                         for j in range(csr.shape[1])))
         return BinMapper(bounds, max_bin, f32_values_safe=safe)
 
     def transform_sparse(self, csr) -> np.ndarray:
@@ -162,6 +168,26 @@ class BinMapper:
             binned[np.isnan(col)] = 0
             out[:, j] = binned
         return out
+
+    def transform_fm(self, X: np.ndarray) -> np.ndarray:
+        """Raw features -> FEATURES-MAJOR (F, N) bins, the GBDT engine's
+        ship layout. Fast path: the fused native kernel bins f32/f64
+        input straight into transposed uint8 (one pass instead of
+        transform + transpose + narrow — three full sweeps at HIGGS
+        scale). Falls back to transform(X).T (int32) when the native
+        kernel or the <=256-bin precondition is unavailable. f32 input
+        widens per-value to f64 before the boundary compare, so results
+        are bit-identical to the f64 path."""
+        try:
+            from mmlspark_tpu.native import loader as native
+            if native.available():
+                out = native.apply_bins_t_u8(X, self.upper_bounds)
+                if out is not None:
+                    return out
+        except Exception:  # noqa: BLE001 — native is only an accelerator
+            pass
+        return np.ascontiguousarray(
+            self.transform(np.asarray(X, dtype=np.float64)).T)
 
     def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
         """The raw-value threshold for 'go left if bin <= bin_idx':
@@ -212,6 +238,39 @@ class BinMapper:
                          f32_values_safe=d.get("f32_values_safe", False))
 
 
+def _holdout_rows(n: int, sampled_idx: np.ndarray, rng) -> np.ndarray:
+    """Up to 50k row indices that the fit sample did NOT cover."""
+    mask = np.ones(n, dtype=bool)
+    mask[sampled_idx] = False
+    rest = np.flatnonzero(mask)
+    if len(rest) > 50_000:
+        rest = rng.choice(rest, size=50_000, replace=False)
+    return rest
+
+
+def _holdout_f32_agrees(bounds, feature_values) -> bool:
+    """Shared f32-safety spot check (dense and sparse fit paths):
+    ``feature_values`` yields (feature_idx, holdout values); True when
+    every value bins identically under f64 and f32 boundaries (NaN is
+    excluded — it maps to bin 0 in either dtype)."""
+    for j, col in feature_values:
+        ub = bounds[j]
+        if not len(ub):
+            continue
+        v = np.asarray(col)
+        v = v[~np.isnan(v)]
+        b64 = np.searchsorted(ub, v, side="left")
+        b32 = np.searchsorted(ub.astype(np.float32),
+                              v.astype(np.float32), side="left")
+        if not np.array_equal(b64, b32):
+            import logging
+            logging.getLogger("mmlspark_tpu.gbdt").info(
+                "feature %d: unsampled rows bin differently in f32; "
+                "using the f64 binning path", j)
+            return False
+    return True
+
+
 _EPS32 = float(np.finfo(np.float32).eps)
 
 
@@ -244,19 +303,21 @@ def _bounds_from_counts(distinct: np.ndarray, counts: np.ndarray,
         ok = all(_cut_f32_ok(a, b)
                  for a, b in zip(distinct[:-1], distinct[1:]))
         return (distinct[:-1] + distinct[1:]) / 2.0, ok
-    # equal-frequency: walk cumulative counts, cut when a bin's quota fills
-    total = counts.sum()
-    per_bin = total / max_bin
+    # equal-frequency: cut where the cumulative count fills a bin's
+    # quota. O(max_bin·log d) — one searchsorted per CUT, not a Python
+    # walk over every distinct value (same arithmetic: cum[i] is exactly
+    # the f64 the old accumulating loop held, counts being integers)
+    cum = np.cumsum(counts)
+    per_bin = cum[-1] / max_bin
     bounds = []
     ok = True
-    acc = 0.0
+    last = len(distinct) - 1
     target = per_bin
-    for i in range(len(distinct) - 1):
-        acc += counts[i]
-        if acc >= target:
-            bounds.append((distinct[i] + distinct[i + 1]) / 2.0)
-            ok = ok and _cut_f32_ok(distinct[i], distinct[i + 1])
-            target = acc + per_bin
-            if len(bounds) == max_bin - 1:
-                break
+    while len(bounds) < max_bin - 1:
+        i = int(np.searchsorted(cum, target, side="left"))
+        if i >= last:
+            break
+        bounds.append((distinct[i] + distinct[i + 1]) / 2.0)
+        ok = ok and _cut_f32_ok(distinct[i], distinct[i + 1])
+        target = cum[i] + per_bin
     return np.asarray(bounds), ok

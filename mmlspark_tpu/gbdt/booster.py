@@ -75,6 +75,9 @@ class Booster:
         self.best_iteration = int(best_iteration)
         self.tree_depths = list(tree_depths or [])
         self._f64_flag: Optional[bool] = None   # _needs_f64_inference cache
+        # per-phase fit wall seconds (set by train(); empty for loaded
+        # models): {bin, ship, first_iter, boost, fetch}
+        self.train_timing: Dict[str, float] = {}
 
     # -- inference ----------------------------------------------------------
 
@@ -332,11 +335,17 @@ def _multihost_mapper(X, streaming: bool, max_bin: int, seed: int,
                             else np.asarray(X)[idx], dtype=np.float64)
     s_len = int(np.min(np.asarray(multihost_utils.process_allgather(
         np.asarray([len(sample)]))).ravel()))
-    # f32 on the wire (the collective's default dtype); boundaries stay
-    # identical everywhere because every host fits the same bytes
-    gathered = np.asarray(multihost_utils.process_allgather(
-        np.ascontiguousarray(sample[:s_len], dtype=np.float32)))
-    gathered = gathered.reshape(-1, sample.shape[1]).astype(np.float64)
+    # f64 BIT-EXACT on the wire: the collective layer would silently
+    # downcast float64 to f32 (jax x64 is off), so ship the raw bits as
+    # uint32 pairs and reinterpret after the gather. An f32 wire would
+    # let an f32-unsafe feature (timestamps, 2^24-scale IDs) bin
+    # differently multi-host vs single-host — the exact failure class
+    # the f64 host-binning work eliminated elsewhere.
+    wire = np.ascontiguousarray(
+        sample[:s_len], dtype=np.float64).view(np.uint32)
+    gathered = np.ascontiguousarray(np.asarray(
+        multihost_utils.process_allgather(wire)))
+    gathered = gathered.reshape(-1, wire.shape[1]).view(np.float64)
     return BinMapper.fit(gathered, max_bin=max_bin,
                          sample_cnt=len(gathered), seed=seed)
 
@@ -446,7 +455,22 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     run continues from the given forest's scores and the returned
     Booster carries old + new trees (ref: TrainUtils.scala:74-77
     modelString warm start). Requires dense ``X`` (the base forest is
-    scored on the raw features)."""
+    scored on the raw features).
+
+    The returned Booster carries ``train_timing``: per-phase wall
+    seconds {bin, ship, first_iter (compile+exec), boost, fetch} so
+    bench drift is attributable to a phase (host binning contention vs
+    link bandwidth vs recompile vs device loop)."""
+    import time as _time
+    _phases: Dict[str, float] = {}
+    _t_phase = _time.perf_counter()
+
+    def _mark(name: str) -> None:
+        nonlocal _t_phase
+        now = _time.perf_counter()
+        _phases[name] = _phases.get(name, 0.0) + (now - _t_phase)
+        _t_phase = now
+
     p = dict(DEFAULTS)
     p.update(params or {})
     if p["hist_method"] == "auto":
@@ -500,10 +524,21 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     proc_info = dist.host_info()
     multi_host = (p["parallelism"] in ("data", "voting")
                   and proc_info.process_count > 1)
-    if p["parallelism"] == "feature" and proc_info.process_count > 1:
-        raise NotImplementedError(
-            "tree_learner='feature' currently shards features within "
-            "one process's mesh; use parallelism='data' across hosts")
+    # multi-host feature-parallel follows LightGBM's feature-parallel
+    # data layout: EVERY worker holds the full dataset (rows replicated)
+    # and owns a feature shard — LightGBM deliberately avoids the
+    # split-partition broadcast this way (ref: TrainParams.scala:26
+    # tree_learner=feature; docs "feature parallel ... every worker
+    # holds the full data"). Each process therefore passes the same
+    # full X; bin-boundary agreement is verified below.
+    multi_host_fp = (p["parallelism"] == "feature"
+                     and proc_info.process_count > 1)
+    if multi_host_fp and streaming:
+        raise ValueError(
+            "multi-host tree_learner='feature' requires the full dense "
+            "dataset on every process (LightGBM's feature-parallel "
+            "layout); stream ingestion only supports "
+            "parallelism='data'/'voting' across hosts")
     if p["parallelism"] == "serial" and proc_info.process_count > 1:
         import logging
         logging.getLogger("mmlspark_tpu.gbdt").warning(
@@ -550,7 +585,12 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             # the ascontiguousarray(bins_np.T) below
             bins_np = mapper.transform_sparse(X).T
         else:
-            X = np.asarray(X, dtype=np.float64)
+            # f32 input stays f32: the binning fast path widens values
+            # per-compare (exact), so the 2x-size f64 matrix copy never
+            # materializes for the common float32 dataset
+            X = np.asarray(X)
+            if X.dtype not in (np.float32, np.float64):
+                X = X.astype(np.float64)
             n, f = X.shape
             w_base = (np.ones(n) if sample_weight is None
                       else np.asarray(sample_weight, dtype=np.float64))
@@ -561,6 +601,35 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     if feature_names is None:
         feature_names = [f"Column_{i}" for i in range(f)]
     num_bins = int(mapper.num_bins.max())
+    if multi_host_fp:
+        # every host fit its mapper on its own copy of the (supposedly
+        # identical) full dataset — verify instead of trusting. The
+        # digest covers shape, boundaries, labels/weights AND a strided
+        # row sample of X itself: boundaries alone are row-ORDER
+        # invariant, so a permuted copy would pass a boundary-only check
+        # and then silently corrupt every split (the psum-broadcast row
+        # bitmap is computed in the owner's row order)
+        import hashlib
+        from jax.experimental import multihost_utils
+        h = hashlib.sha256()
+        h.update(np.asarray([n, f], np.int64).tobytes())
+        for u in mapper.upper_bounds:
+            h.update(u.tobytes())
+        h.update(np.ascontiguousarray(y).tobytes())
+        h.update(np.ascontiguousarray(w_base).tobytes())
+        stride = max(1, n // 1024)
+        h.update(np.ascontiguousarray(
+            np.asarray(X)[::stride]).tobytes())
+        mine = np.frombuffer(h.digest(), np.uint8)
+        alld = np.asarray(multihost_utils.process_allgather(mine))
+        alld = alld.reshape(proc_info.process_count, -1)
+        if not (alld == alld[0]).all():
+            raise ValueError(
+                "hosts disagree on the dataset (shape, bin boundaries, "
+                "labels, or row content/order): multi-host "
+                "tree_learner='feature' requires every process to pass "
+                "the IDENTICAL full dataset (LightGBM feature-parallel "
+                "layout)")
 
     # 2) parallel layout (tree_learner modes, ref: TrainParams.scala:26)
     # voting shards rows exactly like data-parallel; only the per-split
@@ -622,28 +691,36 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # record f32 safety on the model so inference picks the right walk
     # (warm start below ORs in the base model's flag)
     p["f32_unsafe"] = not mapper.f32_safe()
-    if bins_np is None:
-        bins_np = mapper.transform(X)
     # feature-parallel shards the (F, N) feature dim: pad F to the shard
     # count with always-masked dummy features (fmask 0 keeps them out of
     # every split search)
     f_pad = (-f) % n_shards if feature_parallel else 0
     f_eff = f + f_pad
-    if pad:
-        bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
-    bins_t = np.ascontiguousarray(bins_np.T)
-    if f_pad:
-        bins_t = np.pad(bins_t, ((0, f_pad), (0, 0)))
-    if multi_host:
+    if bins_np is None:
+        # dense path: fused native bin+transpose+narrow straight into
+        # the (F, N) ship layout (uint8 when bins fit)
+        bins_t = mapper.transform_fm(X)
+        if pad or f_pad:
+            bins_t = np.pad(bins_t, ((0, f_pad), (0, pad)))
+    else:
+        if pad:
+            bins_np = np.pad(bins_np, ((0, pad), (0, 0)))
+        bins_t = np.ascontiguousarray(bins_np.T)
+        if f_pad:
+            bins_t = np.pad(bins_t, ((0, f_pad), (0, 0)))
+    _mark("bin")   # mapper fit + host binning + (F, N) layout
+    if multi_host or multi_host_fp:
         # multi-host keeps numpy — the global array is assembled from
-        # per-process shards below
+        # per-process shards (or served via callback) below
         bins_dev = bins_t.astype(np.int32)
     else:
         narrow = (np.uint8 if num_bins <= 256
                   else np.int16 if num_bins <= 32767 else np.int32)
         # narrow dtype crosses the host->device link; the widen runs on
-        # device (eager asarray+astype — no per-call retrace)
-        bins_dev = jnp.asarray(bins_t.astype(narrow)).astype(jnp.int32)
+        # device (eager asarray+astype — no per-call retrace). copy=False:
+        # the fused native path already produced uint8
+        bins_dev = jnp.asarray(
+            bins_t.astype(narrow, copy=False)).astype(jnp.int32)
 
     # 3) init scores — fresh start or warm start from a base forest
     base_model: Optional[Booster] = None
@@ -738,17 +815,34 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             jnp.asarray(scores_np, jnp.float32),
             jax.sharding.NamedSharding(mesh, P(None, mesh_lib.DATA_AXIS)))
     elif feature_parallel:
+        col_sh = jax.sharding.NamedSharding(
+            mesh, P(mesh_lib.DATA_AXIS, None))   # FEATURES on axis
         repl = jax.sharding.NamedSharding(mesh, P())
-        bins_d = jax.device_put(
-            bins_dev,
-            jax.sharding.NamedSharding(
-                mesh, P(mesh_lib.DATA_AXIS, None)))   # FEATURES on axis
-        y_d = jax.device_put(jnp.asarray(y_pad, jnp.float32), repl)
-        scores = jax.device_put(jnp.asarray(scores_np, jnp.float32), repl)
+        if multi_host_fp:
+            # every host holds the full (F, N) matrix; serve each device
+            # its feature-shard via callback (process-order assumptions
+            # of make_array_from_process_local_data don't apply — the
+            # callback answers whatever index a local device owns)
+            bins_host = bins_dev
+            y_host = np.asarray(y_pad, np.float32)
+            sc_host = np.ascontiguousarray(scores_np, np.float32)
+            bins_d = jax.make_array_from_callback(
+                bins_host.shape, col_sh, lambda idx: bins_host[idx])
+            y_d = jax.make_array_from_callback(
+                y_host.shape, repl, lambda idx: y_host[idx])
+            scores = jax.make_array_from_callback(
+                sc_host.shape, repl, lambda idx: sc_host[idx])
+        else:
+            bins_d = jax.device_put(bins_dev, col_sh)
+            y_d = jax.device_put(jnp.asarray(y_pad, jnp.float32), repl)
+            scores = jax.device_put(
+                jnp.asarray(scores_np, jnp.float32), repl)
     else:
         bins_d = bins_dev
         y_d = jnp.asarray(y_pad, jnp.float32)
         scores = jnp.asarray(scores_np, jnp.float32)
+    jax.block_until_ready((bins_d, y_d, scores))
+    _mark("ship")   # narrow host->device transfer + placement
 
     rng = np.random.default_rng(p["seed"])
 
@@ -767,7 +861,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             bins_v_np = mapper.transform(
                 np.asarray(valid[0], dtype=np.float64)).astype(np.float32)
         yv_np = np.asarray(valid[1], dtype=np.float32)
-        if multi_host:
+        if multi_host or multi_host_fp:
             # every host must pass IDENTICAL valid data; lift it (and
             # the running scores below) to replicated global arrays so
             # the per-iteration scoring ops run on the global mesh
@@ -785,7 +879,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             v_scores_np = np.broadcast_to(
                 np.asarray(init_score, np.float32)[:, None],
                 (K, bins_v.shape[0]))
-        if multi_host:
+        if multi_host or multi_host_fp:
             v_scores = jax.make_array_from_process_local_data(
                 _repl, np.ascontiguousarray(v_scores_np, np.float32))
         else:
@@ -815,7 +909,7 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # numpy buffers in multi-host mode: jit treats them as replicated
     # inputs on the global mesh (a committed local jnp array would not
     # be addressable across processes)
-    _zeros = np.zeros if multi_host else jnp.zeros
+    _zeros = np.zeros if (multi_host or multi_host_fp) else jnp.zeros
     forest = Tree(**{fld: _zeros((t_cap, M), dt)
                      for fld, dt in _f_dtypes.items()})
 
@@ -826,6 +920,11 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             return jax.make_array_from_process_local_data(
                 jax.sharding.NamedSharding(mesh, P(mesh_lib.DATA_AXIS)),
                 np.asarray(w_np, np.float32))
+        if multi_host_fp:   # rows replicated on the global mesh
+            w_host = np.asarray(w_np, np.float32)
+            return jax.make_array_from_callback(
+                w_host.shape, jax.sharding.NamedSharding(mesh, P()),
+                lambda idx: w_host[idx])
         return _maybe_shard(jnp.asarray(w_np, jnp.float32), mesh,
                             data_parallel)
 
@@ -852,6 +951,9 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         scores, forest = step_fn(bins_d, scores, y_d, w_d, fmask,
                                  forest, np.int32(it * K))
         trees_done = (it + 1) * K
+        if it == 0:
+            jax.block_until_ready(scores)
+            _mark("first_iter")   # compile (unless cached) + first tree
 
         if use_valid:
             row = np.int32(it * K)
@@ -886,6 +988,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                 if stop:
                     break
 
+    jax.block_until_ready(scores)
+    _mark("boost")   # iterations 2..n of the jitted loop
     if trees_done:
         # one device->host transfer for the whole forest
         host = jax.device_get(forest._asdict())
@@ -913,9 +1017,12 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                        + tree_depths)
         if best_iter > 0:
             best_iter += base_eff_trees // K
-    return Booster(objective, stacked, init_score, K, feature_names, p,
-                   best_iteration=best_iter if esr > 0 else -1,
-                   tree_depths=tree_depths)
+    booster = Booster(objective, stacked, init_score, K, feature_names, p,
+                      best_iteration=best_iter if esr > 0 else -1,
+                      tree_depths=tree_depths)
+    _mark("fetch")   # forest D2H + threshold conversion
+    booster.train_timing = {k: round(v, 3) for k, v in _phases.items()}
+    return booster
 
 
 def _host_predict_trees(X: np.ndarray, trees: Dict[str, np.ndarray],

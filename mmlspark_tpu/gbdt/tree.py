@@ -116,7 +116,14 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
       all_gather'd and only those candidates' histograms allreduce —
       LightGBM's parallel-voting tree (PV-tree) scheme, cutting the
       per-split collective from O(F·B) to O(devices·k·B) on wide data.
-      Exact whenever devices·k >= F (every feature is a candidate).
+      Exact split SEARCH when voting_k >= F — every worker votes every
+      feature, so the candidate union is all of them and the search
+      equals data-parallel's (root splits bitwise; deeper nodes up to
+      f32 reassociation of the sibling-subtraction cache, which can
+      flip near-ties whose gains differ by ~1e-6 relative).
+      (devices·k >= F with k < F is NOT sufficient: workers' top-k
+      votes can overlap, shrinking the union below F and possibly
+      missing the true best split.)
     """
     f, n = bins.shape
     L = p.num_leaves
@@ -138,12 +145,20 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                             axis_name=hist_axis)       # (3, 1, F, B)
         return h[:, 0]
 
-    def best_split_voting(hist, depth_ok):
+    def best_split_voting(hist, depth_ok, hist_sub=None):
         """PV-tree split search: rank features by LOCAL gain, vote the
         union of every worker's top-k, allreduce only the candidates'
-        histogram slices, then pick the global best among them."""
-        Gh, Hh, Ch = hist[0], hist[1], hist[2]           # (F, B) LOCAL
-        Gt, Ht, Ct = Gh[0].sum(), Hh[0].sum(), Ch[0].sum()
+        histogram slices, then pick the global best among them.
+
+        ``hist_sub`` carries the sibling-subtraction pair (parent cache,
+        right child) UNSUBTRACTED: the f32 subtraction must happen AFTER
+        the psum — the association order the data-parallel learner uses
+        (psum'd parent minus psum'd child) — or near-tie splits flip and
+        voting_k >= F would not reproduce data-parallel trees bitwise.
+        """
+        local = hist if hist_sub is None else hist - hist_sub
+        Gh, Hh = local[0], local[1]                      # (F, B) LOCAL
+        Gt, Ht = Gh[0].sum(), Hh[0].sum()
         GLl = jnp.cumsum(Gh, axis=-1)
         HLl = jnp.cumsum(Hh, axis=-1)
         parent_l = _split_gain(Gt, Ht, p.lambda_l1, p.lambda_l2)
@@ -157,10 +172,19 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         _, topk = lax.top_k(gain_f, k)
         cand = lax.all_gather(topk, axis_name).reshape(-1)  # (n_dev*k,)
 
-        ch = lax.psum(hist[:, cand, :], axis_name)        # (3, C, B) global
-        G = lax.psum(Gt, axis_name)
-        H = lax.psum(Ht, axis_name)
-        C = lax.psum(Ct, axis_name)
+        # one candidate-sized collective: the voted slices plus the
+        # FEATURE-0 slice (any feature's bins partition all rows), whose
+        # Σ_bin-of-Σ_dev totals match data-parallel's association order
+        # exactly (psum'ing local Σ_bin totals would reassociate)
+        sel = jnp.concatenate([cand, jnp.zeros(1, cand.dtype)])
+        if hist_sub is None:
+            ps = lax.psum(hist[:, sel, :], axis_name)     # (3, C+1, B)
+        else:
+            pair = lax.psum(jnp.stack(
+                [hist[:, sel, :], hist_sub[:, sel, :]]), axis_name)
+            ps = pair[0] - pair[1]
+        ch, tot = ps[:, :-1, :], ps[:, -1, :]             # global
+        G, H, C = tot[0].sum(), tot[1].sum(), tot[2].sum()
         GL = jnp.cumsum(ch[0], axis=-1)
         HL = jnp.cumsum(ch[1], axis=-1)
         CL = jnp.cumsum(ch[2], axis=-1)
@@ -173,16 +197,27 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               & (HL >= min_hess) & (HR >= min_hess)
               & (feature_mask[cand][:, None] > 0) & depth_ok)
         gain = jnp.where(ok, gain, NEG_INF)
-        flat = jnp.argmax(gain)
+        # tie-break by GLOBAL (feature, bin) — not candidate-vote order,
+        # which differs per worker's local ranking: serial's argmax picks
+        # the lowest (f, b) flat index among equal gains, and matching it
+        # exactly is what makes voting_k >= F bitwise-identical to serial
+        best = jnp.max(gain)
+        B_ = gain.shape[-1]
+        fb_key = cand[:, None].astype(jnp.int32) * B_ + jnp.arange(
+            B_, dtype=jnp.int32)    # fits int32 up to F*B < 2^31
+        keyed = jnp.where(gain >= best, fb_key,
+                          jnp.iinfo(jnp.int32).max)
+        flat = jnp.argmin(keyed)
         ci, bb = jnp.unravel_index(flat, gain.shape)
         return (gain.reshape(-1)[flat], cand[ci].astype(jnp.int32),
                 bb.astype(jnp.int32), CL[ci, bb], C)
 
-    def best_split(hist, depth_ok):
+    def best_split(hist, depth_ok, hist_sub=None):
         """Best candidate split of one leaf from its (3, F, B) histogram.
-        Returns (gain, feature, bin, left_count, total_count)."""
+        Returns (gain, feature, bin, left_count, total_count).
+        ``hist_sub`` (voting only): see best_split_voting."""
         if voting:
-            return best_split_voting(hist, depth_ok)
+            return best_split_voting(hist, depth_ok, hist_sub)
         Gh, Hh, Ch = hist[0], hist[1], hist[2]           # (F, B)
         # any feature's bins partition all rows; feature 0's sums = totals
         G, H, C = Gh[0].sum(), Hh[0].sum(), Ch[0].sum()
@@ -276,7 +311,13 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         child_depth = st["leaf_depth"][bl] + 1
         depth_ok = jnp.bool_(True) if p.max_depth <= 0 \
             else child_depth < p.max_depth
-        gl_, fl_, bl_bin, cll, cl_tot = best_split(hist_l, depth_ok)
+        if voting:
+            # ship the (parent, right) pair unsubtracted — the psum-then-
+            # subtract order must match data-parallel (see best_split_voting)
+            gl_, fl_, bl_bin, cll, cl_tot = best_split(
+                st["hist_cache"][bl], depth_ok, hist_sub=hist_r)
+        else:
+            gl_, fl_, bl_bin, cll, cl_tot = best_split(hist_l, depth_ok)
         gr_, fr_, br_bin, clr, cr_tot = best_split(hist_r, depth_ok)
 
         parent = st["leaf_to_node"][bl]

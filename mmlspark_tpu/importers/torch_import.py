@@ -88,8 +88,12 @@ def load_safetensors_file(path: str) -> Dict[str, np.ndarray]:
             arr = np.frombuffer(blob[lo:hi], dtype=_SAFETENSORS_DTYPES[dt])
         else:
             raise ValueError(f"unsupported safetensors dtype {dt!r}")
-        out[name] = arr.reshape(meta["shape"]).astype(np.float32) \
-            if dt in ("F16", "BF16") else arr.reshape(meta["shape"])
+        if dt in ("F16", "BF16"):
+            out[name] = arr.reshape(meta["shape"]).astype(np.float32)
+        else:
+            # frombuffer views are read-only; downstream in-place edits
+            # of imported tensors would raise — hand out owned arrays
+            out[name] = arr.reshape(meta["shape"]).copy()
     return out
 
 

@@ -118,11 +118,21 @@ class _Standardizer:
 
 class TPULogisticRegression(Estimator, HasFeaturesCol, HasLabelCol,
                             HasPredictionCol):
-    """Multinomial logistic regression; labels must be 0..K-1."""
+    """Multinomial logistic regression; labels must be 0..K-1.
+
+    Standardization depends on the feature column's storage: DENSE
+    features are standardized (mean/std folded into the fitted params);
+    SPARSE (CSR) features are NOT — centering would densify, so the raw
+    values feed the solver directly (the reference's hashed-text
+    pipeline behaves the same). The same data therefore trains to a
+    different model dense vs sparse at identical stepSize/regParam;
+    pre-scale sparse features if scale-invariance matters."""
 
     maxIter = IntParam("gradient steps", default=300)
     regParam = FloatParam("L2 regularization", default=1e-4)
-    stepSize = FloatParam("learning rate", default=0.5)
+    stepSize = FloatParam("learning rate (dense features are "
+                          "standardized first; sparse are not — see "
+                          "class docstring)", default=0.5)
 
     def fit(self, table: DataTable) -> "TPULogisticRegressionModel":
         from mmlspark_tpu.core.sparse import CSRMatrix

@@ -282,6 +282,10 @@ class Transformer(nn.Module):
     seq_axis: Optional[str] = None
     seq_impl: str = "ring"
     dtype: Dtype = jnp.float32
+    # the vocab projection is the single largest matmul in an LM; f32
+    # (default, conservative) runs it off the MXU's fast path, bf16 keeps
+    # it on (losses still softmax in f32 — learner casts logits up)
+    head_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,
@@ -323,9 +327,9 @@ class Transformer(nn.Module):
             pooled = jnp.mean(x, axis=1)
             if self.seq_axis is not None:
                 pooled = _lax.pmean(pooled, self.seq_axis)
-            return nn.Dense(self.num_classes, dtype=jnp.float32,
+            return nn.Dense(self.num_classes, dtype=self.head_dtype,
                             name="head")(pooled)
-        return nn.Dense(self.vocab_size, dtype=jnp.float32,
+        return nn.Dense(self.vocab_size, dtype=self.head_dtype,
                         name="lm_head")(x)
 
     def feature_layers(self) -> List[str]:
@@ -358,8 +362,9 @@ def build_network(spec: Dict[str, Any]) -> nn.Module:
     if kind not in NETWORK_REGISTRY:
         raise KeyError(f"unknown network type {kind!r}; "
                        f"have {sorted(NETWORK_REGISTRY)}")
-    if "dtype" in spec and isinstance(spec["dtype"], str):
-        spec["dtype"] = jnp.dtype(spec["dtype"])
+    for key in ("dtype", "head_dtype"):
+        if key in spec and isinstance(spec[key], str):
+            spec[key] = jnp.dtype(spec[key])
     for key in ("conv_features", "dense_features", "stage_sizes",
                 "features", "kernel"):
         if key in spec and isinstance(spec[key], list):

@@ -71,6 +71,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_long),
         ctypes.POINTER(ctypes.c_int32)]
     lib.mml_apply_bins.restype = ctypes.c_int
+    if hasattr(lib, "mml_apply_bins_t_u8"):   # pre-upgrade .so lacks it
+        lib.mml_apply_bins_t_u8.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_long, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.mml_apply_bins_t_u8.restype = ctypes.c_int
     return lib
 
 
@@ -180,4 +187,40 @@ def apply_bins(X: np.ndarray, upper_bounds: list) -> Optional[np.ndarray]:
         bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    return out if rc == 0 else None
+
+
+def apply_bins_t_u8(X: np.ndarray,
+                    upper_bounds: list) -> Optional[np.ndarray]:
+    """Fused bin+transpose+narrow: (n, f) f32/f64 features ->
+    FEATURES-MAJOR (f, n) uint8 bins in one native pass (the GBDT
+    engine's ship layout). Requires every feature's bin count <= 256 and
+    the library built after the kernel landed (probed via hasattr)."""
+    lib = get_lib()
+    if lib is None or not hasattr(lib, "mml_apply_bins_t_u8"):
+        return None
+    if any(len(u) + 1 > 256 for u in upper_bounds):
+        return None
+    X = np.ascontiguousarray(X)
+    if X.dtype == np.float32:
+        is_f32 = 1
+    elif X.dtype == np.float64:
+        is_f32 = 0
+    else:
+        X = np.ascontiguousarray(X, dtype=np.float64)
+        is_f32 = 0
+    n, f = X.shape
+    bounds = (np.concatenate([np.asarray(u, dtype=np.float64)
+                              for u in upper_bounds])
+              if upper_bounds and any(len(u) for u in upper_bounds)
+              else np.zeros(0))
+    offsets = np.zeros(f + 1, dtype=np.int64)
+    for j, u in enumerate(upper_bounds):
+        offsets[j + 1] = offsets[j] + len(u)
+    out = np.empty((f, n), dtype=np.uint8)
+    rc = lib.mml_apply_bins_t_u8(
+        X.ctypes.data_as(ctypes.c_void_p), is_f32, n, f,
+        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out if rc == 0 else None

@@ -264,4 +264,37 @@ int mml_apply_bins(const double* X, long n, int f, const double* bounds,
   return 0;
 }
 
+// fused bin+transpose+narrow: row-major (n, f) features -> FEATURES-MAJOR
+// (f, n) uint8 bins in ONE pass (the layout+dtype the device engine
+// ships; separate transform/transpose/astype passes cost three full
+// sweeps of a 1M-row matrix). x_is_f32 selects the input dtype — f32
+// values widen to double before the boundary compare, which is exact,
+// so results match the f64 path bit-for-bit. Requires every feature's
+// bin count <= 256 (caller checks). Row-tiled so the strided input
+// reads stay within cache while output writes run contiguous.
+int mml_apply_bins_t_u8(const void* Xv, int x_is_f32, long n, int f,
+                        const double* bounds, const long* offsets,
+                        uint8_t* out) {
+  const float* Xf = static_cast<const float*>(Xv);
+  const double* Xd = static_cast<const double*>(Xv);
+  const long TILE = 8192;
+  for (long t0 = 0; t0 < n; t0 += TILE) {
+    const long t1 = std::min(n, t0 + TILE);
+    for (int j = 0; j < f; ++j) {
+      const double* lo = bounds + offsets[j];
+      const double* hi = bounds + offsets[j + 1];
+      uint8_t* orow = out + static_cast<size_t>(j) * n;
+      for (long i = t0; i < t1; ++i) {
+        const double v = x_is_f32 ? static_cast<double>(Xf[i * f + j])
+                                  : Xd[i * f + j];
+        orow[i] = std::isnan(v)
+                      ? 0
+                      : static_cast<uint8_t>(
+                            std::lower_bound(lo, hi, v) - lo);
+      }
+    }
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -125,6 +125,57 @@ def main() -> None:
         booster.model_to_string().encode()).hexdigest()[:16]
     auc_ok = int(np.mean((booster.predict(GX) > 0.5) == GY) > 0.9)
     print(f"GBDT {pid} {digest},{auc_ok}", flush=True)
+
+    # multi-host FEATURE-parallel: every process holds the FULL dataset
+    # (LightGBM's feature-parallel layout) and owns a feature shard of
+    # the global mesh; forests must be byte-identical across hosts
+    # (ref: TrainParams.scala:26 tree_learner=feature across executors)
+    fp = gbdt_train(
+        {"objective": "binary", "num_iterations": 5, "num_leaves": 7,
+         "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "feature",
+         "hist_method": "scatter"},
+        GX, GY)
+    fp_digest = hashlib.sha256(
+        fp.model_to_string().encode()).hexdigest()[:16]
+    fp_ok = int(np.mean((fp.predict(GX) > 0.5) == GY) > 0.9)
+    print(f"FPGBDT {pid} {fp_digest},{fp_ok}", flush=True)
+
+    # multi-host VOTING-parallel: local row shards like data-parallel,
+    # candidate-sized per-split collective (PV-tree across hosts)
+    vt = gbdt_train(
+        {"objective": "binary", "num_iterations": 5, "num_leaves": 7,
+         "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "voting",
+         "top_k": 6, "hist_method": "scatter"},
+        GX[rows_lo:rows_hi], GY[rows_lo:rows_hi])
+    vt_digest = hashlib.sha256(
+        vt.model_to_string().encode()).hexdigest()[:16]
+    vt_ok = int(np.mean((vt.predict(GX) > 0.5) == GY) > 0.9)
+    print(f"VOTEGBDT {pid} {vt_digest},{vt_ok}", flush=True)
+
+    # f64-faithful multi-host binning: a feature at 2^24 scale whose
+    # distinct values collapse under an f32 wire. The agreed boundaries
+    # must equal a single-host f64 BinMapper fit on the concatenated
+    # data byte-for-byte (the parent test recomputes and compares), and
+    # the trained forests must agree across hosts with f32_unsafe set.
+    from mmlspark_tpu.gbdt.booster import _multihost_mapper
+    f24 = 2.0 ** 24
+    UX = np.stack([
+        f24 + np.arange(400, dtype=np.float64) * 0.25,   # f32-unsafe
+        grng.normal(size=400)], axis=1)
+    UY = ((UX[:, 0] - f24) * 0.04 + UX[:, 1] > 5.0).astype(float)
+    u_mapper = _multihost_mapper(UX[rows_lo:rows_hi], False, 15, 2, nproc)
+    b_digest = hashlib.sha256(
+        b"".join(u.tobytes() for u in u_mapper.upper_bounds)
+    ).hexdigest()[:16]
+    ub = gbdt_train(
+        {"objective": "binary", "num_iterations": 4, "num_leaves": 7,
+         "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "data",
+         "hist_method": "scatter"},
+        UX[rows_lo:rows_hi], UY[rows_lo:rows_hi])
+    u_digest = hashlib.sha256(
+        ub.model_to_string().encode()).hexdigest()[:16]
+    unsafe = int(bool(ub.params.get("f32_unsafe")))
+    print(f"F64BIN {pid} {b_digest},{u_digest},{unsafe}", flush=True)
     print(f"OK {pid}", flush=True)
 
 

@@ -58,6 +58,9 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     trained = {}
     streamed = {}
     gbdt = {}
+    fp_gbdt = {}
+    vote_gbdt = {}
+    f64bin = {}
     for rc, out, err in outs:
         for line in out.splitlines():
             if line.startswith("PSUM"):
@@ -75,6 +78,15 @@ def test_multiprocess_rendezvous_and_psum(nproc):
             if line.startswith("GBDT"):
                 _, pid, vals = line.split()
                 gbdt[int(pid)] = vals
+            if line.startswith("FPGBDT"):
+                _, pid, vals = line.split()
+                fp_gbdt[int(pid)] = vals
+            if line.startswith("VOTEGBDT"):
+                _, pid, vals = line.split()
+                vote_gbdt[int(pid)] = vals
+            if line.startswith("F64BIN"):
+                _, pid, vals = line.split()
+                f64bin[int(pid)] = vals
     # host-sharded training ran and produced identical replicated params
     assert len(trained) == nproc
     assert len(set(trained.values())) == 1, trained
@@ -87,6 +99,36 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     assert len(gbdt) == nproc
     assert len(set(gbdt.values())) == 1, gbdt
     assert all(v.endswith(",1") for v in gbdt.values()), gbdt
+    # multi-host FEATURE-parallel: byte-identical forests from
+    # feature shards of the global mesh (full data on every host)
+    assert len(fp_gbdt) == nproc
+    assert len(set(fp_gbdt.values())) == 1, fp_gbdt
+    assert all(v.endswith(",1") for v in fp_gbdt.values()), fp_gbdt
+    # multi-host VOTING-parallel: byte-identical forests from row shards
+    assert len(vote_gbdt) == nproc
+    assert len(set(vote_gbdt.values())) == 1, vote_gbdt
+    assert all(v.endswith(",1") for v in vote_gbdt.values()), vote_gbdt
+    # f64-faithful multi-host binning: (boundary_digest, forest_digest,
+    # f32_unsafe) agree across hosts, the f32-unsafe flag is set, and
+    # the agreed boundaries equal a single-host f64 fit byte-for-byte
+    assert len(f64bin) == nproc
+    assert len(set(f64bin.values())) == 1, f64bin
+    b_digest, _, unsafe = next(iter(f64bin.values())).split(",")
+    assert unsafe == "1", f64bin
+    import hashlib
+    import numpy as np
+    from mmlspark_tpu.gbdt.binning import BinMapper
+    grng = np.random.default_rng(11)
+    grng.normal(size=(400, 6))          # replay the worker's draws
+    f24 = 2.0 ** 24
+    ux = np.stack([f24 + np.arange(400, dtype=np.float64) * 0.25,
+                   grng.normal(size=400)], axis=1)
+    expect_digest = hashlib.sha256(
+        b"".join(u.tobytes() for u in BinMapper.fit(
+            ux, max_bin=15).upper_bounds)).hexdigest()[:16]
+    assert b_digest == expect_digest, \
+        "multi-host agreed bin boundaries differ from single-host f64 " \
+        "fit (the f32-wire quantization bug)"
     # host shards are disjoint row ranges
     assert len(shards) == nproc
     all_rows = ",".join(shards[i] for i in range(nproc))

@@ -1,0 +1,140 @@
+"""Capture + analyze an xplane profile of a TPULearner train step.
+
+Usage::
+
+    python tools/profile_step.py resnet   # ResNet-20, bench config
+    python tools/profile_step.py convnet  # bench ConvNet
+    python tools/profile_step.py <dir-or-xplane.pb>  # analyze existing
+
+Runs a short device-feed training (the bench configuration), captures a
+``jax.profiler.trace`` xplane, and aggregates device-plane op times
+within the LAST (steady-state) XLA-module execution window, by HLO
+category. This is the evidence path behind docs/perf_analysis.md: where
+every microsecond of the compiled step goes, op by op.
+
+Methodology notes:
+- The ``XLA Modules`` line gives each jitted-program execution window;
+  the last one is steady-state (first is compile-adjacent/warmup).
+- The ``XLA Ops`` line carries leaf op events; scan-body ops appear once
+  per scan iteration, so an 8-step chunk shows x8 counts.
+- The ``while`` wrapper op spans its children and is excluded from the
+  busy-time denominator (its children are themselves on the line).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def capture(kind: str, trace_dir: str, batch: int = 512) -> None:
+    import jax
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.models.learner import TPULearner
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+
+    specs = {
+        "resnet": {"type": "resnet", "stage_sizes": [3, 3, 3], "width": 16,
+                   "num_classes": 10},
+        "convnet": {"type": "convnet", "conv_features": [64, 64, 64],
+                    "dense_features": [256], "num_classes": 10},
+    }
+    rng = np.random.default_rng(0)
+    n = batch * 8
+    x = rng.integers(0, 256, size=(n, 32, 32, 3)).astype(np.float32) / 255.0
+    y = rng.integers(0, 10, size=n).astype(np.int64)
+    table = DataTable({"features": x.reshape(n, -1), "label": y})
+    mesh = mesh_lib.make_mesh({"data": len(jax.devices())})
+    learner = TPULearner(
+        networkSpec=specs[kind], inputShape=[32, 32, 3], batchSize=batch,
+        learningRate=0.1, computeDtype="bfloat16", epochs=2,
+        logEvery=10_000, dataFeed="device", profileDir=trace_dir)
+    learner.set_mesh(mesh)
+    learner.fit(table)
+    print(f"# timing: {learner.timing}")
+
+
+def _load_space(path: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    if os.path.isdir(path):
+        from mmlspark_tpu.utils.profiling import trace_files
+        files = trace_files(path)
+        if not files:
+            raise SystemExit(f"no xplane.pb under {path}")
+        path = files[-1]
+    with open(path, "rb") as f:
+        return xplane_pb2.XSpace.FromString(f.read())
+
+
+def analyze(path: str, top: int = 25) -> None:
+    """Leaf-op breakdown of the last XLA-module window on the device."""
+    space = _load_space(path)
+    planes = [p for p in space.planes
+              if "TPU" in p.name or "Device" in p.name]
+    if not planes:
+        raise SystemExit("no device plane in trace")
+    for plane in planes:
+        lines = {ln.name: ln for ln in plane.lines}
+        if "XLA Modules" not in lines or "XLA Ops" not in lines:
+            continue
+        mods = sorted(lines["XLA Modules"].events,
+                      key=lambda e: e.offset_ps)
+        if not mods:
+            continue
+        last = mods[-1]
+        w0, w1 = last.offset_ps, last.offset_ps + last.duration_ps
+        ev_meta, stat_meta = plane.event_metadata, plane.stat_metadata
+
+        def category(md) -> str:
+            for st in md.stats:
+                sm = stat_meta.get(st.metadata_id)
+                if sm and sm.name == "hlo_category":
+                    return st.str_value
+            return "?"
+
+        agg = collections.Counter()
+        cnt = collections.Counter()
+        by_cat = collections.Counter()
+        for ev in lines["XLA Ops"].events:
+            if ev.offset_ps < w0 or ev.offset_ps >= w1:
+                continue
+            md = ev_meta.get(ev.metadata_id)
+            name = md.name if md else "?"
+            cat = category(md) if md else "?"
+            if cat == "while":
+                continue  # spans its children; they are counted directly
+            agg[(name, cat)] += ev.duration_ps
+            cnt[(name, cat)] += 1
+            by_cat[cat] += ev.duration_ps
+
+        total = sum(agg.values())
+        print(f"\n== {plane.name}: steady-state module "
+              f"{last.duration_ps / 1e9:.2f} ms, leaf-op busy "
+              f"{total / 1e9:.2f} ms "
+              f"({total / max(last.duration_ps, 1) * 100:.1f}%) ==")
+        print("-- by hlo_category --")
+        for c, d in by_cat.most_common():
+            print(f"{d / total * 100:6.2f}%  {d / 1e9:8.3f} ms  {c}")
+        print(f"-- top {top} ops --")
+        for (n, c), d in agg.most_common(top):
+            print(f"{d / total * 100:6.2f}%  {d / 1e9:8.3f} ms "
+                  f"x{cnt[(n, c)]:<4d} [{c}] {n[:78]}")
+
+
+def main():
+    arg = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    if os.path.exists(arg):
+        analyze(arg)
+        return
+    trace_dir = f"/tmp/profile_{arg}"
+    capture(arg, trace_dir)
+    analyze(trace_dir)
+
+
+if __name__ == "__main__":
+    main()
