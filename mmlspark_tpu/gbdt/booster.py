@@ -617,9 +617,17 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             h.update(u.tobytes())
         h.update(np.ascontiguousarray(y).tobytes())
         h.update(np.ascontiguousarray(w_base).tobytes())
-        stride = max(1, n // 1024)
-        h.update(np.ascontiguousarray(
-            np.asarray(X)[::stride]).tobytes())
+        from mmlspark_tpu.core.sparse import CSRMatrix as _CSRd
+        if isinstance(X, _CSRd):
+            # hash the CSR buffers — np.asarray(X) would densify the
+            # whole matrix, the exact thing the sparse path forbids
+            h.update(np.ascontiguousarray(X.indptr).tobytes())
+            h.update(np.ascontiguousarray(X.indices).tobytes())
+            h.update(np.ascontiguousarray(X.data).tobytes())
+        else:
+            stride = max(1, n // 1024)
+            h.update(np.ascontiguousarray(
+                np.asarray(X)[::stride]).tobytes())
         mine = np.frombuffer(h.digest(), np.uint8)
         alld = np.asarray(multihost_utils.process_allgather(mine))
         alld = alld.reshape(proc_info.process_count, -1)

@@ -331,12 +331,11 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
         else:
             local_batch = batch_size
         device_feed = self.get("dataFeed") == "device"
-        if device_feed and (streaming or proc_count > 1):
+        if device_feed and streaming:
             raise ValueError(
                 "dataFeed='device' needs the whole dataset resident in "
-                "this process's HBM: pass an in-memory DataTable and run "
-                "single-process (use dataFeed='host' for streaming or "
-                "multi-host training)")
+                "HBM: pass an in-memory DataTable per process (use "
+                "dataFeed='host' for shard streams)")
         steps_per_epoch = max(1, (n + local_batch - 1) // local_batch)
         total_steps = steps_per_epoch * self.get("epochs")
 
@@ -604,12 +603,19 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
 
         if device_feed:
             # Pad once to full batches; per-epoch shuffle happens ON
-            # DEVICE: a host permutation (4 bytes/row) gathers the padded
-            # dataset into an (steps, batch, ...) epoch tensor, and each
-            # step then reads only a scalar batch index from the host —
-            # the steady state is chip-bound, not feed-bound.
-            n_pad = steps_per_epoch * local_batch
-            pad = n_pad - n
+            # DEVICE: a permutation derived on device from the (shared)
+            # seed key gathers the padded dataset into an
+            # (steps, batch, ...) epoch tensor, and each step then reads
+            # only a scalar batch index from the host — the steady state
+            # is chip-bound, not feed-bound. Multi-host: every process
+            # contributes its LOCAL padded shard to a row-sharded global
+            # array; the permutation key is seed-derived in-program so
+            # hosts agree without communicating, and the global gather's
+            # cross-device row movement rides the mesh interconnect
+            # (ref: CommandBuilders.scala:108-267 — distributed training
+            # is the product, not a mode).
+            n_pad_local = steps_per_epoch * local_batch
+            pad = n_pad_local - n
             if pad:
                 x_p = np.concatenate(
                     [x, np.zeros((pad,) + x.shape[1:], x.dtype)])
@@ -617,7 +623,9 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     [y, np.zeros((pad,) + y.shape[1:], y.dtype)])
             else:
                 x_p, y_p = x, y
-            w_p = (np.arange(n_pad) < n).astype(np.float32)
+            w_p = (np.arange(n_pad_local) < n).astype(np.float32)
+            n_pad = n_pad_local * proc_count     # GLOBAL padded rows
+            global_batch = local_batch * proc_count
             try:
                 stats = jax.devices()[0].memory_stats() or {}
                 hbm_limit = stats.get("bytes_limit")
@@ -626,7 +634,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             # resident twice: the row-major copy + the epoch tensor. Only
             # the data axis shards the rows — other mesh axes replicate
             # them, so per-chip residency divides by the data size alone.
-            want = 2 * (x_p.nbytes + y_p.nbytes + w_p.nbytes)
+            want = 2 * proc_count * (x_p.nbytes + y_p.nbytes + w_p.nbytes)
             per_chip = want / mesh.shape.get(mesh_lib.DATA_AXIS, 1)
             if hbm_limit and per_chip > 0.6 * hbm_limit:
                 logger.warning(
@@ -639,9 +647,9 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                 return NamedSharding(mesh, P(*((mesh_lib.DATA_AXIS,)
                                                + (None,) * (nd - 1))))
 
-            x_dev = jax.device_put(x_p, _row_sh(x_p.ndim))
-            y_dev = jax.device_put(y_p, _row_sh(y_p.ndim))
-            w_dev = jax.device_put(w_p, _row_sh(1))
+            x_dev = _to_global(x_p, _row_sh(x_p.ndim))
+            y_dev = _to_global(y_p, _row_sh(y_p.ndim))
+            w_dev = _to_global(w_p, _row_sh(1))
             row_shardings = (_row_sh(x_p.ndim), _row_sh(y_p.ndim),
                              _row_sh(1))
             base_key = jax.random.PRNGKey(self.get("seed") + 17)
@@ -663,11 +671,11 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                 # chunks would otherwise re-gather the full epoch tensor
                 # once per segment)
                 sel = jax.lax.dynamic_slice_in_dim(
-                    perm, start * local_batch, length * local_batch)
+                    perm, start * global_batch, length * global_batch)
 
                 def g(a):
                     return a[sel].reshape(
-                        (length, local_batch) + a.shape[1:])
+                        (length, global_batch) + a.shape[1:])
                 xs, ys, ws = g(xf), g(yf), g(wf)
 
                 def body(carry, b):
@@ -748,13 +756,13 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                             # extra compile before timing starts
                             batch_sds = {
                                 "x": jax.ShapeDtypeStruct(
-                                    (local_batch,) + x_p.shape[1:],
+                                    (global_batch,) + x_p.shape[1:],
                                     x_p.dtype),
                                 "y": jax.ShapeDtypeStruct(
-                                    (local_batch,) + y_p.shape[1:],
+                                    (global_batch,) + y_p.shape[1:],
                                     y_p.dtype),
                                 "w": jax.ShapeDtypeStruct(
-                                    (local_batch,), jnp.float32),
+                                    (global_batch,), jnp.float32),
                             }
                             probe = jax.jit(
                                 train_step,
@@ -790,15 +798,17 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
         t_end = _time.time()
         if device_feed:
             # resolve the deferred per-chunk row counts (transfers only,
-            # after the clock stops so they can't skew the measurement)
-            examples_timed = proc_count * int(sum(
+            # after the clock stops so they can't skew the measurement).
+            # Counts are GLOBAL already — the chunk's w spans every
+            # host's rows — so no per-process multiplier.
+            examples_timed = int(sum(
                 float(np.asarray(c)) for c, timed in chunk_counts
                 if timed))
             if t_first is not None and global_step == first_timed_step:
                 # single-chunk run: the whole fit was "warmup", so report
                 # the full wall including the first chunk (compile time
                 # excluded is impossible here — flag it)
-                examples_timed = proc_count * int(sum(
+                examples_timed = int(sum(
                     float(np.asarray(c)) for c, _ in chunk_counts))
                 first_timed_step = start_step
                 t_first = t_loop_start

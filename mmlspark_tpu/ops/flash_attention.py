@@ -210,6 +210,15 @@ def _blocks(lq, lk):
     return bq, bk, (-lq) % bq, (-lk) % bk
 
 
+def _lse_pad(lq: int) -> int:
+    """Padded Q length of the forward's lse output — callers that
+    fabricate lse-shaped tensors (ring_flash_attention's masked hop)
+    must match it, so derive it from _blocks rather than restating the
+    block-size formula."""
+    _, _, pad_q, _ = _blocks(lq, lq)
+    return lq + pad_q
+
+
 def _heads_major(x, pad, lpad_idx=1):
     """(B, L, H, D) -> (B*H, L(+pad), D)."""
     b, l, h, d = x.shape

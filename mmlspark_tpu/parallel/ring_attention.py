@@ -107,6 +107,13 @@ def attention(q, k, v, causal: bool = False,
     return dense_attention(q, k, v, causal, q_offset, k_offset)
 
 
+# ring shards at least this long run each hop through the Pallas flash
+# kernel (ring_flash_attention) instead of the dense einsum — the dense
+# hop materializes (B, H, Lq, Lk_local) scores per hop, exactly the
+# memory wall the flash kernel exists to avoid
+RING_FLASH_MIN_LEN = 512
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = False
                    ) -> jnp.ndarray:
     """Exact attention over a sequence sharded on ``axis_name``.
@@ -117,7 +124,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False
     around the ring via ppermute; softmax is accumulated online so the
     result is bitwise-independent of the ring schedule up to float
     reassociation.
+
+    Long shards (>= RING_FLASH_MIN_LEN) run every hop inside the Pallas
+    flash kernel — no (Lq, Lk_local) score tensor exists at any point,
+    in forward OR backward (ring_flash_attention's custom_vjp does a
+    second ring pass with the flash backward kernels).
     """
+    if (jax.default_backend() in ("tpu", "axon")
+            and q.shape[1] >= RING_FLASH_MIN_LEN
+            and k.shape[1] >= RING_FLASH_MIN_LEN):
+        return ring_flash_attention(q, k, v, axis_name, causal)
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, lq, h, d = q.shape
@@ -152,6 +168,183 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False
     o0 = jnp.zeros((b, lq, h, d), jnp.float32)
     m, l, o, _, _ = lax.fori_loop(0, n, step, (m0, l0, o0, k, v))
     return _finalize(m, l, o).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# ring + flash: every hop runs the Pallas kernel, never a dense score
+# ---------------------------------------------------------------------------
+#
+# A hop's causal structure depends only on where the visiting K/V shard
+# sits relative to this device's Q shard, and with equal shards that is
+# one of exactly THREE static kernel configurations:
+#   src <  my : fully visible   -> dense flash, causal=False
+#   src == my : the diagonal    -> flash, causal=True, zero offsets
+#   src >  my : fully masked    -> contributes nothing (skip compute)
+# so the traced hop index selects a branch (lax.switch) instead of
+# feeding a dynamic offset into the kernel. Per-hop (out_i, lse_i)
+# pairs merge online in log space; the custom_vjp backward replays the
+# ring with the flash backward kernels, rotating dK/dV accumulators
+# along with their K/V blocks so each lands home after a full cycle.
+# (New-design area — the reference has no long-context machinery,
+# SURVEY.md §5; the hop-classification trick keeps Mosaic kernels
+# static under a traced ring schedule.)
+
+
+def _hop_forward(q, k_cur, v_cur, branch, causal, interpret):
+    """One ring hop -> (out_i f32 (B,Lq,H,D), lse_i f32 (BH,Lqp,1))."""
+    from mmlspark_tpu.ops.flash_attention import _flash_forward, _lse_pad
+    b, lq, h, d = q.shape
+
+    def full(_):
+        out, lse = _flash_forward(q, k_cur, v_cur, False, 0, 0, interpret)
+        return out.astype(jnp.float32), lse
+
+    def diag(_):
+        out, lse = _flash_forward(q, k_cur, v_cur, True, 0, 0, interpret)
+        return out.astype(jnp.float32), lse
+
+    def masked(_):
+        return (jnp.zeros((b, lq, h, d), jnp.float32),
+                jnp.full((b * h, _lse_pad(lq), 1), NEG_INF, jnp.float32))
+
+    if not causal:
+        return full(None)
+    return lax.switch(branch, (full, diag, masked), None)
+
+
+def _hop_backward(q, k_cur, v_cur, out, lse, g, branch, causal, interpret):
+    """One backward hop -> (dq_i, dk_i, dv_i) in f32."""
+    from mmlspark_tpu.ops.flash_attention import _flash_backward
+
+    def run(causal_flag):
+        dq, dk, dv = _flash_backward(q, k_cur, v_cur, out, lse, g,
+                                     causal_flag, 0, 0, interpret)
+        return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                dv.astype(jnp.float32))
+
+    def full(_):
+        return run(False)
+
+    def diag(_):
+        return run(True)
+
+    def masked(_):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.zeros(k_cur.shape, jnp.float32),
+                jnp.zeros(v_cur.shape, jnp.float32))
+
+    if not causal:
+        return full(None)
+    return lax.switch(branch, (full, diag, masked), None)
+
+
+def _merge_hops(out_run, lse_run, out_i, lse_i):
+    """Log-space merge of two normalized partial attentions.
+
+    m = max(lse); weights exp(lse - m) — one of them is exp(0) = 1, so
+    the denominator is always >= 1 (no guard needed); rows masked in
+    BOTH halves stay 0 with lse ~ NEG_INF."""
+    m = jnp.maximum(lse_run, lse_i)
+    w1 = jnp.exp(lse_run - m)                   # (BH, Lqp, 1)
+    w2 = jnp.exp(lse_i - m)
+    lse_new = m + jnp.log(w1 + w2)
+
+    def rowwise(w, x):
+        # (BH, Lqp, 1) weights -> (B, Lq, H, 1) per-row scale
+        b, lq, h, _ = x.shape
+        wr = w[:, :lq, 0].reshape(b, h, lq).transpose(0, 2, 1)
+        return x * wr[..., None]
+
+    out_new = (rowwise(w1, out_run) + rowwise(w2, out_i)) \
+        / rowwise(w1 + w2, jnp.ones_like(out_run))
+    return out_new, lse_new
+
+
+def _ring_branch(t, my, n):
+    """0 = fully visible, 1 = diagonal, 2 = fully masked (src > my)."""
+    src = (my - t) % n
+    return jnp.where(src == my, 1, jnp.where(src < my, 0, 2)).astype(
+        jnp.int32)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, lq, h, d = q.shape
+    from mmlspark_tpu.ops.flash_attention import _lse_pad
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        out_run, lse_run, k_cur, v_cur = carry
+        out_i, lse_i = _hop_forward(q, k_cur, v_cur,
+                                    _ring_branch(t, my, n), causal,
+                                    interpret)
+        out_run, lse_run = _merge_hops(out_run, lse_run, out_i, lse_i)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return out_run, lse_run, k_nxt, v_nxt
+
+    out0 = jnp.zeros((b, lq, h, d), jnp.float32)
+    lse0 = jnp.full((b * h, _lse_pad(lq), 1), NEG_INF, jnp.float32)
+    # n rotations total -> K/V return to their owners (no drift)
+    out, lse, _, _ = lax.fori_loop(0, n, step, (out0, lse0, k, v))
+    return out.astype(q.dtype), lse
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, res, g):
+    q, k, v, out, lse = res
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        dq_run, k_cur, v_cur, dk_acc, dv_acc = carry
+        dq_i, dk_i, dv_i = _hop_backward(
+            q, k_cur, v_cur, out, lse, g, _ring_branch(t, my, n), causal,
+            interpret)
+        dq_run = dq_run + dq_i
+        dk_acc = dk_acc + dk_i
+        dv_acc = dv_acc + dv_i
+        # rotate the K/V blocks WITH their gradient accumulators: after
+        # the full n-hop cycle each dK/dV lands back on its owner
+        rot = lambda x: lax.ppermute(x, axis_name, perm)  # noqa: E731
+        return dq_run, rot(k_cur), rot(v_cur), rot(dk_acc), rot(dv_acc)
+
+    zeros_kv = jnp.zeros(k.shape, jnp.float32)
+    dq, _, _, dk, dv = lax.fori_loop(
+        0, n, step,
+        (jnp.zeros(q.shape, jnp.float32), k, v, zeros_kv,
+         jnp.zeros(v.shape, jnp.float32)))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, axis_name: str, causal: bool = False,
+                         interpret: bool = False) -> jnp.ndarray:
+    """Ring attention whose every hop runs the Pallas flash kernel —
+    O(L_local) memory per device in forward AND backward; no
+    (Lq, Lk_local) score tensor is ever materialized. Same contract and
+    numerics (to f32 reassociation) as ring_attention's dense path.
+    Requires equal-length Q/K shards (the shard_map contract already
+    guarantees this)."""
+    if q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"ring_flash_attention needs equal shards, got Lq={q.shape[1]} "
+            f"Lk={k.shape[1]}")
+    return _ring_flash(q, k, v, axis_name, bool(causal), bool(interpret))
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False

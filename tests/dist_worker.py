@@ -83,6 +83,30 @@ def main() -> None:
         model.get("weights"))[0]).ravel()[:3]
     print(f"TRAIN {pid} {','.join(f'{v:.6f}' for v in leaf)}", flush=True)
 
+    # DEVICE-RESIDENT multi-host feed: each process device_puts its local
+    # shard into a row-sharded global array; the epoch permutation is
+    # derived on device from the shared seed key so hosts agree without
+    # communicating (learner.py run_chunk). Every host must end with
+    # identical replicated params, and a re-run with the same seed must
+    # reproduce them exactly (on-device shuffle determinism).
+    def fit_device_feed():
+        dl = TPULearner(
+            networkSpec={"type": "mlp", "features": [8], "num_classes": 2},
+            epochs=6, batchSize=8 * nproc, learningRate=0.1,
+            computeDtype="float32", logEvery=1000, dataFeed="device",
+            meshAxes={"data": info.global_device_count})
+        dmodel = dl.fit(local)
+        return np.concatenate([
+            np.asarray(leaf_arr).ravel()
+            for leaf_arr in jax.tree_util.tree_leaves(
+                dmodel.get("weights"))])
+
+    dw1 = fit_device_feed()
+    dw2 = fit_device_feed()
+    det = int(np.array_equal(dw1, dw2))
+    print(f"DEVFEED {pid} {','.join(f'{v:.6f}' for v in dw1[:3])},{det}",
+          flush=True)
+
     # STREAMING multi-host: each host feeds a RAGGED shard stream (40 vs
     # 36 rows); hosts allgather their counts and truncate to the global
     # minimum so step counts agree (VERDICT r2 item 5 — the restriction

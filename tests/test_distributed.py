@@ -61,6 +61,7 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     fp_gbdt = {}
     vote_gbdt = {}
     f64bin = {}
+    devfeed = {}
     for rc, out, err in outs:
         for line in out.splitlines():
             if line.startswith("PSUM"):
@@ -75,6 +76,9 @@ def test_multiprocess_rendezvous_and_psum(nproc):
             if line.startswith("STREAM"):
                 _, pid, vals = line.split()
                 streamed[int(pid)] = vals
+            if line.startswith("DEVFEED"):
+                _, pid, vals = line.split()
+                devfeed[int(pid)] = vals
             if line.startswith("GBDT"):
                 _, pid, vals = line.split()
                 gbdt[int(pid)] = vals
@@ -94,6 +98,12 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     # (hosts truncate to the min shard count so steps agree)
     assert len(streamed) == nproc
     assert len(set(streamed.values())) == 1, streamed
+    # DEVICE-RESIDENT multi-host feed: identical replicated params on
+    # every host AND bit-exact across re-runs (deterministic on-device
+    # shuffle from the shared seed key); trailing ,1 = determinism flag
+    assert len(devfeed) == nproc
+    assert len(set(devfeed.values())) == 1, devfeed
+    assert all(v.endswith(",1") for v in devfeed.values()), devfeed
     # multi-host GBDT grew identical forests from disjoint row shards,
     # and the model predicts the global data well (digest,auc_ok)
     assert len(gbdt) == nproc
