@@ -1,0 +1,65 @@
+"""ONNX-checkpoint inference — framework-neutral model ingestion.
+
+The reference's zoo serves published models behind URI+sha256 schemas
+(ref: ModelDownloader.scala:209). ONNX is the dominant neutral
+interchange format today, so this example takes an ONNX CNN (a
+resnet-architecture graph; here synthesized by the test writer since
+the image has no egress — any torchvision/HF ONNX export drops into the
+same call), publishes it through ModelDownloader with its structural
+manifest, and serves batched predictions through TPUModel.
+"""
+
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(_pathsetup.__file__),
+                                os.pardir, "tests"))
+import onnx_writer  # noqa: E402 — the dependency-free ONNX writer
+
+from mmlspark_tpu.core.table import DataTable  # noqa: E402
+from mmlspark_tpu.downloader import LocalRepo  # noqa: E402
+from mmlspark_tpu.importers import (  # noqa: E402
+    import_onnx_model, onnx_summary,
+)
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="onnx_example_")
+    onnx_path = os.path.join(tmp, "resnet18.onnx")
+    onnx_writer.resnet18_onnx(onnx_path, num_classes=10, width=8, seed=7)
+
+    # structural manifest — the validation hook recorded on the schema
+    summary = onnx_summary(onnx_path)
+    print("ops:", summary["ops"])
+    assert summary["ops"]["Conv"] == 20
+
+    # publish through the zoo (blob + sha256), reload, serve
+    repo = LocalRepo(os.path.join(tmp, "repo"))
+    with open(onnx_path, "rb") as f:
+        blob = f.read()
+    repo.publish("onnx_resnet18",
+                 {"format": "onnx", "onnx_summary": summary},
+                 blob=blob, model_type="classification")
+    schema = repo.get_schema("onnx_resnet18")
+    reload_path = os.path.join(tmp, "reload.onnx")
+    with open(reload_path, "wb") as f:
+        f.write(repo.read_blob(schema, verify=True))
+
+    model = import_onnx_model(reload_path, batch_size=8,
+                              input_shape=[3, 32, 32])
+    rng = np.random.default_rng(0)
+    images = rng.normal(size=(16, 3 * 32 * 32)).astype(np.float32)
+    out = model.transform(DataTable({"images": images}))
+    scores = np.asarray(out["scores"])
+    assert scores.shape == (16, 10) and np.all(np.isfinite(scores))
+    print("predictions:", scores.argmax(1).tolist())
+    print("onnx_inference OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,9 +6,14 @@ graphs — CNTKModel deserializes a trained CNTK Function
 SerializableFunction.scala:85) and ModelDownloader fetches CNN zoo models
 (ref: src/downloader/src/main/scala/ModelDownloader.scala:209). The
 TPU-native equivalent ingests torch checkpoints (state_dicts) into flax
-variable pytrees for the zoo network specs.
+variable pytrees for the zoo network specs, and ONNX graphs (the
+framework-neutral interchange format) through a dependency-free reader
++ jax executor.
 """
 
+from mmlspark_tpu.importers.onnx_import import (
+    OnnxApply, import_onnx_model, load_onnx, onnx_summary,
+)
 from mmlspark_tpu.importers.torch_import import (
     TORCHVISION_RESNET18_SPEC, TORCHVISION_RESNET34_SPEC,
     import_torch_checkpoint, import_torchvision_resnet,
@@ -17,6 +22,7 @@ from mmlspark_tpu.importers.torch_import import (
 
 __all__ = [
     "TORCHVISION_RESNET18_SPEC", "TORCHVISION_RESNET34_SPEC",
-    "import_torch_checkpoint", "import_torchvision_resnet",
-    "load_checkpoint_file", "load_safetensors_file", "load_torch_file",
+    "OnnxApply", "import_onnx_model", "import_torch_checkpoint",
+    "import_torchvision_resnet", "load_checkpoint_file", "load_onnx",
+    "load_safetensors_file", "load_torch_file", "onnx_summary",
 ]

@@ -1,0 +1,495 @@
+"""Dependency-free ONNX ingestion: wire-format reader + jax executor.
+
+The reference's model zoo serves published CNN checkpoints in a
+framework-neutral way (ref: src/downloader/src/main/scala/
+ModelDownloader.scala:209, Schema.scala:54 — CNTK model files behind
+URI+sha256 schemas). ONNX is today's dominant neutral interchange
+format, so "load a real published checkpoint" must hold for it, not
+just the torch ecosystem (importers/torch_import.py).
+
+No ``onnx`` package exists in the image, so this module parses the
+protobuf WIRE FORMAT directly (varint / length-delimited walking over
+the public onnx.proto field numbers — ModelProto.graph=7,
+GraphProto.{node=1, initializer=5, input=11, output=12},
+NodeProto.{input=1, output=2, name=3, op_type=4, attribute=5},
+AttributeProto.{name=1, f=2, i=3, s=4, t=5, ints=8},
+TensorProto.{dims=1, data_type=2, float_data=4, int64_data=7, name=8,
+raw_data=9}). The supported operator subset covers the published CNN
+families (torchvision resnet18/34 exports): Conv, BatchNormalization,
+Relu, MaxPool, AveragePool, GlobalAveragePool, Add, Gemm, MatMul,
+Flatten, Reshape, Identity, Constant, Clip.
+
+Execution is a small jax interpreter over the graph in ONNX's native
+NCHW layout (lax.conv_general_dilated carries the layout directly, so
+imported numerics match the exporter bit-comparably in f32). The
+executor object is picklable and plugs into TPUModel as ``modelFn`` —
+the same serving contract every zoo model uses.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# protobuf wire-format primitives
+# ---------------------------------------------------------------------------
+
+
+def _read_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint overflow (corrupt ONNX file?)")
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) over a message's bytes.
+    Values: varint -> int, 64-bit -> 8 bytes, length-delimited -> bytes,
+    32-bit -> 4 bytes. Truncated payloads raise (a short slice would
+    otherwise parse into a wrong-sized tensor and fail far away, or not
+    at all); groups (deprecated) are rejected."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wt = key >> 3, key & 7
+        if wt == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wt == 1:
+            end = pos + 8
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            end = pos + ln
+        elif wt == 5:
+            end = pos + 4
+        else:
+            raise ValueError(f"unsupported protobuf wire type {wt}")
+        if wt != 0:
+            if end > n:
+                raise ValueError(
+                    f"truncated protobuf: field {field} needs bytes "
+                    f"[{pos}, {end}) of {n}")
+            val, pos = buf[pos:end], end
+        yield field, wt, val
+
+
+# ---------------------------------------------------------------------------
+# onnx message readers (subset)
+# ---------------------------------------------------------------------------
+
+# TensorProto.DataType (public enum values)
+_DT_FLOAT, _DT_UINT8, _DT_INT8, _DT_INT32, _DT_INT64 = 1, 2, 3, 6, 7
+_DT_DOUBLE, _DT_FLOAT16 = 11, 10
+
+_TENSOR_DTYPES = {
+    _DT_FLOAT: np.float32,
+    _DT_DOUBLE: np.float64,
+    _DT_INT32: np.int32,
+    _DT_INT64: np.int64,
+    _DT_UINT8: np.uint8,
+    _DT_INT8: np.int8,
+    _DT_FLOAT16: np.float16,
+}
+
+
+def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
+    dims: List[int] = []
+    data_type = _DT_FLOAT
+    raw = b""
+    float_data: List[float] = []
+    double_data: List[float] = []
+    int64_data: List[int] = []
+    int32_data: List[int] = []
+    name = ""
+    for field, wt, val in _fields(buf):
+        if field == 1:                      # dims (repeated int64)
+            if wt == 0:
+                dims.append(val)
+            else:                           # packed
+                pos = 0
+                while pos < len(val):
+                    d, pos = _read_varint(val, pos)
+                    dims.append(d)
+        elif field == 2:
+            data_type = val
+        elif field == 4:                    # float_data
+            if wt == 5:
+                float_data.append(struct.unpack("<f", val)[0])
+            else:                           # packed
+                float_data.extend(
+                    struct.unpack(f"<{len(val) // 4}f", val))
+        elif field == 5:                    # int32_data
+            if wt == 0:
+                int32_data.append(val)
+            else:
+                pos = 0
+                while pos < len(val):
+                    d, pos = _read_varint(val, pos)
+                    int32_data.append(d)
+        elif field == 7:                    # int64_data
+            if wt == 0:
+                int64_data.append(val)
+            else:
+                pos = 0
+                while pos < len(val):
+                    d, pos = _read_varint(val, pos)
+                    int64_data.append(d)
+        elif field == 8:
+            name = val.decode("utf-8")
+        elif field == 9:
+            raw = val
+        elif field == 10:                   # double_data
+            if wt == 1:
+                double_data.append(struct.unpack("<d", val)[0])
+            else:                           # packed
+                double_data.extend(
+                    struct.unpack(f"<{len(val) // 8}d", val))
+    if data_type not in _TENSOR_DTYPES:
+        raise ValueError(
+            f"tensor {name!r}: unsupported ONNX data_type {data_type}")
+    dtype = _TENSOR_DTYPES[data_type]
+    if raw:
+        arr = np.frombuffer(raw, dtype=dtype).copy()
+    elif float_data:
+        arr = np.asarray(float_data, dtype=dtype)
+    elif double_data:
+        arr = np.asarray(double_data, dtype=dtype)
+    elif int64_data:
+        arr = np.asarray(int64_data, dtype=dtype)
+    elif int32_data:
+        if data_type == _DT_FLOAT16:
+            # spec: FLOAT16 payloads in int32_data are uint16 BIT
+            # patterns, not values — reinterpret, never cast
+            arr = np.asarray(int32_data, dtype=np.uint16).view(np.float16)
+        else:
+            arr = np.asarray(int32_data, dtype=dtype)
+    else:
+        arr = np.zeros(0, dtype=dtype)
+    if dims and arr.size != int(np.prod(dims)):
+        raise ValueError(
+            f"tensor {name!r}: payload has {arr.size} elements but dims "
+            f"{dims} need {int(np.prod(dims))} (unsupported storage "
+            f"field or corrupt file)")
+    return name, arr.reshape(dims) if dims else arr
+
+
+def _parse_attribute(buf: bytes) -> Tuple[str, Any]:
+    name = ""
+    out: Any = None
+    ints: List[int] = []
+    for field, wt, val in _fields(buf):
+        if field == 1:
+            name = val.decode("utf-8")
+        elif field == 2:                    # f (float, fixed32)
+            out = struct.unpack("<f", val)[0]
+        elif field == 3:                    # i (int)
+            out = _signed(val)
+        elif field == 4:                    # s (bytes)
+            out = val.decode("utf-8", "replace")
+        elif field == 5:                    # t (tensor)
+            out = _parse_tensor(val)[1]
+        elif field == 8:                    # ints (repeated)
+            if wt == 0:
+                ints.append(_signed(val))
+            else:
+                pos = 0
+                while pos < len(val):
+                    d, pos = _read_varint(val, pos)
+                    ints.append(_signed(d))
+    return name, (ints if ints else out)
+
+
+def _signed(v: int) -> int:
+    """proto int64 varints are two's-complement in 64 bits."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+class OnnxNode:
+    def __init__(self, op_type: str, inputs: List[str], outputs: List[str],
+                 attrs: Dict[str, Any], name: str = ""):
+        self.op_type = op_type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+        self.name = name
+
+    def __repr__(self):
+        return f"OnnxNode({self.op_type}, {self.inputs} -> {self.outputs})"
+
+
+def _parse_node(buf: bytes) -> OnnxNode:
+    inputs: List[str] = []
+    outputs: List[str] = []
+    attrs: Dict[str, Any] = {}
+    op_type = ""
+    name = ""
+    for field, _wt, val in _fields(buf):
+        if field == 1:
+            inputs.append(val.decode("utf-8"))
+        elif field == 2:
+            outputs.append(val.decode("utf-8"))
+        elif field == 3:
+            name = val.decode("utf-8")
+        elif field == 4:
+            op_type = val.decode("utf-8")
+        elif field == 5:
+            k, v = _parse_attribute(val)
+            attrs[k] = v
+    return OnnxNode(op_type, inputs, outputs, attrs, name)
+
+
+def _value_info_name(buf: bytes) -> str:
+    for field, _wt, val in _fields(buf):
+        if field == 1:
+            return val.decode("utf-8")
+    return ""
+
+
+class OnnxGraph:
+    """Parsed ONNX graph: topologically-ordered nodes, initializers,
+    graph input/output names (initializer-backed inputs excluded)."""
+
+    def __init__(self, nodes: List[OnnxNode],
+                 initializers: Dict[str, np.ndarray],
+                 inputs: List[str], outputs: List[str]):
+        self.nodes = nodes
+        self.initializers = initializers
+        self.inputs = [i for i in inputs if i not in initializers]
+        self.outputs = outputs
+
+
+SUPPORTED_OPS = {
+    "Conv", "BatchNormalization", "Relu", "MaxPool", "AveragePool",
+    "GlobalAveragePool", "Add", "Gemm", "MatMul", "Flatten", "Reshape",
+    "Identity", "Constant", "Clip",
+}
+
+
+def load_onnx(path: str) -> OnnxGraph:
+    """Parse an .onnx file into an OnnxGraph; raises with the offending
+    op list when the graph uses operators outside the supported subset
+    (fail at load, not mid-inference)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    graph_buf: Optional[bytes] = None
+    try:
+        for field, _wt, val in _fields(buf):
+            if field == 7:                  # ModelProto.graph
+                graph_buf = val
+    except (IndexError, ValueError, struct.error) as e:
+        raise ValueError(
+            f"{path!r} is not a parseable ONNX protobuf: {e}") from e
+    if graph_buf is None:
+        raise ValueError(f"{path!r} has no graph — not an ONNX model file")
+    nodes: List[OnnxNode] = []
+    inits: Dict[str, np.ndarray] = {}
+    inputs: List[str] = []
+    outputs: List[str] = []
+    try:
+        for field, _wt, val in _fields(graph_buf):
+            if field == 1:
+                nodes.append(_parse_node(val))
+            elif field == 5:
+                name, arr = _parse_tensor(val)
+                inits[name] = arr
+            elif field == 11:
+                inputs.append(_value_info_name(val))
+            elif field == 12:
+                outputs.append(_value_info_name(val))
+    except (IndexError, struct.error) as e:
+        raise ValueError(
+            f"{path!r}: corrupt/truncated ONNX graph: {e}") from e
+    unsupported = sorted({n.op_type for n in nodes} - SUPPORTED_OPS)
+    if unsupported:
+        raise ValueError(
+            f"ONNX graph uses unsupported operators {unsupported}; "
+            f"supported subset: {sorted(SUPPORTED_OPS)}")
+    return OnnxGraph(nodes, inits, inputs, outputs)
+
+
+# ---------------------------------------------------------------------------
+# jax executor
+# ---------------------------------------------------------------------------
+
+
+def _pairs(pads: List[int]) -> List[Tuple[int, int]]:
+    """ONNX pads [b0, b1, ..., e0, e1, ...] -> [(b0, e0), (b1, e1), ...]."""
+    k = len(pads) // 2
+    return [(pads[i], pads[k + i]) for i in range(k)]
+
+
+class OnnxApply:
+    """Picklable jax executor for a supported-subset ONNX graph —
+    TPUModel's ``modelFn`` contract: ``(weights, inputs_dict) -> out``.
+    Inputs/outputs are NCHW (ONNX's native layout; the convs carry it
+    through lax dimension_numbers, no transposes)."""
+
+    def __init__(self, graph: OnnxGraph, input_shape=None):
+        self.nodes = graph.nodes
+        self.input_names = graph.inputs
+        self.output_names = graph.outputs
+        # per-row shape (e.g. (3, 224, 224)) to unflatten table rows to
+        self.input_shape = tuple(input_shape) if input_shape else None
+        # Reshape targets are initializer int64 vectors in exported
+        # graphs; resolve them STATICALLY here — under jit (TPUModel
+        # compiles this apply) the weights pytree arrives as tracers and
+        # a traced shape could not concretize
+        self._static_shapes: Dict[str, List[int]] = {}
+        for node in graph.nodes:
+            if node.op_type == "Reshape" and len(node.inputs) > 1:
+                t = graph.initializers.get(node.inputs[1])
+                if t is not None:
+                    self._static_shapes[node.inputs[1]] = [
+                        int(v) for v in np.asarray(t).ravel()]
+
+    def __call__(self, weights: Dict[str, Any], inputs: Dict[str, Any]):
+        import jax.numpy as jnp
+        from jax import lax
+
+        env: Dict[str, Any] = dict(weights)
+        vals = list(inputs.values())
+        for name, v in zip(self.input_names, vals):
+            if self.input_shape:
+                v = v.reshape((v.shape[0],) + self.input_shape)
+            env[name] = v
+        for node in self.nodes:
+            a = node.attrs
+            x = [env[i] if i else None for i in node.inputs]
+            op = node.op_type
+            if op == "Conv":
+                strides = a.get("strides", [1, 1])
+                pads = a.get("pads", [0] * 4)
+                dil = a.get("dilations", [1, 1])
+                groups = int(a.get("group", 1))
+                out = lax.conv_general_dilated(
+                    x[0], jnp.asarray(x[1]), strides, _pairs(pads),
+                    rhs_dilation=dil, feature_group_count=groups,
+                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                if len(x) > 2 and x[2] is not None:
+                    out = out + jnp.asarray(x[2])[None, :, None, None]
+            elif op == "BatchNormalization":
+                eps = a.get("epsilon", 1e-5)
+                scale, b, mean, var = (jnp.asarray(t) for t in x[1:5])
+                inv = scale / jnp.sqrt(var + eps)
+                out = (x[0] - mean[None, :, None, None]) \
+                    * inv[None, :, None, None] + b[None, :, None, None]
+            elif op == "Relu":
+                out = jnp.maximum(x[0], 0)
+            elif op in ("MaxPool", "AveragePool"):
+                ks = a["kernel_shape"]
+                strides = a.get("strides", [1] * len(ks))
+                pads = _pairs(a.get("pads", [0] * (2 * len(ks))))
+                if op == "MaxPool":
+                    init, fn = -jnp.inf, lax.max
+                    out = lax.reduce_window(
+                        x[0], init, fn, (1, 1) + tuple(ks),
+                        (1, 1) + tuple(strides),
+                        [(0, 0), (0, 0)] + pads)
+                else:
+                    s = lax.reduce_window(
+                        x[0], 0.0, lax.add, (1, 1) + tuple(ks),
+                        (1, 1) + tuple(strides),
+                        [(0, 0), (0, 0)] + pads)
+                    if a.get("count_include_pad", 0):
+                        out = s / float(np.prod(ks))
+                    else:
+                        ones = jnp.ones_like(x[0])
+                        cnt = lax.reduce_window(
+                            ones, 0.0, lax.add, (1, 1) + tuple(ks),
+                            (1, 1) + tuple(strides),
+                            [(0, 0), (0, 0)] + pads)
+                        out = s / cnt
+            elif op == "GlobalAveragePool":
+                out = jnp.mean(x[0], axis=(2, 3), keepdims=True)
+            elif op == "Add":
+                out = x[0] + x[1]
+            elif op == "Gemm":
+                alpha = a.get("alpha", 1.0)
+                beta = a.get("beta", 1.0)
+                A = x[0].T if a.get("transA", 0) else x[0]
+                B = jnp.asarray(x[1])
+                if a.get("transB", 0):
+                    B = B.T
+                out = alpha * (A @ B)
+                if len(x) > 2 and x[2] is not None:
+                    out = out + beta * jnp.asarray(x[2])
+            elif op == "MatMul":
+                out = x[0] @ jnp.asarray(x[1])
+            elif op == "Flatten":
+                ax = int(a.get("axis", 1))
+                shape = x[0].shape
+                out = x[0].reshape(
+                    (int(np.prod(shape[:ax])) if ax else 1, -1))
+            elif op == "Reshape":
+                target = self._static_shapes.get(node.inputs[1])
+                if target is None:
+                    # non-initializer shape: must be concrete (eager
+                    # path only — a traced shape cannot concretize)
+                    target = np.asarray(x[1]).astype(np.int64).tolist()
+                shape = list(x[0].shape)
+                target = [shape[i] if t == 0 else int(t)
+                          for i, t in enumerate(target)]
+                out = x[0].reshape(target)
+            elif op == "Identity":
+                out = x[0]
+            elif op == "Constant":
+                out = jnp.asarray(a["value"])
+            elif op == "Clip":
+                lo = x[1] if len(x) > 1 and x[1] is not None \
+                    else a.get("min", -np.inf)
+                hi = x[2] if len(x) > 2 and x[2] is not None \
+                    else a.get("max", np.inf)
+                out = jnp.clip(x[0], lo, hi)
+            else:  # pragma: no cover — load_onnx validated the op set
+                raise ValueError(f"unsupported op {op}")
+            env[node.outputs[0]] = out
+        outs = [env[o] for o in self.output_names]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def import_onnx_model(path: str, batch_size: int = 64,
+                      input_shape=None):
+    """ONNX file -> ready-to-serve TPUModel (the ModelDownloader /
+    ImageFeaturizer contract). Weights are the graph initializers; the
+    modelFn is the jax graph executor. Inputs are NCHW float32;
+    ``input_shape`` (e.g. [3, 224, 224]) unflattens table rows."""
+    from mmlspark_tpu.models.tpu_model import TPUModel
+
+    graph = load_onnx(path)
+    if len(graph.inputs) != 1:
+        raise ValueError(
+            f"expected a single graph input, got {graph.inputs}")
+    model = TPUModel(
+        modelFn=OnnxApply(graph, input_shape=input_shape),
+        weights={k: np.asarray(v) for k, v in graph.initializers.items()},
+        inputCol="images", outputCol="scores", batchSize=batch_size,
+        computeDtype="float32")
+    return model
+
+
+def onnx_summary(path: str) -> Dict[str, Any]:
+    """Structural manifest of an ONNX file (op histogram, initializer
+    count/bytes, inputs/outputs) — the validation hook ModelDownloader
+    schemas record, mirroring the torchvision manifest discipline."""
+    graph = load_onnx(path)
+    ops: Dict[str, int] = {}
+    for node in graph.nodes:
+        ops[node.op_type] = ops.get(node.op_type, 0) + 1
+    return {
+        "ops": dict(sorted(ops.items())),
+        "num_initializers": len(graph.initializers),
+        "initializer_bytes": int(sum(
+            v.nbytes for v in graph.initializers.values())),
+        "inputs": graph.inputs,
+        "outputs": graph.outputs,
+    }

@@ -5,11 +5,13 @@ DistributedHTTPSource.scala, ServingImplicits.scala,
 PartitionConsolidator.scala).
 """
 
-from mmlspark_tpu.serving.fleet import PartitionConsolidator, ServingFleet
+from mmlspark_tpu.serving.fleet import (
+    PartitionConsolidator, ServingFleet, json_scoring_pipeline,
+)
 from mmlspark_tpu.serving.server import (
     HTTPSource, ServingEngine, SharedSingleton, SharedVariable, serve_model,
 )
 
 __all__ = ["HTTPSource", "PartitionConsolidator", "ServingEngine",
            "ServingFleet", "SharedSingleton", "SharedVariable",
-           "serve_model"]
+           "json_scoring_pipeline", "serve_model"]

@@ -35,6 +35,31 @@ from mmlspark_tpu.serving.server import HTTPSource, ServingEngine
 log = get_logger("serving.fleet")
 
 
+def json_scoring_pipeline(model, field: str = "features",
+                          reply_field: str = "prediction"):
+    """The standard model-behind-HTTP pipeline: decode JSON request
+    bodies ``{field: [floats]}``, score the micro-batch through
+    ``model`` (a TPUModel whose inputCol is ``field``), reply
+    ``{reply_field: argmax}`` per row. One implementation shared by the
+    serving bench, the throughput floor test, and user deployments —
+    the serving-side analog of ServingImplicits' request parsing
+    (ref: ServingImplicits.scala)."""
+    import numpy as np
+    from mmlspark_tpu.stages.basic import Lambda
+
+    def handle(table: DataTable) -> DataTable:
+        feats = np.stack([
+            np.asarray(json.loads(r["entity"].decode())[field],
+                       dtype=np.float32)
+            for r in table["request"]])
+        scored = model.transform(DataTable({field: feats}))
+        preds = np.asarray(scored[model.get("outputCol")]).argmax(-1)
+        return table.with_column(
+            "reply", [{reply_field: int(p)} for p in preds])
+
+    return Lambda.apply(handle)
+
+
 class ServingFleet:
     """N serving engines over one pipeline — one per host in a real
     deployment, N ports on one host in simulation/tests. Replies always

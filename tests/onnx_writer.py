@@ -1,0 +1,198 @@
+"""Minimal ONNX protobuf WRITER (tests only).
+
+The image has no ``onnx`` package and no egress, so tests synthesize
+genuine ONNX protobuf bytes with this hand-rolled wire-format encoder
+(the reader under test, importers/onnx_import.py, walks the same public
+onnx.proto field numbers but shares no code with this writer). Produces
+files any standard ONNX runtime would parse: proper ModelProto with
+ir_version, opset_import, and a GraphProto of nodes / initializers /
+value-info inputs+outputs.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wt: int) -> bytes:
+    return _varint((field << 3) | wt)
+
+
+def _ld(field: int, payload: bytes) -> bytes:        # length-delimited
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _int_field(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v & ((1 << 64) - 1))
+
+
+def _float_field(field: int, v: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+_NP_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.float64): 11,
+               np.dtype(np.int64): 7, np.dtype(np.int32): 6}
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    out = b""
+    for d in arr.shape:
+        out += _int_field(1, d)                       # dims
+    out += _int_field(2, _NP_TO_ONNX[arr.dtype])      # data_type
+    out += _ld(8, name.encode())                      # name
+    out += _ld(9, arr.tobytes())                      # raw_data
+    return out
+
+
+def _attr(name: str, value: Any) -> bytes:
+    out = _ld(1, name.encode())
+    if isinstance(value, (list, tuple)):
+        for v in value:
+            out += _int_field(8, int(v))              # ints
+        out += _int_field(20, 7)                      # type = INTS
+    elif isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        out += _int_field(3, int(value))              # i
+        out += _int_field(20, 2)                      # type = INT
+    elif isinstance(value, float):
+        out += _float_field(2, value)                 # f
+        out += _int_field(20, 1)                      # type = FLOAT
+    elif isinstance(value, np.ndarray):
+        out += _ld(5, tensor("", value))              # t
+        out += _int_field(20, 4)                      # type = TENSOR
+    else:
+        raise TypeError(f"attr {name}: {type(value)}")
+    return out
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         **attrs: Any) -> bytes:
+    out = b""
+    for i in inputs:
+        out += _ld(1, i.encode())
+    for o in outputs:
+        out += _ld(2, o.encode())
+    out += _ld(4, op_type.encode())
+    for k, v in attrs.items():
+        out += _ld(5, _attr(k, v))
+    return out
+
+
+def _value_info(name: str) -> bytes:
+    return _ld(1, name.encode())
+
+
+def model(nodes: List[bytes], initializers: Dict[str, np.ndarray],
+          input_name: str, output_name: str) -> bytes:
+    graph = b""
+    for nd in nodes:
+        graph += _ld(1, nd)
+    graph += _ld(2, b"graph")
+    for name, arr in initializers.items():
+        graph += _ld(5, tensor(name, arr))
+    graph += _ld(11, _value_info(input_name))
+    graph += _ld(12, _value_info(output_name))
+    opset = _ld(1, b"") + _int_field(2, 17)           # default domain, v17
+    return (_int_field(1, 8)                          # ir_version
+            + _ld(8, opset)                           # opset_import
+            + _ld(7, graph))                          # graph
+
+
+# ---------------------------------------------------------------------------
+# resnet18 graph (torchvision architecture, random weights)
+# ---------------------------------------------------------------------------
+
+
+def resnet18_onnx(path: str, num_classes: int = 1000, seed: int = 0,
+                  width: int = 64) -> Dict[str, np.ndarray]:
+    """Write a torchvision-architecture resnet18 as ONNX; returns the
+    weight dict so a torch twin can be built for ground truth."""
+    rng = np.random.default_rng(seed)
+    weights: Dict[str, np.ndarray] = {}
+    nodes: List[bytes] = []
+
+    def w(name: str, shape, scale=0.1) -> str:
+        weights[name] = rng.normal(scale=scale, size=shape
+                                   ).astype(np.float32)
+        return name
+
+    def conv(x: str, out: str, prefix: str, cin: int, cout: int, k: int,
+             stride: int, pad: int) -> str:
+        nodes.append(node(
+            "Conv", [x, w(f"{prefix}.weight", (cout, cin, k, k))], [out],
+            kernel_shape=[k, k], strides=[stride, stride],
+            pads=[pad, pad, pad, pad], dilations=[1, 1], group=1))
+        return out
+
+    def bn(x: str, out: str, prefix: str, c: int) -> str:
+        weights[f"{prefix}.weight"] = rng.uniform(
+            0.5, 1.5, c).astype(np.float32)
+        weights[f"{prefix}.bias"] = rng.normal(
+            scale=0.1, size=c).astype(np.float32)
+        weights[f"{prefix}.running_mean"] = rng.normal(
+            scale=0.1, size=c).astype(np.float32)
+        weights[f"{prefix}.running_var"] = rng.uniform(
+            0.5, 1.5, c).astype(np.float32)
+        nodes.append(node(
+            "BatchNormalization",
+            [x, f"{prefix}.weight", f"{prefix}.bias",
+             f"{prefix}.running_mean", f"{prefix}.running_var"],
+            [out], epsilon=1e-5))
+        return out
+
+    def relu(x: str, out: str) -> str:
+        nodes.append(node("Relu", [x], [out]))
+        return out
+
+    x = conv("input", "c1", "conv1", 3, width, 7, 2, 3)
+    x = bn(x, "b1", "bn1", width)
+    x = relu(x, "r1")
+    nodes.append(node("MaxPool", [x], ["p1"], kernel_shape=[3, 3],
+                      strides=[2, 2], pads=[1, 1, 1, 1]))
+    x = "p1"
+    cin = width
+    for li, (cout, stride) in enumerate(
+            [(width, 1), (2 * width, 2), (4 * width, 2), (8 * width, 2)]):
+        for blk in range(2):
+            s = stride if blk == 0 else 1
+            p = f"layer{li + 1}.{blk}"
+            y = conv(x, f"{p}.y1", f"{p}.conv1", cin, cout, 3, s, 1)
+            y = bn(y, f"{p}.yb1", f"{p}.bn1", cout)
+            y = relu(y, f"{p}.yr1")
+            y = conv(y, f"{p}.y2", f"{p}.conv2", cout, cout, 3, 1, 1)
+            y = bn(y, f"{p}.yb2", f"{p}.bn2", cout)
+            if s != 1 or cin != cout:
+                d = conv(x, f"{p}.d", f"{p}.downsample.0",
+                         cin, cout, 1, s, 0)
+                d = bn(d, f"{p}.db", f"{p}.downsample.1", cout)
+            else:
+                d = x
+            nodes.append(node("Add", [y, d], [f"{p}.sum"]))
+            x = relu(f"{p}.sum", f"{p}.out")
+            cin = cout
+    nodes.append(node("GlobalAveragePool", [x], ["gap"]))
+    nodes.append(node("Flatten", ["gap"], ["flat"], axis=1))
+    nodes.append(node(
+        "Gemm", ["flat", w("fc.weight", (num_classes, 8 * width)),
+                 w("fc.bias", (num_classes,), 0.05)],
+        ["output"], alpha=1.0, beta=1.0, transB=1))
+
+    blob = model(nodes, weights, "input", "output")
+    with open(path, "wb") as f:
+        f.write(blob)
+    return weights

@@ -356,3 +356,51 @@ class TestMiniBatch:
         flat = FlattenBatch().transform(batched)
         assert len(flat) == 0
         assert "a" in flat.column_names
+
+
+class TestServingThroughput:
+    """Serving performance floor (bench.py bench_serving measures the
+    real-chip number; this guards the machinery from regressing into
+    per-request recompiles or serialized batching on any backend)."""
+
+    def test_fleet_qps_floor(self):
+        import concurrent.futures
+        import time as _time
+
+        import jax
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+
+        dim, n_req, clients = 32, 60, 6
+        module = build_network({"type": "mlp", "features": [32],
+                                "num_classes": 4})
+        weights = {"params": module.init(
+            jax.random.PRNGKey(0), np.zeros((1, dim), np.float32))["params"]}
+        model = TPUModel(modelFn=lambda w, ins: module.apply(
+            {"params": w["params"]}, list(ins.values())[0]),
+            weights=weights, inputCol="features", outputCol="scores",
+            batchSize=64, computeDtype="float32")
+
+        fleet = ServingFleet(json_scoring_pipeline(model), n_engines=2,
+                             base_port=18880, batch_size=64)
+        payload = {"features": [0.1] * dim}
+        try:
+            for addr in fleet.addresses:          # warmup compiles
+                _post(addr, payload, timeout=60)
+            t0 = _time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                futs = [ex.submit(_post, fleet.addresses[i % 2], payload,
+                                  60) for i in range(n_req)]
+                for f in concurrent.futures.as_completed(futs):
+                    status, body = f.result()
+                    assert status == 200 and "prediction" in body
+            wall = _time.perf_counter() - t0
+        finally:
+            fleet.stop_all()
+        qps = n_req / wall
+        # conservative floor: a single shared CPU core must still push
+        # >= 10 req/s through batch assembly + jitted scoring + replies
+        assert qps >= 10, f"serving throughput collapsed: {qps:.1f} qps"

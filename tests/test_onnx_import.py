@@ -1,0 +1,241 @@
+"""ONNX ingestion tests (importers/onnx_import.py).
+
+The correctness bar mirrors the torchvision-import suite: an ONNX
+resnet18 file — genuine protobuf bytes produced by an independent
+writer (tests/onnx_writer.py), not by the reader's own code — must
+predict identically to a same-weights torch model through TPUModel
+(ref: ModelDownloader.scala:209 — the zoo serves real published CNNs).
+"""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.importers.onnx_import import (
+    OnnxApply, import_onnx_model, load_onnx, onnx_summary,
+)
+from tests import onnx_writer as ow
+
+
+@pytest.fixture(scope="module")
+def resnet18_file(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("onnx") / "resnet18.onnx")
+    weights = ow.resnet18_onnx(path, num_classes=10, width=8, seed=3)
+    return path, weights
+
+
+def _torch_resnet18(weights, num_classes=10, width=8):
+    """torchvision-architecture resnet18 built from plain torch.nn,
+    loaded with the generated weights — the ground truth."""
+    import torch
+    import torch.nn as nn
+
+    class BasicBlock(nn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.conv1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(cout)
+            self.conv2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(cout)
+            self.downsample = None
+            if stride != 1 or cin != cout:
+                self.downsample = nn.Sequential(
+                    nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            idn = x if self.downsample is None else self.downsample(x)
+            y = torch.relu(self.bn1(self.conv1(x)))
+            y = self.bn2(self.conv2(y))
+            return torch.relu(y + idn)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, width, 7, 2, 3, bias=False)
+            self.bn1 = nn.BatchNorm2d(width)
+            self.maxpool = nn.MaxPool2d(3, 2, 1)
+            cin = width
+            for li, (cout, stride) in enumerate(
+                    [(width, 1), (2 * width, 2), (4 * width, 2),
+                     (8 * width, 2)]):
+                blocks = []
+                for blk in range(2):
+                    blocks.append(BasicBlock(
+                        cin, cout, stride if blk == 0 else 1))
+                    cin = cout
+                setattr(self, f"layer{li + 1}", nn.Sequential(*blocks))
+            self.fc = nn.Linear(8 * width, num_classes)
+
+        def forward(self, x):
+            x = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+            x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+            x = x.mean(dim=(2, 3))
+            return self.fc(x)
+
+    net = Net().eval()
+    state = {}
+    for k, v in weights.items():
+        # the ONNX conv weights carry no bias; names already match
+        # torch's state_dict convention by construction
+        state[k] = torch.from_numpy(np.asarray(v))
+    missing, unexpected = net.load_state_dict(state, strict=False)
+    # only num_batches_tracked counters may be missing
+    assert all("num_batches_tracked" in m for m in missing), missing
+    assert not unexpected, unexpected
+    return net
+
+
+class TestWireParsing:
+    def test_summary(self, resnet18_file):
+        path, weights = resnet18_file
+        s = onnx_summary(path)
+        assert s["ops"]["Conv"] == 20          # 16 block + 3 downsample + stem
+        assert s["ops"]["BatchNormalization"] == 20
+        assert s["ops"]["Add"] == 8
+        assert s["ops"]["Gemm"] == 1
+        assert s["num_initializers"] == len(weights)
+        assert s["inputs"] == ["input"]
+        assert s["outputs"] == ["output"]
+
+    def test_initializer_roundtrip(self, resnet18_file):
+        path, weights = resnet18_file
+        graph = load_onnx(path)
+        for name, arr in weights.items():
+            np.testing.assert_array_equal(graph.initializers[name], arr)
+
+    def test_unsupported_op_rejected(self, tmp_path):
+        blob = ow.model([ow.node("LSTM", ["x"], ["y"])], {}, "x", "y")
+        p = tmp_path / "bad.onnx"
+        p.write_bytes(blob)
+        with pytest.raises(ValueError, match="LSTM"):
+            load_onnx(str(p))
+
+    def test_not_onnx_rejected(self, tmp_path):
+        p = tmp_path / "junk.onnx"
+        p.write_bytes(b"\x00\x01\x02")
+        with pytest.raises(ValueError):
+            load_onnx(str(p))
+
+
+class TestExecution:
+    def test_resnet18_matches_torch(self, resnet18_file):
+        path, weights = resnet18_file
+        net = _torch_resnet18(weights)
+        import torch
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 3, 64, 64)).astype(np.float32)
+        with torch.no_grad():
+            ref = net(torch.from_numpy(x)).numpy()
+        graph = load_onnx(path)
+        out = np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"images": x}))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+
+    def test_through_tpu_model(self, resnet18_file):
+        from mmlspark_tpu.core.table import DataTable
+        path, weights = resnet18_file
+        net = _torch_resnet18(weights)
+        import torch
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(6, 3, 32, 32)).astype(np.float32)
+        with torch.no_grad():
+            ref = net(torch.from_numpy(x)).numpy()
+        model = import_onnx_model(path, batch_size=4,
+                                  input_shape=[3, 32, 32])
+        table = DataTable({"images": x.reshape(6, -1)})
+        out = np.asarray(model.transform(table)["scores"])
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-4)
+        assert np.array_equal(out.argmax(1), ref.argmax(1))
+
+    def test_pool_variants_and_clip(self, tmp_path):
+        """AveragePool/Reshape/Clip ops against torch semantics —
+        Reshape's target is an int64 initializer (the torch.onnx.export
+        pattern) and the whole graph runs JITTED through TPUModel, the
+        path where a traced shape tensor could not concretize."""
+        import torch
+        from mmlspark_tpu.core.table import DataTable
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        nodes = [
+            ow.node("AveragePool", ["input"], ["ap"], kernel_shape=[2, 2],
+                    strides=[2, 2], pads=[0, 0, 0, 0]),
+            ow.node("Clip", ["ap"], ["cl"], min=-0.5, max=0.5),
+            ow.node("Reshape", ["cl", "shape"], ["output"]),
+        ]
+        inits = {"shape": np.asarray([0, -1], np.int64)}  # 0 = keep dim
+        p = tmp_path / "pool.onnx"
+        p.write_bytes(ow.model(nodes, inits, "input", "output"))
+        graph = load_onnx(str(p))
+        ref = torch.clamp(
+            torch.nn.functional.avg_pool2d(torch.from_numpy(x), 2, 2),
+            -0.5, 0.5).flatten(1).numpy()
+        out = np.asarray(OnnxApply(graph)(
+            {"shape": inits["shape"]}, {"images": x}))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # jitted path: TPUModel compiles the executor; weights (incl.
+        # the shape initializer) become tracers
+        model = import_onnx_model(str(p), batch_size=2,
+                                  input_shape=[3, 8, 8])
+        out2 = np.asarray(model.transform(
+            DataTable({"images": x.reshape(2, -1)}))["scores"])
+        np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
+
+    def test_float16_bit_pattern_payload(self, tmp_path):
+        """FLOAT16 int32_data carries uint16 BIT PATTERNS per spec —
+        reinterpreted, not value-cast."""
+        import struct as _struct
+        vals = np.asarray([1.0, -2.5, 0.5], np.float16)
+        bits = vals.view(np.uint16)
+        # hand-encode a TensorProto with int32_data (field 5, varints)
+        body = b""
+        body += ow._int_field(1, 3)                  # dims = [3]
+        body += ow._int_field(2, 10)                 # data_type FLOAT16
+        for b in bits:
+            body += ow._int_field(5, int(b))         # int32_data
+        body += ow._ld(8, b"w")                      # name
+        nodes = [ow.node("Identity", ["input"], ["output"])]
+        graph = b"".join([ow._ld(1, n) for n in nodes]) \
+            + ow._ld(5, body) \
+            + ow._ld(11, ow._value_info("input")) \
+            + ow._ld(12, ow._value_info("output"))
+        blob = ow._int_field(1, 8) + ow._ld(7, graph)
+        p = tmp_path / "f16.onnx"
+        p.write_bytes(blob)
+        graph_p = load_onnx(str(p))
+        np.testing.assert_array_equal(
+            graph_p.initializers["w"].astype(np.float32),
+            vals.astype(np.float32))
+
+    def test_truncated_file_fails_fast(self, resnet18_file, tmp_path):
+        path, _ = resnet18_file
+        with open(path, "rb") as f:
+            blob = f.read()
+        p = tmp_path / "trunc.onnx"
+        p.write_bytes(blob[:len(blob) // 2])
+        with pytest.raises(ValueError):
+            load_onnx(str(p))
+
+
+class TestDownloaderPublish:
+    def test_publish_and_reload(self, resnet18_file, tmp_path):
+        """ONNX models publish through ModelDownloader like every zoo
+        model: blob + sha256 schema, reload, predict."""
+        from mmlspark_tpu.downloader import LocalRepo
+        path, _ = resnet18_file
+        repo = LocalRepo(str(tmp_path / "repo"))
+        with open(path, "rb") as f:
+            blob = f.read()
+        repo.publish(
+            "onnx_resnet18",
+            {"format": "onnx", "onnx_summary": onnx_summary(path)},
+            blob=blob, model_type="classification")
+        got = repo.get_schema("onnx_resnet18")
+        assert got.network_spec["onnx_summary"]["ops"]["Conv"] == 20
+        blob2 = repo.read_blob(got, verify=True)
+        assert blob2 == blob
+        # reload from the repo blob and execute
+        p2 = tmp_path / "reload.onnx"
+        p2.write_bytes(blob2)
+        model = import_onnx_model(str(p2))
+        assert model is not None
