@@ -160,7 +160,9 @@ class TestHistogram:
 
     @pytest.mark.parametrize("n,f,L,B", [
         (700, 20, 6, 16),     # n > ROW_CHUNK: row-chunk accumulation
-        (600, 20, 1, 256),    # B=256 -> fc=8 < f_p: feature-chunk grid
+        (600, 20, 1, 256),    # B=256: single-leaf digit-decomposition
+        (600, 20, 1, 160),    # b_pad=160: non-power-of-2 nibble (l=80)
+        (600, 20, 1, 100),    # b_pad=128 boundary of the nibble route
         (100, 3, 4, 8),       # single row chunk, tiny shapes
     ])
     def test_pallas_matches_scatter(self, n, f, L, B):
