@@ -176,6 +176,25 @@ def main() -> None:
     vt_ok = int(np.mean((vt.predict(GX) > 0.5) == GY) > 0.9)
     print(f"VOTEGBDT {pid} {vt_digest},{vt_ok}", flush=True)
 
+    # multi-host feature-parallel with SPARSE input: the dataset digest
+    # hashes the CSR buffers (densifying would defeat the sparse path);
+    # forests must still be byte-identical across hosts
+    from mmlspark_tpu.core.sparse import CSRMatrix
+    dense_for_csr = GX.copy()
+    dense_for_csr[np.abs(dense_for_csr) < 0.6] = 0.0   # ~45% sparse
+    csr_X = CSRMatrix.from_dense(dense_for_csr.astype(np.float32))
+    fps = gbdt_train(
+        {"objective": "binary", "num_iterations": 4, "num_leaves": 7,
+         "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "feature",
+         "hist_method": "scatter"},
+        csr_X, GY)
+    fps_digest = hashlib.sha256(
+        fps.model_to_string().encode()).hexdigest()[:16]
+    # 0.80 floor: zeroing |x|<0.6 costs signal — single-process serial
+    # training on the same CSR data also lands at 0.8275
+    fps_ok = int(np.mean((fps.predict(csr_X) > 0.5) == GY) > 0.80)
+    print(f"FPCSR {pid} {fps_digest},{fps_ok}", flush=True)
+
     # f64-faithful multi-host binning: a feature at 2^24 scale whose
     # distinct values collapse under an f32 wire. The agreed boundaries
     # must equal a single-host f64 BinMapper fit on the concatenated

@@ -59,6 +59,7 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     streamed = {}
     gbdt = {}
     fp_gbdt = {}
+    fp_csr = {}
     vote_gbdt = {}
     f64bin = {}
     devfeed = {}
@@ -85,6 +86,9 @@ def test_multiprocess_rendezvous_and_psum(nproc):
             if line.startswith("FPGBDT"):
                 _, pid, vals = line.split()
                 fp_gbdt[int(pid)] = vals
+            if line.startswith("FPCSR"):
+                _, pid, vals = line.split()
+                fp_csr[int(pid)] = vals
             if line.startswith("VOTEGBDT"):
                 _, pid, vals = line.split()
                 vote_gbdt[int(pid)] = vals
@@ -114,6 +118,11 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     assert len(fp_gbdt) == nproc
     assert len(set(fp_gbdt.values())) == 1, fp_gbdt
     assert all(v.endswith(",1") for v in fp_gbdt.values()), fp_gbdt
+    # feature-parallel with CSR input (digest hashes the sparse buffers;
+    # trailing ,1 = the forest also predicts the data well)
+    assert len(fp_csr) == nproc
+    assert len(set(fp_csr.values())) == 1, fp_csr
+    assert all(v.endswith(",1") for v in fp_csr.values()), fp_csr
     # multi-host VOTING-parallel: byte-identical forests from row shards
     assert len(vote_gbdt) == nproc
     assert len(set(vote_gbdt.values())) == 1, vote_gbdt
