@@ -270,7 +270,7 @@ def bench_serving() -> dict:
         batchSize=256, computeDtype="float32")
 
     fleet = ServingFleet(json_scoring_pipeline(model), n_engines=2,
-                         base_port=18800, batch_size=256)
+                         base_port=18800, batch_size=256, workers=2)
     payload = {"features": rng.normal(size=SERVING_FEATURE_DIM).tolist()}
 
     def post(_i):
@@ -299,8 +299,8 @@ def bench_serving() -> dict:
         "p50_ms": round(float(np.percentile(lat, 50)), 1),
         "p99_ms": round(float(np.percentile(lat, 99)), 1),
         "config": (f"{SERVING_REQUESTS} reqs, {SERVING_CLIENTS} clients, "
-                   f"2 engines, MLP-{SERVING_FEATURE_DIM} TPUModel, "
-                   f"batch 256"),
+                   f"2 engines x 2 workers, MLP-{SERVING_FEATURE_DIM} "
+                   f"TPUModel, batch 256"),
     }
 
 

@@ -170,11 +170,26 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         # their ids through float compute dtypes
         int_input = bool(getattr(self.get("modelFn"), "int_input", False))
 
+        def _bucket(rows: int) -> int:
+            """Pad partial batches up to a power-of-two row count (capped
+            at batchSize): the jitted forward is shape-keyed, so ragged
+            batch sizes — serving micro-batches drain whatever is queued
+            — would each trigger a fresh XLA compile (seconds through a
+            remote backend). Buckets bound the distinct shapes to
+            log2(batchSize)+1; padded rows are sliced off by the
+            [:true_len] readback."""
+            b = 8
+            while b < rows:
+                b *= 2
+            return min(b, batch_size)
+
         def prepare(start):
             """Host batch assembly + device_put — runs on the prefetch
             thread so transfers overlap the current batch's compute
             (the host-bound loop VERDICT flagged in :168-190)."""
             stop = min(start + batch_size, n)
+            rows = stop - start
+            bucket = _bucket(rows)
             inputs = {}
             for model_in, col_name in feeds.items():
                 field = table.schema.get(col_name)
@@ -182,11 +197,14 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                 host_dtype = np.int32 if int_input else (
                     np.float32 if dtype == jnp.bfloat16 else dtype)
                 arr = _column_to_array(arr, field, host_dtype)
+                if bucket > rows:
+                    arr = np.concatenate([arr, np.zeros(
+                        (bucket - rows,) + arr.shape[1:], arr.dtype)])
                 sharded, _ = mesh_lib.shard_batch(mesh, arr)
                 if dtype == jnp.bfloat16 and not int_input:
                     sharded = sharded.astype(jnp.bfloat16)
                 inputs[model_in] = sharded
-            return stop - start, inputs
+            return rows, inputs
 
         def flush(item):
             true_len, outputs = item

@@ -68,7 +68,8 @@ class ServingFleet:
 
     def __init__(self, pipeline, n_engines: int = 2,
                  host: str = "127.0.0.1", base_port: int = 18700,
-                 batch_size: int = 64, reply_col: str = "reply"):
+                 batch_size: int = 64, reply_col: str = "reply",
+                 workers: int = 1):
         self.engines: List[ServingEngine] = []
         port = base_port
         try:
@@ -78,7 +79,8 @@ class ServingFleet:
                 try:
                     engine = ServingEngine(source, pipeline,
                                            reply_col=reply_col,
-                                           batch_size=batch_size).start()
+                                           batch_size=batch_size,
+                                           workers=workers).start()
                 except Exception:
                     source.close()   # don't orphan the bound port
                     raise

@@ -227,7 +227,7 @@ class ServingEngine:
                  reply_col: str = "reply", id_col: str = "id",
                  batch_size: int = 64,
                  content_type: str = "application/json",
-                 error_col: str = "error"):
+                 error_col: str = "error", workers: int = 1):
         self.source = source
         self.pipeline = pipeline
         self.reply_col = reply_col
@@ -235,9 +235,18 @@ class ServingEngine:
         self.batch_size = batch_size
         self.content_type = content_type
         self.error_col = error_col
+        # workers > 1 drains the queue from N loop threads, so batch
+        # N+1 assembles (and its replies flush) while batch N's device
+        # round-trip is in flight — the accelerator round-trip otherwise
+        # serializes the whole engine (jit dispatch is thread-safe).
+        # CONTRACT: pipeline.transform must itself be thread-safe under
+        # workers > 1 (TPUModel is; a Lambda closing over mutable state
+        # is only if it locks)
+        self.workers = max(1, int(workers))
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._threads: List[threading.Thread] = []
         self.batches_processed = 0
+        self._stats_lock = threading.Lock()
 
     def _respond_ok(self, rid: str, rep: Any) -> None:
         body = rep if isinstance(rep, (bytes, str)) \
@@ -279,7 +288,8 @@ class ServingEngine:
         except Exception as e:  # noqa: BLE001 — isolate the poison row(s)
             log.warning("serving batch failed (%s); retrying per-row", e)
             self._process_rows_individually(table, ids)
-            self.batches_processed += 1
+            with self._stats_lock:
+                self.batches_processed += 1
             return len(ids)
         try:
             self._answer_output(out, ids)
@@ -288,7 +298,8 @@ class ServingEngine:
             for rid in ids:
                 self.source.respond(rid, HTTPSchema.response(
                     500, f"reply error: {e}", None))
-        self.batches_processed += 1
+        with self._stats_lock:
+            self.batches_processed += 1
         return len(ids)
 
     def _process_rows_individually(self, table: DataTable,
@@ -316,22 +327,28 @@ class ServingEngine:
                     n = 0
                 if n == 0:
                     time.sleep(0.005)
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+        for _ in range(self.workers):
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            self._threads.append(t)
         return self
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        for t in self._threads:
+            t.join(timeout=5)
         self.source.close()
 
 
 def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
                 port: int = 8899, batch_size: int = 64,
-                reply_col: str = "reply") -> ServingEngine:
+                reply_col: str = "reply",
+                workers: int = 1) -> ServingEngine:
     """One-call serving: the ``.server()`` DSL analog
-    (ref: ServingImplicits.scala:10-50)."""
+    (ref: ServingImplicits.scala:10-50). ``workers`` > 1 overlaps the
+    accelerator round-trip of one micro-batch with the assembly of the
+    next; the pipeline's ``transform`` must then be thread-safe
+    (TPUModel is)."""
     source = HTTPSource(host=host, port=port)
     return ServingEngine(source, pipeline, reply_col=reply_col,
-                         batch_size=batch_size).start()
+                         batch_size=batch_size, workers=workers).start()
