@@ -75,6 +75,11 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         self._mesh: Optional[Mesh] = None
         self._jitted: Dict[Tuple, Callable] = {}
         self._device_weights = None
+        # lazy init is shared mutable state; concurrent first calls
+        # (multi-worker serving engines) must not race it — a race would
+        # device_put N transient copies of the full weight tree
+        import threading
+        self._init_lock = threading.Lock()
 
     def _on_param_change(self, name: str) -> None:
         if name == "weights":
@@ -109,18 +114,23 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
 
     def _get_mesh(self) -> Mesh:
         if self._mesh is None:
-            self._mesh = mesh_lib.make_mesh()
+            with self._init_lock:
+                if self._mesh is None:
+                    self._mesh = mesh_lib.make_mesh()
         return self._mesh
 
     def _weights_on_device(self):
         """Replicate weights across the mesh once (broadcast analog,
-        ref: CNTKModel.scala:413 rebroadcastCNTKModel)."""
+        ref: CNTKModel.scala:413 rebroadcastCNTKModel). Double-checked
+        locking: thread-safe under multi-worker serving."""
         if self._device_weights is None:
             m = self._get_mesh()
-            repl = NamedSharding(m, P())
-            self._device_weights = jax.tree_util.tree_map(
-                lambda a: jax.device_put(jnp.asarray(a), repl),
-                self.get("weights"))
+            with self._init_lock:
+                if self._device_weights is None:
+                    repl = NamedSharding(m, P())
+                    self._device_weights = jax.tree_util.tree_map(
+                        lambda a: jax.device_put(jnp.asarray(a), repl),
+                        self.get("weights"))
         return self._device_weights
 
     def _feeds(self) -> Dict[str, str]:
@@ -140,16 +150,19 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         internally); invalidated when modelFn changes."""
         fn = self._jitted.get("run")
         if fn is None:
-            model_fn = self.get("modelFn")
+            with self._init_lock:
+                fn = self._jitted.get("run")
+                if fn is None:
+                    model_fn = self.get("modelFn")
 
-            def run(weights, inputs: Dict[str, jnp.ndarray]):
-                out = model_fn(weights, inputs)
-                if not isinstance(out, dict):
-                    out = {"output": out}
-                return out
+                    def run(weights, inputs: Dict[str, jnp.ndarray]):
+                        out = model_fn(weights, inputs)
+                        if not isinstance(out, dict):
+                            out = {"output": out}
+                        return out
 
-            fn = jax.jit(run)
-            self._jitted["run"] = fn
+                    fn = jax.jit(run)
+                    self._jitted["run"] = fn
         return fn
 
     # -- transform ----------------------------------------------------------
@@ -198,8 +211,11 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                     np.float32 if dtype == jnp.bfloat16 else dtype)
                 arr = _column_to_array(arr, field, host_dtype)
                 if bucket > rows:
-                    arr = np.concatenate([arr, np.zeros(
-                        (bucket - rows,) + arr.shape[1:], arr.dtype)])
+                    # edge-pad (pad_to_multiple's discipline): padded
+                    # rows stay VALID inputs, so models with log/1-over/
+                    # normalization paths can't turn them into NaNs that
+                    # a cross-row computation would spread to real rows
+                    arr, _ = mesh_lib.pad_to_multiple(arr, bucket, axis=0)
                 sharded, _ = mesh_lib.shard_batch(mesh, arr)
                 if dtype == jnp.bfloat16 and not int_input:
                     sharded = sharded.astype(jnp.bfloat16)

@@ -48,16 +48,21 @@ BLOCK_Q = 1024
 BLOCK_K = 1024
 
 
-@functools.lru_cache(maxsize=1)
-def _block_caps():
-    """Per-generation block ceiling: the tuned 1024 blocks are VMEM-safe
-    on v5e+ (measured); unknown/older parts keep the conservative 256."""
+@functools.lru_cache(maxsize=8)
+def _block_caps(d: int):
+    """Per-generation, per-head-dim block ceiling: the tuned 1024 blocks
+    are VMEM-safe on v5e+ up to D=128 (measured); D=160 overflows the
+    16 MB scoped-vmem limit in the backward (observed: 16.78M request),
+    so wider heads halve the blocks. Unknown/older parts keep the
+    conservative 256."""
     try:
         kind = jax.devices()[0].device_kind
     except Exception:  # backend not initialized yet
         return 256, 256
     if any(t in kind for t in ("v5", "v6", "v7")):
-        return BLOCK_Q, BLOCK_K
+        if d <= 128:
+            return BLOCK_Q, BLOCK_K
+        return min(BLOCK_Q, 512), min(BLOCK_K, 512)
     return min(BLOCK_Q, 256), min(BLOCK_K, 256)
 
 
@@ -222,19 +227,19 @@ def _dkv_kernel(k_ref, v_ref, q_ref, g_ref, lse_ref, dlt_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _blocks(lq, lk):
-    cap_q, cap_k = _block_caps()
+def _blocks(lq, lk, d):
+    cap_q, cap_k = _block_caps(d)
     bq = min(cap_q, max(8, lq + ((-lq) % 8)))
     bk = min(cap_k, max(128, lk + ((-lk) % 128)))
     return bq, bk, (-lq) % bq, (-lk) % bk
 
 
-def _lse_pad(lq: int) -> int:
+def _lse_pad(lq: int, d: int) -> int:
     """Padded Q length of the forward's lse output — callers that
     fabricate lse-shaped tensors (ring_flash_attention's masked hop)
     must match it, so derive it from _blocks rather than restating the
     block-size formula."""
-    _, _, pad_q, _ = _blocks(lq, lq)
+    _, _, pad_q, _ = _blocks(lq, lq, d)
     return lq + pad_q
 
 
@@ -286,7 +291,7 @@ def _flash_forward(q, k, v, causal: bool = False, q_offset: int = 0,
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / float(d) ** 0.5
-    bq, bk, pad_q, pad_k = _blocks(lq, lk)
+    bq, bk, pad_q, pad_k = _blocks(lq, lk, d)
 
     # heads-major (BH, L, D) layout for per-(batch, head) grid blocks
     qt = _heads_major(q, pad_q)
@@ -334,7 +339,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, q_offset, k_offset,
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = 1.0 / float(d) ** 0.5
-    bq, bk, pad_q, pad_k = _blocks(lq, lk)
+    bq, bk, pad_q, pad_k = _blocks(lq, lk, d)
 
     qt = _heads_major(q, pad_q)
     kt = _heads_major(k, pad_k)

@@ -205,7 +205,8 @@ def _hop_forward(q, k_cur, v_cur, branch, causal, interpret):
 
     def masked(_):
         return (jnp.zeros((b, lq, h, d), jnp.float32),
-                jnp.full((b * h, _lse_pad(lq), 1), NEG_INF, jnp.float32))
+                jnp.full((b * h, _lse_pad(lq, d), 1), NEG_INF,
+                         jnp.float32))
 
     if not causal:
         return full(None)
@@ -291,7 +292,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
         return out_run, lse_run, k_nxt, v_nxt
 
     out0 = jnp.zeros((b, lq, h, d), jnp.float32)
-    lse0 = jnp.full((b * h, _lse_pad(lq), 1), NEG_INF, jnp.float32)
+    lse0 = jnp.full((b * h, _lse_pad(lq, d), 1), NEG_INF, jnp.float32)
     # n rotations total -> K/V return to their owners (no drift)
     out, lse, _, _ = lax.fori_loop(0, n, step, (out0, lse0, k, v))
     return out.astype(q.dtype), lse
