@@ -97,3 +97,36 @@ def test_bfloat16_inputs():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(ref, np.float32),
         rtol=2e-2, atol=2e-2)
+
+
+def test_wide_head_dim_block_caps():
+    """D > 128 halves the v5e block caps (the 1024 blocks overflow the
+    16 MB scoped-vmem limit in the backward at D=160); _blocks/_lse_pad
+    must agree on the resulting padding, and fwd+bwd must stay correct
+    at a wide head dim."""
+    import jax
+    from mmlspark_tpu.ops.flash_attention import _blocks, _lse_pad
+
+    for d in (64, 128, 160, 256):
+        bq, _, pad_q, _ = _blocks(700, 700, d)
+        assert _lse_pad(700, d) == 700 + pad_q
+
+    q, k, v = _qkv(1, 300, 300, 2, 160, seed=13)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True,
+                                       interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        from mmlspark_tpu.parallel.ring_attention import dense_attention
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    out = flash_attention(q, k, v, causal=True, interpret=True)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
