@@ -385,7 +385,7 @@ class TestServingThroughput:
             batchSize=64, computeDtype="float32")
 
         fleet = ServingFleet(json_scoring_pipeline(model), n_engines=2,
-                             base_port=18880, batch_size=64)
+                             base_port=18880, batch_size=64, workers=2)
         payload = {"features": [0.1] * dim}
         try:
             for addr in fleet.addresses:          # warmup compiles
