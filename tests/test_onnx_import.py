@@ -239,3 +239,43 @@ class TestDownloaderPublish:
         p2.write_bytes(blob2)
         model = import_onnx_model(str(p2))
         assert model is not None
+
+
+class TestOpVariants:
+    """Per-op parity for paths the resnet graph doesn't exercise."""
+
+    def _run(self, tmp_path, nodes, inits, x, name="g.onnx"):
+        p = tmp_path / name
+        p.write_bytes(ow.model(nodes, inits, "input", "output"))
+        graph = load_onnx(str(p))
+        return np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"images": x}))
+
+    def test_matmul_and_constant(self, tmp_path):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(4, 6)).astype(np.float32)
+        w = rng.normal(size=(6, 3)).astype(np.float32)
+        c = np.asarray([1.0, 2.0, 3.0], np.float32)
+        nodes = [
+            ow.node("MatMul", ["input", "w"], ["mm"]),
+            ow.node("Constant", [], ["c"], value=c),
+            ow.node("Add", ["mm", "c"], ["output"]),
+        ]
+        out = self._run(tmp_path, nodes, {"w": w}, x)
+        np.testing.assert_allclose(out, x @ w + c, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("transA,transB", [(0, 0), (0, 1), (1, 0)])
+    def test_gemm_transpose_variants(self, tmp_path, transA, transB):
+        rng = np.random.default_rng(6)
+        A = rng.normal(size=(5, 4)).astype(np.float32)
+        x = A.T if transA else A
+        B = rng.normal(size=(4, 3)).astype(np.float32)
+        w = B.T if transB else B
+        bias = rng.normal(size=3).astype(np.float32)
+        nodes = [ow.node("Gemm", ["input", "w", "b"], ["output"],
+                         alpha=1.0, beta=0.5, transA=transA,
+                         transB=transB)]
+        out = self._run(tmp_path, nodes, {"w": w, "b": bias}, x)
+        np.testing.assert_allclose(out, A @ B + 0.5 * bias,
+                                   rtol=1e-5, atol=1e-6)
