@@ -1,29 +1,58 @@
 """Dependency-free ONNX ingestion: wire-format reader + jax executor.
 
-The reference's model zoo serves published CNN checkpoints in a
+The reference's model zoo serves published checkpoints in a
 framework-neutral way (ref: src/downloader/src/main/scala/
 ModelDownloader.scala:209, Schema.scala:54 — CNTK model files behind
-URI+sha256 schemas). ONNX is today's dominant neutral interchange
-format, so "load a real published checkpoint" must hold for it, not
-just the torch ecosystem (importers/torch_import.py).
+URI+sha256 schemas), and its workhorse model stage ingests arbitrary
+serialized graphs, not just CNNs (ref: src/cntk-model/src/main/scala/
+CNTKModel.scala:147, SerializableFunction.scala:85-140). ONNX is
+today's dominant neutral interchange format, so "load a real published
+checkpoint" must hold for it across model families — CNNs, MLPs, and
+recurrent taggers (the notebook-304 BiLSTM flagship).
 
 No ``onnx`` package exists in the image, so this module parses the
 protobuf WIRE FORMAT directly (varint / length-delimited walking over
-the public onnx.proto field numbers — ModelProto.graph=7,
-GraphProto.{node=1, initializer=5, input=11, output=12},
-NodeProto.{input=1, output=2, name=3, op_type=4, attribute=5},
-AttributeProto.{name=1, f=2, i=3, s=4, t=5, ints=8},
-TensorProto.{dims=1, data_type=2, float_data=4, int64_data=7, name=8,
-raw_data=9}). The supported operator subset covers the published CNN
-families (torchvision resnet18/34 exports): Conv, BatchNormalization,
-Relu, MaxPool, AveragePool, GlobalAveragePool, Add, Gemm, MatMul,
-Flatten, Reshape, Identity, Constant, Clip.
+the public onnx.proto field numbers — ModelProto.{graph=7,
+opset_import=8}, GraphProto.{node=1, initializer=5, input=11,
+output=12}, NodeProto.{input=1, output=2, name=3, op_type=4,
+attribute=5}, AttributeProto.{name=1, f=2, i=3, s=4, t=5, ints=8,
+strings=7}, TensorProto.{dims=1, data_type=2, float_data=4,
+int32_data=5, int64_data=7, name=8, raw_data=9},
+ValueInfoProto.{name=1, type=2} with nested tensor_type/shape dims).
+
+Supported operators (validated at load — unknown ops AND
+semantics-changing attributes outside the supported envelope are
+rejected with actionable errors, so a graph that loads executes
+faithfully):
+
+  CNN family  : Conv, BatchNormalization, Relu, MaxPool, AveragePool,
+                GlobalAveragePool, Flatten
+  linear      : Gemm, MatMul
+  recurrent   : LSTM (forward / reverse / bidirectional)
+  activations : Sigmoid, Tanh, Softmax, LogSoftmax, LeakyRelu, Clip
+  elementwise : Add, Sub, Mul, Div, Neg, Exp, Sqrt, Pow
+  structure   : Concat, Transpose, Reshape, Squeeze, Unsqueeze, Slice,
+                Shape, Gather, Cast, Identity, Constant, ReduceMean
+
+Opset-version semantics are honored where they differ: Squeeze /
+Unsqueeze axes move from attribute (opset <= 12) to input (>= 13),
+Slice moves from attributes (<= 9) to inputs (>= 10), and Softmax's
+default axis flips from 1 (flatten-to-2D semantics, <= 12) to -1
+(per-axis, >= 13). The model's declared default-domain opset drives
+the choice; out-of-range opsets are rejected at load.
 
 Execution is a small jax interpreter over the graph in ONNX's native
 NCHW layout (lax.conv_general_dilated carries the layout directly, so
-imported numerics match the exporter bit-comparably in f32). The
-executor object is picklable and plugs into TPUModel as ``modelFn`` —
-the same serving contract every zoo model uses.
+imported numerics match the exporter bit-comparably in f32). The LSTM
+is TPU-first: the input projection for the whole sequence is hoisted
+out of the recurrence into ONE large (T*B, I)x(I, 4H) MXU matmul;
+only the (B, 4H) recurrent matmul rides lax.scan. The executor object
+is picklable and plugs into TPUModel as ``modelFn`` — the same serving
+contract every zoo model uses. Graph inputs declared with integer
+element types mark the executor ``int_input`` so TPUModel feeds token
+ids as int32 instead of round-tripping them through float compute
+dtypes; a symbolic (dim_param) batch dimension is the dynamic-batch
+contract — the executor is shape-polymorphic over it.
 """
 
 from __future__ import annotations
@@ -83,13 +112,18 @@ def _fields(buf: bytes):
         yield field, wt, val
 
 
+def _signed(v: int) -> int:
+    """proto int64 varints are two's-complement in 64 bits."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
 # ---------------------------------------------------------------------------
 # onnx message readers (subset)
 # ---------------------------------------------------------------------------
 
 # TensorProto.DataType (public enum values)
 _DT_FLOAT, _DT_UINT8, _DT_INT8, _DT_INT32, _DT_INT64 = 1, 2, 3, 6, 7
-_DT_DOUBLE, _DT_FLOAT16 = 11, 10
+_DT_DOUBLE, _DT_FLOAT16, _DT_BOOL = 11, 10, 9
 
 _TENSOR_DTYPES = {
     _DT_FLOAT: np.float32,
@@ -99,7 +133,10 @@ _TENSOR_DTYPES = {
     _DT_UINT8: np.uint8,
     _DT_INT8: np.int8,
     _DT_FLOAT16: np.float16,
+    _DT_BOOL: np.bool_,
 }
+
+_INT_ELEM_TYPES = (_DT_INT32, _DT_INT64, _DT_UINT8, _DT_INT8)
 
 
 def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
@@ -129,21 +166,27 @@ def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
                 float_data.extend(
                     struct.unpack(f"<{len(val) // 4}f", val))
         elif field == 5:                    # int32_data
+            # int32 varints are sign-extended to 64 bits on the wire —
+            # without _signed a negative decodes as ~2^64 and the
+            # np.asarray below overflows (FLOAT16 bit patterns are
+            # 0..65535, where _signed is a no-op)
             if wt == 0:
-                int32_data.append(val)
+                int32_data.append(_signed(val))
             else:
                 pos = 0
                 while pos < len(val):
                     d, pos = _read_varint(val, pos)
-                    int32_data.append(d)
+                    int32_data.append(_signed(d))
         elif field == 7:                    # int64_data
+            # same two's-complement rule: a Reshape shape [-1, C] or a
+            # negative axis stored here (not raw_data) must decode signed
             if wt == 0:
-                int64_data.append(val)
+                int64_data.append(_signed(val))
             else:
                 pos = 0
                 while pos < len(val):
                     d, pos = _read_varint(val, pos)
-                    int64_data.append(d)
+                    int64_data.append(_signed(d))
         elif field == 8:
             name = val.decode("utf-8")
         elif field == 9:
@@ -180,13 +223,18 @@ def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
             f"tensor {name!r}: payload has {arr.size} elements but dims "
             f"{dims} need {int(np.prod(dims))} (unsupported storage "
             f"field or corrupt file)")
-    return name, arr.reshape(dims) if dims else arr
+    if dims:
+        return name, arr.reshape(dims)
+    # spec: absent dims means a 0-d scalar (dims=[] and "not written"
+    # are indistinguishable on the wire)
+    return name, arr.reshape(()) if arr.size == 1 else arr
 
 
 def _parse_attribute(buf: bytes) -> Tuple[str, Any]:
     name = ""
     out: Any = None
     ints: List[int] = []
+    strings: List[str] = []
     for field, wt, val in _fields(buf):
         if field == 1:
             name = val.decode("utf-8")
@@ -198,6 +246,8 @@ def _parse_attribute(buf: bytes) -> Tuple[str, Any]:
             out = val.decode("utf-8", "replace")
         elif field == 5:                    # t (tensor)
             out = _parse_tensor(val)[1]
+        elif field == 7:                    # strings (repeated bytes)
+            strings.append(val.decode("utf-8", "replace"))
         elif field == 8:                    # ints (repeated)
             if wt == 0:
                 ints.append(_signed(val))
@@ -206,12 +256,11 @@ def _parse_attribute(buf: bytes) -> Tuple[str, Any]:
                 while pos < len(val):
                     d, pos = _read_varint(val, pos)
                     ints.append(_signed(d))
-    return name, (ints if ints else out)
-
-
-def _signed(v: int) -> int:
-    """proto int64 varints are two's-complement in 64 bits."""
-    return v - (1 << 64) if v >= (1 << 63) else v
+    if ints:
+        return name, ints
+    if strings:
+        return name, strings
+    return name, out
 
 
 class OnnxNode:
@@ -248,53 +297,225 @@ def _parse_node(buf: bytes) -> OnnxNode:
     return OnnxNode(op_type, inputs, outputs, attrs, name)
 
 
-def _value_info_name(buf: bytes) -> str:
+def _parse_value_info(buf: bytes) -> Tuple[str, Optional[int],
+                                           Optional[List[Optional[int]]]]:
+    """ValueInfoProto -> (name, elem_type, dims) where a symbolic
+    dim_param (the dynamic-batch convention) or absent dim parses as
+    None. TypeProto.tensor_type=1 {elem_type=1, shape=2};
+    TensorShapeProto.dim=1 {dim_value=1, dim_param=2}."""
+    name = ""
+    elem_type: Optional[int] = None
+    dims: Optional[List[Optional[int]]] = None
     for field, _wt, val in _fields(buf):
         if field == 1:
-            return val.decode("utf-8")
-    return ""
+            name = val.decode("utf-8")
+        elif field == 2:                    # TypeProto
+            for f2, _w2, v2 in _fields(val):
+                if f2 != 1:                 # tensor_type only
+                    continue
+                for f3, _w3, v3 in _fields(v2):
+                    if f3 == 1:
+                        elem_type = v3
+                    elif f3 == 2:           # TensorShapeProto
+                        dims = []
+                        for f4, _w4, v4 in _fields(v3):
+                            if f4 != 1:
+                                continue
+                            d: Optional[int] = None
+                            for f5, _w5, v5 in _fields(v4):
+                                if f5 == 1:
+                                    d = _signed(v5) if isinstance(
+                                        v5, int) else None
+                            dims.append(d)
+    return name, elem_type, dims
 
 
 class OnnxGraph:
     """Parsed ONNX graph: topologically-ordered nodes, initializers,
-    graph input/output names (initializer-backed inputs excluded)."""
+    graph input/output names (initializer-backed inputs excluded),
+    per-input (elem_type, dims) info, and the default-domain opset."""
 
     def __init__(self, nodes: List[OnnxNode],
                  initializers: Dict[str, np.ndarray],
-                 inputs: List[str], outputs: List[str]):
+                 inputs: List[str], outputs: List[str],
+                 input_infos: Optional[Dict[str, Tuple[
+                     Optional[int], Optional[List[Optional[int]]]]]] = None,
+                 opset: int = 13):
         self.nodes = nodes
         self.initializers = initializers
         self.inputs = [i for i in inputs if i not in initializers]
         self.outputs = outputs
+        self.input_infos = input_infos or {}
+        self.opset = opset
 
 
 SUPPORTED_OPS = {
     "Conv", "BatchNormalization", "Relu", "MaxPool", "AveragePool",
     "GlobalAveragePool", "Add", "Gemm", "MatMul", "Flatten", "Reshape",
     "Identity", "Constant", "Clip",
+    "Sigmoid", "Tanh", "Softmax", "LogSoftmax", "LeakyRelu",
+    "Sub", "Mul", "Div", "Neg", "Exp", "Sqrt", "Pow",
+    "Concat", "Transpose", "Squeeze", "Unsqueeze", "Slice", "Shape",
+    "Gather", "Cast", "ReduceMean", "LSTM",
 }
+
+# inclusive default-domain opset envelope this importer implements
+_OPSET_MIN, _OPSET_MAX = 7, 22
+
+_LSTM_DEFAULT_ACTS = {
+    1: ["Sigmoid", "Tanh", "Tanh"],
+    2: ["Sigmoid", "Tanh", "Tanh", "Sigmoid", "Tanh", "Tanh"],
+}
+
+
+def _node_label(node: OnnxNode) -> str:
+    return f"{node.op_type} node {node.name or node.outputs[:1]}"
+
+
+def _validate_node(node: OnnxNode, opset: int,
+                   inits: Optional[Dict[str, np.ndarray]] = None) -> None:
+    """Reject semantics-changing attributes outside the implemented
+    envelope — the 'fail at load, not mid-inference' contract. Without
+    this, e.g. auto_pad=SAME_UPPER or ceil_mode=1 would pass the op-set
+    check and execute with silently wrong padding/window math."""
+    a = node.attrs
+    op = node.op_type
+    lbl = _node_label(node)
+    if op in ("Conv", "MaxPool", "AveragePool"):
+        ap = a.get("auto_pad", "NOTSET")
+        if ap not in ("NOTSET", ""):
+            raise ValueError(
+                f"{lbl}: auto_pad={ap!r} is not supported — re-export "
+                f"with explicit 'pads' (auto_pad is deprecated in ONNX)")
+        # only 2-D convs/pools are implemented (NCHW); a Conv1d/3d
+        # export would otherwise die mid-inference in lax with an
+        # unrelated-looking dimension_numbers error
+        ks = a.get("kernel_shape")
+        if ks is not None and len(ks) != 2:
+            raise ValueError(
+                f"{lbl}: only 2-D spatial kernels are supported, got "
+                f"kernel_shape={ks}")
+        if op == "Conv" and inits is not None and len(node.inputs) > 1:
+            w = inits.get(node.inputs[1])
+            if w is not None and w.ndim != 4:
+                raise ValueError(
+                    f"{lbl}: only 2-D convolution (OIHW weights) is "
+                    f"supported, got weight rank {w.ndim}")
+    if op in ("MaxPool", "AveragePool"):
+        if a.get("ceil_mode", 0):
+            raise ValueError(
+                f"{lbl}: ceil_mode=1 is not supported — re-export with "
+                f"ceil_mode=0 (floor) or pad explicitly")
+    if op == "MaxPool":
+        if any(d != 1 for d in a.get("dilations", [1])):
+            raise ValueError(
+                f"{lbl}: dilated max-pooling is not supported")
+        if a.get("storage_order", 0):
+            raise ValueError(f"{lbl}: storage_order=1 is not supported")
+        if len(node.outputs) > 1 and node.outputs[1]:
+            raise ValueError(
+                f"{lbl}: the Indices output is not supported")
+    if op == "Concat" and "axis" not in a:
+        raise ValueError(f"{lbl}: required attribute 'axis' missing")
+    if op == "Cast":
+        to = a.get("to")
+        if to not in _TENSOR_DTYPES:
+            raise ValueError(
+                f"{lbl}: cast target data_type {to} is not supported "
+                f"(supported: {sorted(_TENSOR_DTYPES)})")
+    if op == "LSTM":
+        ndir = 2 if a.get("direction", "forward") == "bidirectional" else 1
+        acts = a.get("activations")
+        if acts is not None and list(acts) != _LSTM_DEFAULT_ACTS[ndir]:
+            raise ValueError(
+                f"{lbl}: non-default activations {acts} are not "
+                f"supported (only {_LSTM_DEFAULT_ACTS[ndir]})")
+        if a.get("clip") is not None:
+            raise ValueError(f"{lbl}: cell clipping is not supported")
+        if a.get("input_forget", 0):
+            raise ValueError(f"{lbl}: input_forget=1 is not supported")
+        if a.get("layout", 0):
+            raise ValueError(
+                f"{lbl}: layout=1 (batch-major) is not supported — "
+                f"re-export with the default layout=0")
+        if a.get("direction", "forward") not in (
+                "forward", "reverse", "bidirectional"):
+            raise ValueError(
+                f"{lbl}: direction={a.get('direction')!r} invalid")
+        if len(node.inputs) > 4 and node.inputs[4]:
+            raise ValueError(
+                f"{lbl}: per-row sequence_lens is not supported — pad "
+                f"to fixed length (TPU graphs are static-shape)")
+    if op == "LSTM" and len(node.inputs) > 7 and node.inputs[7]:
+        raise ValueError(
+            f"{lbl}: peephole weights (input P) are not supported — "
+            f"the gates would compute without the P*c terms")
+    if op in ("Squeeze", "Unsqueeze") and opset >= 13 and "axes" in a:
+        raise ValueError(
+            f"{lbl}: attribute-form axes inside an opset-{opset} graph "
+            f"(axes moved to an input at opset 13) — file is "
+            f"inconsistent")
+    if op == "Unsqueeze" and opset >= 13 and (
+            len(node.inputs) < 2 or not node.inputs[1]):
+        raise ValueError(
+            f"{lbl}: required 'axes' input missing (opset >= 13)")
+    if op == "ReduceMean" and opset >= 18 and "axes" in a:
+        raise ValueError(
+            f"{lbl}: attribute-form axes inside an opset-{opset} graph "
+            f"(axes moved to an input at opset 18) — file is "
+            f"inconsistent")
+    if op == "Reshape" and a.get("allowzero", 0):
+        raise ValueError(
+            f"{lbl}: allowzero=1 is not supported (0 always means "
+            f"'copy input dim' here)")
+    if op == "Slice" and opset >= 10 and "starts" in a:
+        raise ValueError(
+            f"{lbl}: attribute-form Slice inside an opset-{opset} "
+            f"graph — file is inconsistent")
 
 
 def load_onnx(path: str) -> OnnxGraph:
     """Parse an .onnx file into an OnnxGraph; raises with the offending
-    op list when the graph uses operators outside the supported subset
-    (fail at load, not mid-inference)."""
+    op list when the graph uses operators outside the supported subset,
+    with the offending attribute when a supported op carries
+    unsupported semantics, and with the declared opset when it falls
+    outside [_OPSET_MIN, _OPSET_MAX] (fail at load, not
+    mid-inference)."""
     with open(path, "rb") as f:
         buf = f.read()
     graph_buf: Optional[bytes] = None
+    opset: Optional[int] = None
     try:
         for field, _wt, val in _fields(buf):
             if field == 7:                  # ModelProto.graph
                 graph_buf = val
+            elif field == 8:                # ModelProto.opset_import
+                domain, version = "", None
+                for f2, _w2, v2 in _fields(val):
+                    if f2 == 1:
+                        domain = v2.decode("utf-8")
+                    elif f2 == 2:
+                        version = v2
+                if domain in ("", "ai.onnx") and version is not None:
+                    opset = version
     except (IndexError, ValueError, struct.error) as e:
         raise ValueError(
             f"{path!r} is not a parseable ONNX protobuf: {e}") from e
     if graph_buf is None:
         raise ValueError(f"{path!r} has no graph — not an ONNX model file")
+    if opset is None:
+        opset = 13                          # spec default when absent
+    if not _OPSET_MIN <= opset <= _OPSET_MAX:
+        raise ValueError(
+            f"{path!r} declares default-domain opset {opset}; this "
+            f"importer implements opsets {_OPSET_MIN}..{_OPSET_MAX} — "
+            f"re-export the model targeting a supported opset")
     nodes: List[OnnxNode] = []
     inits: Dict[str, np.ndarray] = {}
     inputs: List[str] = []
     outputs: List[str] = []
+    input_infos: Dict[str, Tuple[Optional[int],
+                                 Optional[List[Optional[int]]]]] = {}
     try:
         for field, _wt, val in _fields(graph_buf):
             if field == 1:
@@ -303,9 +524,11 @@ def load_onnx(path: str) -> OnnxGraph:
                 name, arr = _parse_tensor(val)
                 inits[name] = arr
             elif field == 11:
-                inputs.append(_value_info_name(val))
+                name, elem, dims = _parse_value_info(val)
+                inputs.append(name)
+                input_infos[name] = (elem, dims)
             elif field == 12:
-                outputs.append(_value_info_name(val))
+                outputs.append(_parse_value_info(val)[0])
     except (IndexError, struct.error) as e:
         raise ValueError(
             f"{path!r}: corrupt/truncated ONNX graph: {e}") from e
@@ -314,7 +537,9 @@ def load_onnx(path: str) -> OnnxGraph:
         raise ValueError(
             f"ONNX graph uses unsupported operators {unsupported}; "
             f"supported subset: {sorted(SUPPORTED_OPS)}")
-    return OnnxGraph(nodes, inits, inputs, outputs)
+    for node in nodes:
+        _validate_node(node, opset, inits)
+    return OnnxGraph(nodes, inits, inputs, outputs, input_infos, opset)
 
 
 # ---------------------------------------------------------------------------
@@ -328,35 +553,122 @@ def _pairs(pads: List[int]) -> List[Tuple[int, int]]:
     return [(pads[i], pads[k + i]) for i in range(k)]
 
 
+# node input slots that carry SHAPE-LIKE values (reshape targets, axes,
+# slice bounds): these must resolve to static python ints at
+# construction time, because under jit the weights pytree arrives as
+# tracers and a traced value cannot drive an output shape
+_SHAPE_SLOTS = {
+    "Reshape": (1,),
+    "Squeeze": (1,),
+    "Unsqueeze": (1,),
+    "Slice": (1, 2, 3, 4),
+    "ReduceMean": (1,),
+}
+
+_INT64_MAX = (1 << 63) - 1
+_INT32_MAX = (1 << 31) - 1
+
+
+def _concrete_np(v: Any) -> bool:
+    """True for values that are plain host numbers/arrays (numpy keeps
+    shape-computing chains concrete under jit — np.take on a 0-d index
+    returns an np.generic SCALAR, so np.ndarray alone is not enough)."""
+    return isinstance(v, (np.ndarray, np.generic, int, float))
+
+
+def _lib_for(*vals):
+    """numpy when every operand is a plain host value, else jax.numpy.
+    The single dispatch point for the shape-chain-stays-concrete rule:
+    jnp ops stage even concrete operands under jit, so structural ops
+    (Transpose/Concat/Squeeze/Unsqueeze/Gather/Cast) must run in numpy
+    whenever their operands are host values, or a downstream Reshape
+    target becomes a tracer."""
+    import jax.numpy as jnp
+    return np if all(_concrete_np(v) for v in vals) else jnp
+
+
 class OnnxApply:
     """Picklable jax executor for a supported-subset ONNX graph —
     TPUModel's ``modelFn`` contract: ``(weights, inputs_dict) -> out``.
-    Inputs/outputs are NCHW (ONNX's native layout; the convs carry it
-    through lax dimension_numbers, no transposes)."""
+    Inputs/outputs follow the graph's native layout (NCHW for CNNs, the
+    exporter's layout otherwise — the convs carry NCHW through lax
+    dimension_numbers, no transposes)."""
 
     def __init__(self, graph: OnnxGraph, input_shape=None):
         self.nodes = graph.nodes
         self.input_names = graph.inputs
         self.output_names = graph.outputs
+        self.opset = graph.opset
         # per-row shape (e.g. (3, 224, 224)) to unflatten table rows to
         self.input_shape = tuple(input_shape) if input_shape else None
-        # Reshape targets are initializer int64 vectors in exported
-        # graphs; resolve them STATICALLY here — under jit (TPUModel
-        # compiles this apply) the weights pytree arrives as tracers and
-        # a traced shape could not concretize
-        self._static_shapes: Dict[str, List[int]] = {}
+        # int-element graph inputs (token ids) — TPUModel reads this to
+        # feed int32 instead of the float compute dtype
+        infos = [graph.input_infos.get(n, (None, None))
+                 for n in graph.inputs]
+        self.int_input = bool(infos) and all(
+            e in _INT_ELEM_TYPES for e, _ in infos if e is not None
+        ) and any(e is not None for e, _ in infos)
+        # shape-like inputs (reshape targets, axes, slice bounds) come
+        # from initializers or Constant nodes in exported graphs;
+        # resolve them STATICALLY here (see _SHAPE_SLOTS)
+        consts: Dict[str, np.ndarray] = {}
         for node in graph.nodes:
-            if node.op_type == "Reshape" and len(node.inputs) > 1:
-                t = graph.initializers.get(node.inputs[1])
-                if t is not None:
-                    self._static_shapes[node.inputs[1]] = [
-                        int(v) for v in np.asarray(t).ravel()]
+            if node.op_type == "Constant" and node.outputs:
+                consts[node.outputs[0]] = np.asarray(node.attrs["value"])
+        needed = set()
+        for node in graph.nodes:
+            for slot in _SHAPE_SLOTS.get(node.op_type, ()):
+                if slot < len(node.inputs) and node.inputs[slot]:
+                    needed.add(node.inputs[slot])
+        self._static: Dict[str, np.ndarray] = {}
+        for name in needed:
+            if name in graph.initializers:
+                self._static[name] = np.asarray(graph.initializers[name])
+            elif name in consts:
+                self._static[name] = consts[name]
+        # also capture every SMALL integer initializer/constant: under
+        # jit the weights pytree is traced, but shape-computing chains
+        # (Shape->Gather->Concat->Reshape) must stay concrete, so their
+        # integer scalars/axes are overlaid into the env statically
+        for src in (graph.initializers, consts):
+            for name, arr in src.items():
+                arr = np.asarray(arr)
+                if arr.size <= 64 and np.issubdtype(arr.dtype, np.integer):
+                    self._static.setdefault(name, arr)
+
+    # -- static helpers -----------------------------------------------------
+
+    def _static_ints(self, node: OnnxNode, slot: int,
+                     x: List[Any]) -> Optional[List[int]]:
+        """Resolve a shape-like input to a list of python ints: from the
+        pre-resolved static table, else from a concrete (non-tracer)
+        runtime value (Shape-op chains stay concrete under jit because
+        array shapes are static at trace time)."""
+        if slot >= len(node.inputs) or not node.inputs[slot]:
+            return None
+        name = node.inputs[slot]
+        if name in self._static:
+            return [int(v) for v in self._static[name].ravel()]
+        v = x[slot]
+        if v is None:
+            return None
+        import jax.core
+        if isinstance(v, jax.core.Tracer):
+            raise ValueError(
+                f"{_node_label(node)}: input {slot} ({name!r}) is "
+                f"data-dependent — shape-like inputs must be constants "
+                f"(initializer / Constant / Shape-derived)")
+        return [int(q) for q in np.asarray(v).ravel()]
 
     def __call__(self, weights: Dict[str, Any], inputs: Dict[str, Any]):
+        import jax
         import jax.numpy as jnp
         from jax import lax
 
         env: Dict[str, Any] = dict(weights)
+        # static overlay: small integer constants stay concrete numpy
+        # even when the weights pytree arrives traced (see __init__)
+        env.update(self._static)
         vals = list(inputs.values())
         for name, v in zip(self.input_names, vals):
             if self.input_shape:
@@ -410,9 +722,44 @@ class OnnxApply:
                             [(0, 0), (0, 0)] + pads)
                         out = s / cnt
             elif op == "GlobalAveragePool":
-                out = jnp.mean(x[0], axis=(2, 3), keepdims=True)
+                out = jnp.mean(x[0], axis=tuple(range(2, x[0].ndim)),
+                               keepdims=True)
             elif op == "Add":
                 out = x[0] + x[1]
+            elif op == "Sub":
+                out = x[0] - x[1]
+            elif op == "Mul":
+                out = x[0] * x[1]
+            elif op == "Div":
+                out = x[0] / x[1]
+            elif op == "Pow":
+                out = x[0] ** x[1]
+            elif op == "Neg":
+                out = -x[0]
+            elif op == "Exp":
+                out = jnp.exp(x[0])
+            elif op == "Sqrt":
+                out = jnp.sqrt(x[0])
+            elif op == "Sigmoid":
+                out = jax.nn.sigmoid(x[0])
+            elif op == "Tanh":
+                out = jnp.tanh(x[0])
+            elif op == "LeakyRelu":
+                alpha = a.get("alpha", 0.01)
+                out = jnp.where(x[0] >= 0, x[0], alpha * x[0])
+            elif op in ("Softmax", "LogSoftmax"):
+                fn = jax.nn.softmax if op == "Softmax" \
+                    else jax.nn.log_softmax
+                if self.opset >= 13:
+                    out = fn(x[0], axis=int(a.get("axis", -1)))
+                else:
+                    # legacy semantics: flatten to 2D at axis, softmax
+                    # over the trailing block, restore shape
+                    ax = int(a.get("axis", 1)) % x[0].ndim
+                    shape = x[0].shape
+                    flat = x[0].reshape(
+                        (int(np.prod(shape[:ax])) if ax else 1, -1))
+                    out = fn(flat, axis=-1).reshape(shape)
             elif op == "Gemm":
                 alpha = a.get("alpha", 1.0)
                 beta = a.get("beta", 1.0)
@@ -431,46 +778,211 @@ class OnnxApply:
                 out = x[0].reshape(
                     (int(np.prod(shape[:ax])) if ax else 1, -1))
             elif op == "Reshape":
-                target = self._static_shapes.get(node.inputs[1])
-                if target is None:
-                    # non-initializer shape: must be concrete (eager
-                    # path only — a traced shape cannot concretize)
-                    target = np.asarray(x[1]).astype(np.int64).tolist()
+                target = self._static_ints(node, 1, x)
                 shape = list(x[0].shape)
                 target = [shape[i] if t == 0 else int(t)
                           for i, t in enumerate(target)]
                 out = x[0].reshape(target)
+            elif op == "Transpose":
+                perm = a.get("perm")
+                out = _lib_for(x[0]).transpose(
+                    x[0], tuple(perm) if perm else None)
+            elif op == "Concat":
+                # shape-computing chains stay concrete: jnp ops stage
+                # even concrete operands under jit, so pure-numpy
+                # inputs must concat in numpy
+                parts = [t for t in x if t is not None]
+                lib = _lib_for(*parts)
+                out = lib.concatenate(
+                    [np.atleast_1d(t) if _concrete_np(t) else t
+                     for t in parts], axis=int(a["axis"]))
+            elif op == "Squeeze":
+                axes = (a.get("axes") if self.opset < 13
+                        else self._static_ints(node, 1, x))
+                lib = _lib_for(x[0])
+                if axes:
+                    out = lib.squeeze(
+                        x[0], axis=tuple(ax % x[0].ndim for ax in axes))
+                else:
+                    out = lib.squeeze(x[0])
+            elif op == "Unsqueeze":
+                axes = (a.get("axes") if self.opset < 13
+                        else self._static_ints(node, 1, x))
+                ndim = x[0].ndim + len(axes)
+                lib = _lib_for(x[0])
+                out = lib.expand_dims(
+                    x[0], axis=tuple(ax % ndim for ax in axes))
+            elif op == "Slice":
+                if self.opset < 10:
+                    starts = list(a["starts"])
+                    ends = list(a["ends"])
+                    axes = list(a.get("axes", range(len(starts))))
+                    steps = [1] * len(starts)
+                else:
+                    starts = self._static_ints(node, 1, x)
+                    ends = self._static_ints(node, 2, x)
+                    axes = self._static_ints(node, 3, x) \
+                        or list(range(len(starts)))
+                    steps = self._static_ints(node, 4, x) \
+                        or [1] * len(starts)
+                idx: List[Any] = [slice(None)] * x[0].ndim
+                for st, en, ax, sp in zip(starts, ends, axes, steps):
+                    # spec: huge sentinels mean "to the end"
+                    en_s = None if en >= _INT32_MAX else en
+                    st_s = None if (sp < 0 and st >= _INT32_MAX) else st
+                    if sp < 0 and en <= -_INT32_MAX:
+                        en_s = None
+                    idx[ax % x[0].ndim] = slice(st_s, en_s, sp)
+                out = x[0][tuple(idx)]
+            elif op == "Shape":
+                # array shapes are static under jit — returning numpy
+                # keeps Shape->Gather->Concat->Reshape chains concrete.
+                # start/end slicing attrs (opset 15+) honored; defaults
+                # cover the whole rank
+                r = x[0].ndim
+                st = int(a.get("start", 0))
+                en = a.get("end")
+                en = r if en is None else int(en)
+                st = max(st + r, 0) if st < 0 else min(st, r)
+                en = max(en + r, 0) if en < 0 else min(en, r)
+                out = np.asarray(x[0].shape[st:en], dtype=np.int64)
+            elif op == "Gather":
+                ax = int(a.get("axis", 0))
+                if _lib_for(x[0], x[1]) is np:
+                    # keep Shape-derived chains concrete numpy so a
+                    # downstream Reshape can use them as a static target
+                    out = np.take(np.asarray(x[0]), np.asarray(x[1]),
+                                  axis=ax)
+                else:
+                    out = jnp.take(jnp.asarray(x[0]), x[1], axis=ax)
+            elif op == "Cast":
+                out = _lib_for(x[0]).asarray(x[0]).astype(
+                    _TENSOR_DTYPES[a["to"]])
+            elif op == "ReduceMean":
+                # axes: attribute through opset 17, input from opset 18
+                axes = (a.get("axes") if self.opset < 18
+                        else self._static_ints(node, 1, x))
+                keep = bool(a.get("keepdims", 1))
+                if not axes and self.opset >= 18 and \
+                        a.get("noop_with_empty_axes", 0):
+                    out = x[0]
+                else:
+                    out = jnp.mean(
+                        x[0], axis=tuple(axes) if axes else None,
+                        keepdims=keep)
             elif op == "Identity":
                 out = x[0]
             elif op == "Constant":
-                out = jnp.asarray(a["value"])
+                # numpy (not jnp) so shape-computing chains that consume
+                # constants stay concrete under jit
+                out = np.asarray(a["value"])
             elif op == "Clip":
                 lo = x[1] if len(x) > 1 and x[1] is not None \
                     else a.get("min", -np.inf)
                 hi = x[2] if len(x) > 2 and x[2] is not None \
                     else a.get("max", np.inf)
                 out = jnp.clip(x[0], lo, hi)
+            elif op == "LSTM":
+                out = self._lstm(node, x, a)
             else:  # pragma: no cover — load_onnx validated the op set
                 raise ValueError(f"unsupported op {op}")
-            env[node.outputs[0]] = out
+            outs_t = out if isinstance(out, tuple) else (out,)
+            for oname, oval in zip(node.outputs, outs_t):
+                if oname:
+                    env[oname] = oval
         outs = [env[o] for o in self.output_names]
         return outs[0] if len(outs) == 1 else tuple(outs)
+
+    @staticmethod
+    def _lstm(node: OnnxNode, x: List[Any], a: Dict[str, Any]):
+        """ONNX LSTM (gate order i,o,f,c; activations sigmoid/tanh/tanh
+        — load_onnx rejected anything else). TPU-first: the input
+        projection X@W^T for the WHOLE sequence is hoisted out of the
+        recurrence into one (T*B, I)x(I, 4H) MXU matmul; lax.scan only
+        carries the (B, 4H) recurrent matmul. Returns the full ONNX
+        output triple (Y [T, dirs, B, H], Y_h, Y_c)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        X = x[0]                                   # (T, B, I)
+        W = jnp.asarray(x[1])                      # (D, 4H, I)
+        R = jnp.asarray(x[2])                      # (D, 4H, H)
+        hid = R.shape[-1]
+        bsz = X.shape[1]
+        bias = jnp.asarray(x[3]) if len(x) > 3 and x[3] is not None \
+            else None                              # (D, 8H)
+        h0 = x[5] if len(x) > 5 and x[5] is not None else None
+        c0 = x[6] if len(x) > 6 and x[6] is not None else None
+
+        def run_dir(d: int, reverse: bool):
+            Wd, Rd = W[d], R[d]
+            if bias is not None:
+                bsum = bias[d, :4 * hid] + bias[d, 4 * hid:]
+            else:
+                bsum = jnp.zeros((4 * hid,), X.dtype)
+            h = h0[d] if h0 is not None \
+                else jnp.zeros((bsz, hid), X.dtype)
+            c = c0[d] if c0 is not None \
+                else jnp.zeros((bsz, hid), X.dtype)
+            xs = jnp.flip(X, 0) if reverse else X
+            xw = xs @ Wd.T + bsum                  # (T, B, 4H) on MXU
+
+            def step(carry, xt):
+                h, c = carry
+                g = xt + h @ Rd.T
+                i, o, f, cc = jnp.split(g, 4, axis=-1)
+                i = jax.nn.sigmoid(i)
+                o = jax.nn.sigmoid(o)
+                f = jax.nn.sigmoid(f)
+                cc = jnp.tanh(cc)
+                c = f * c + i * cc
+                h = o * jnp.tanh(c)
+                return (h, c), h
+
+            (hT, cT), ys = lax.scan(step, (h, c), xw)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            return ys, hT, cT
+
+        direction = a.get("direction", "forward")
+        revs = {"forward": [False], "reverse": [True],
+                "bidirectional": [False, True]}[direction]
+        ys_l, h_l, c_l = [], [], []
+        for d, rev in enumerate(revs):
+            ys, hT, cT = run_dir(d, rev)
+            ys_l.append(ys)
+            h_l.append(hT)
+            c_l.append(cT)
+        Y = jnp.stack(ys_l, axis=1)                # (T, D, B, H)
+        return Y, jnp.stack(h_l, 0), jnp.stack(c_l, 0)
 
 
 def import_onnx_model(path: str, batch_size: int = 64,
                       input_shape=None):
     """ONNX file -> ready-to-serve TPUModel (the ModelDownloader /
     ImageFeaturizer contract). Weights are the graph initializers; the
-    modelFn is the jax graph executor. Inputs are NCHW float32;
-    ``input_shape`` (e.g. [3, 224, 224]) unflattens table rows."""
+    modelFn is the jax graph executor. ``input_shape`` (e.g.
+    [3, 224, 224]) unflattens table rows; when omitted it is inferred
+    from the graph's declared input shape (trailing dims after the
+    batch axis — a symbolic batch dim_param is the dynamic-batch
+    convention and is ignored). Integer-typed graph inputs (token ids)
+    make the model feed int32 rows instead of floats."""
     from mmlspark_tpu.models.tpu_model import TPUModel
 
     graph = load_onnx(path)
     if len(graph.inputs) != 1:
         raise ValueError(
             f"expected a single graph input, got {graph.inputs}")
+    apply_fn = OnnxApply(graph, input_shape=input_shape)
+    if apply_fn.input_shape is None:
+        _elem, dims = graph.input_infos.get(
+            graph.inputs[0], (None, None))
+        if dims and len(dims) > 1 and all(
+                d is not None for d in dims[1:]):
+            apply_fn.input_shape = tuple(dims[1:])
     model = TPUModel(
-        modelFn=OnnxApply(graph, input_shape=input_shape),
+        modelFn=apply_fn,
         weights={k: np.asarray(v) for k, v in graph.initializers.items()},
         inputCol="images", outputCol="scores", batchSize=batch_size,
         computeDtype="float32")
@@ -478,15 +990,17 @@ def import_onnx_model(path: str, batch_size: int = 64,
 
 
 def onnx_summary(path: str) -> Dict[str, Any]:
-    """Structural manifest of an ONNX file (op histogram, initializer
-    count/bytes, inputs/outputs) — the validation hook ModelDownloader
-    schemas record, mirroring the torchvision manifest discipline."""
+    """Structural manifest of an ONNX file (op histogram, opset,
+    initializer count/bytes, inputs/outputs) — the validation hook
+    ModelDownloader schemas record, mirroring the torchvision manifest
+    discipline."""
     graph = load_onnx(path)
     ops: Dict[str, int] = {}
     for node in graph.nodes:
         ops[node.op_type] = ops.get(node.op_type, 0) + 1
     return {
         "ops": dict(sorted(ops.items())),
+        "opset": graph.opset,
         "num_initializers": len(graph.initializers),
         "initializer_bytes": int(sum(
             v.nbytes for v in graph.initializers.values())),

@@ -48,22 +48,32 @@ BLOCK_Q = 1024
 BLOCK_K = 1024
 
 
-@functools.lru_cache(maxsize=8)
+_BLOCK_CAP_MEMO: dict = {}
+
+
 def _block_caps(d: int):
     """Per-generation, per-head-dim block ceiling: the tuned 1024 blocks
     are VMEM-safe on v5e+ up to D=128 (measured); D=160 overflows the
     16 MB scoped-vmem limit in the backward (observed: 16.78M request),
     so wider heads halve the blocks. Unknown/older parts keep the
-    conservative 256."""
+    conservative 256.
+
+    Memoized manually (not lru_cache): if the first call lands before the
+    jax backend is usable, the conservative fallback must NOT be pinned
+    for the process lifetime — the next call re-probes the device."""
+    if d in _BLOCK_CAP_MEMO:
+        return _BLOCK_CAP_MEMO[d]
     try:
         kind = jax.devices()[0].device_kind
-    except Exception:  # backend not initialized yet
+    except Exception:  # backend not initialized yet — don't memoize
         return 256, 256
     if any(t in kind for t in ("v5", "v6", "v7")):
-        if d <= 128:
-            return BLOCK_Q, BLOCK_K
-        return min(BLOCK_Q, 512), min(BLOCK_K, 512)
-    return min(BLOCK_Q, 256), min(BLOCK_K, 256)
+        caps = (BLOCK_Q, BLOCK_K) if d <= 128 else \
+            (min(BLOCK_Q, 512), min(BLOCK_K, 512))
+    else:
+        caps = (min(BLOCK_Q, 256), min(BLOCK_K, 256))
+    _BLOCK_CAP_MEMO[d] = caps
+    return caps
 
 
 def _fully_masked(qi, ki, bq, bk, q_offset, k_offset):

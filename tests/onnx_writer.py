@@ -49,20 +49,38 @@ _NP_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.float64): 11,
                np.dtype(np.int64): 7, np.dtype(np.int32): 6}
 
 
-def tensor(name: str, arr: np.ndarray) -> bytes:
-    arr = np.ascontiguousarray(arr)
+def tensor(name: str, arr: np.ndarray, storage: str = "raw") -> bytes:
+    """storage='raw' writes raw_data; 'int_data' writes int64_data /
+    int32_data varints (two's-complement for negatives — the storage
+    real exporters use for small shape/axes tensors)."""
+    # ascontiguousarray promotes 0-d to 1-d — restore the true shape so
+    # scalars write with no dims (the spec's 0-d encoding)
+    arr = np.ascontiguousarray(arr).reshape(np.shape(arr))
     out = b""
     for d in arr.shape:
         out += _int_field(1, d)                       # dims
     out += _int_field(2, _NP_TO_ONNX[arr.dtype])      # data_type
     out += _ld(8, name.encode())                      # name
-    out += _ld(9, arr.tobytes())                      # raw_data
+    if storage == "raw":
+        out += _ld(9, arr.tobytes())                  # raw_data
+    elif storage == "int_data":
+        field = {np.dtype(np.int64): 7,
+                 np.dtype(np.int32): 5}[arr.dtype]
+        for v in arr.ravel().tolist():
+            out += _int_field(field, int(v))          # sign-extended
+    else:
+        raise ValueError(storage)
     return out
 
 
 def _attr(name: str, value: Any) -> bytes:
     out = _ld(1, name.encode())
-    if isinstance(value, (list, tuple)):
+    if isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], str):
+        for v in value:
+            out += _ld(7, v.encode())                 # strings
+        out += _int_field(20, 8)                      # type = STRINGS
+    elif isinstance(value, (list, tuple)):
         for v in value:
             out += _int_field(8, int(v))              # ints
         out += _int_field(20, 7)                      # type = INTS
@@ -72,6 +90,9 @@ def _attr(name: str, value: Any) -> bytes:
     elif isinstance(value, float):
         out += _float_field(2, value)                 # f
         out += _int_field(20, 1)                      # type = FLOAT
+    elif isinstance(value, str):
+        out += _ld(4, value.encode())                 # s
+        out += _int_field(20, 3)                      # type = STRING
     elif isinstance(value, np.ndarray):
         out += _ld(5, tensor("", value))              # t
         out += _int_field(20, 4)                      # type = TENSOR
@@ -93,24 +114,115 @@ def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
     return out
 
 
-def _value_info(name: str) -> bytes:
-    return _ld(1, name.encode())
+def _value_info(name: str, elem_type: int = None,
+                dims: Sequence[Any] = None) -> bytes:
+    """dims entries: int (dim_value), str (dim_param — the symbolic
+    dynamic-batch convention), or None (unknown)."""
+    out = _ld(1, name.encode())
+    if elem_type is not None:
+        shape = b""
+        for d in (dims or []):
+            if isinstance(d, str):
+                dim = _ld(2, d.encode())              # dim_param
+            elif d is None:
+                dim = b""
+            else:
+                dim = _int_field(1, int(d))           # dim_value
+            shape += _ld(1, dim)
+        tensor_type = _int_field(1, elem_type) + _ld(2, shape)
+        out += _ld(2, _ld(1, tensor_type))            # TypeProto
+    return out
 
 
 def model(nodes: List[bytes], initializers: Dict[str, np.ndarray],
-          input_name: str, output_name: str) -> bytes:
+          input_name, output_name, opset: int = 17,
+          int_data_names: Sequence[str] = ()) -> bytes:
+    """input_name/output_name: a name string, or a (name, elem_type,
+    dims) tuple to declare typed/shaped value info. Initializers named
+    in ``int_data_names`` are stored as int64_data/int32_data varints
+    instead of raw bytes."""
     graph = b""
     for nd in nodes:
         graph += _ld(1, nd)
     graph += _ld(2, b"graph")
     for name, arr in initializers.items():
-        graph += _ld(5, tensor(name, arr))
-    graph += _ld(11, _value_info(input_name))
-    graph += _ld(12, _value_info(output_name))
-    opset = _ld(1, b"") + _int_field(2, 17)           # default domain, v17
+        storage = "int_data" if name in int_data_names else "raw"
+        graph += _ld(5, tensor(name, arr, storage=storage))
+    for spec, field in ((input_name, 11), (output_name, 12)):
+        if isinstance(spec, tuple):
+            graph += _ld(field, _value_info(*spec))
+        else:
+            graph += _ld(field, _value_info(spec))
+    opset_b = _ld(1, b"") + _int_field(2, opset)      # default domain
     return (_int_field(1, 8)                          # ir_version
-            + _ld(8, opset)                           # opset_import
+            + _ld(8, opset_b)                         # opset_import
             + _ld(7, graph))                          # graph
+
+
+# ---------------------------------------------------------------------------
+# BiLSTM tagger graph (notebook-304 architecture) from a torch state_dict
+# ---------------------------------------------------------------------------
+
+
+def _iofc(t: np.ndarray) -> np.ndarray:
+    """torch LSTM gate chunks [i, f, g, o] -> ONNX order [i, o, f, c]."""
+    i, f, g, o = np.split(t, 4, axis=0)
+    return np.concatenate([i, o, f, g], axis=0)
+
+
+def bilstm_onnx(path: str, sd: Dict[str, np.ndarray], seq_len: int) -> None:
+    """Write a bidirectional-LSTM token tagger as genuine ONNX from a
+    torch state_dict (embed.weight, lstm.weight_ih_l0[/_reverse],
+    lstm.weight_hh_l0[/_reverse], lstm.bias_ih_l0[...], fc.weight,
+    fc.bias). Mirrors what torch.onnx.export emits for the notebook-304
+    model: Gather embedding, Transpose to time-major, bidirectional
+    LSTM, Transpose/Reshape back to batch-major, MatMul+Add head. The
+    batch axis is a symbolic dim_param ('N') and token ids are INT64 —
+    the dynamic-batch / integer-input conventions real exporters use.
+    The Reshape target is stored as int64_data varints (contains -1,
+    exercising signed decode)."""
+    npf = {k: np.asarray(v, dtype=np.float32) if "weight" in k
+           or "bias" in k else np.asarray(v) for k, v in sd.items()}
+    E = npf["embed.weight"].shape[1]
+    H = npf["lstm.weight_hh_l0"].shape[1]
+    tags = npf["fc.weight"].shape[0]
+
+    W = np.stack([_iofc(npf["lstm.weight_ih_l0"]),
+                  _iofc(npf["lstm.weight_ih_l0_reverse"])])   # (2, 4H, E)
+    R = np.stack([_iofc(npf["lstm.weight_hh_l0"]),
+                  _iofc(npf["lstm.weight_hh_l0_reverse"])])   # (2, 4H, H)
+    B = np.stack([
+        np.concatenate([_iofc(npf["lstm.bias_ih_l0"]),
+                        _iofc(npf["lstm.bias_hh_l0"])]),
+        np.concatenate([_iofc(npf["lstm.bias_ih_l0_reverse"]),
+                        _iofc(npf["lstm.bias_hh_l0_reverse"])]),
+    ])                                                        # (2, 8H)
+
+    inits: Dict[str, np.ndarray] = {
+        "embed.weight": npf["embed.weight"],
+        "lstm.W": W, "lstm.R": R, "lstm.B": B,
+        "head.weight": npf["fc.weight"].T.copy(),             # (2H, tags)
+        "head.bias": npf["fc.bias"],
+        "flat_shape": np.asarray([0, 0, -1], dtype=np.int64),
+    }
+    nodes = [
+        node("Gather", ["embed.weight", "tokens"], ["emb"], axis=0),
+        node("Transpose", ["emb"], ["emb_t"], perm=[1, 0, 2]),
+        node("LSTM", ["emb_t", "lstm.W", "lstm.R", "lstm.B"],
+             ["lstm_y", "lstm_h", "lstm_c"],
+             direction="bidirectional", hidden_size=H),
+        node("Transpose", ["lstm_y"], ["y_t"], perm=[2, 0, 1, 3]),
+        node("Reshape", ["y_t", "flat_shape"], ["y_flat"]),
+        node("MatMul", ["y_flat", "head.weight"], ["y_mm"]),
+        node("Add", ["y_mm", "head.bias"], ["logits"]),
+    ]
+    blob = model(
+        nodes, inits,
+        ("tokens", 7, ["N", seq_len]),                        # INT64
+        ("logits", 1, ["N", seq_len, tags]),
+        int_data_names=("flat_shape",))
+    with open(path, "wb") as f:
+        f.write(blob)
 
 
 # ---------------------------------------------------------------------------
