@@ -200,8 +200,16 @@ def generate_artifacts(out_dir: str) -> Dict[str, int]:
             "tests": n_tests}
 
 
-if __name__ == "__main__":
+def main(argv=None) -> int:
+    """Console entry point (``mmlspark-tpu-codegen out_dir``)."""
     import sys
-    out = sys.argv[1] if len(sys.argv) > 1 else "generated"
+    args = sys.argv[1:] if argv is None else argv
+    out = args[0] if args else "generated"
     counts = generate_artifacts(out)
     print(json.dumps(counts))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

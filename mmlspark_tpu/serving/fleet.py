@@ -60,6 +60,35 @@ def json_scoring_pipeline(model, field: str = "features",
     return Lambda.apply(handle)
 
 
+def json_row_scoring_pipeline(pipeline, reply_col: str = "prediction"):
+    """Serve an arbitrary TABULAR pipeline behind HTTP: each request
+    body is a JSON object of column values (one row); bodies batch into
+    a DataTable, run through ``pipeline.transform``, and the
+    ``reply_col`` value answers each request. This is what
+    ``mmlspark-tpu serve`` wraps saved models with — any fitted
+    pipeline becomes an HTTP scorer with no Python written
+    (ref: ServingImplicits.scala request parsing; the CLI is the
+    R-wrapper-capability analog)."""
+    import numpy as np
+    from mmlspark_tpu.stages.basic import Lambda
+
+    def handle(table: DataTable) -> DataTable:
+        rows = [json.loads(r["entity"].decode())
+                for r in table["request"]]
+        data = DataTable.from_rows(rows)
+        scored = pipeline.transform(data)
+        if reply_col not in scored:
+            raise KeyError(
+                f"reply column {reply_col!r} not in scored table; "
+                f"have {scored.column_names}")
+        vals = scored[reply_col]
+        return table.with_column(
+            "reply", [v.item() if isinstance(v, np.generic) else v
+                      for v in vals])
+
+    return Lambda.apply(handle)
+
+
 class ServingFleet:
     """N serving engines over one pipeline — one per host in a real
     deployment, N ports on one host in simulation/tests. Replies always

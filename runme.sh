@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# One-command build/test/package pipeline — the sbt-chain analog
+# (ref: src/project/build.scala:86-97 packages + publishes every
+# module; runme there drives the full build). Produces an installable
+# wheel in dist/ with the native library compiled in.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== 1/4 native build =="
+cmake -S mmlspark_tpu/native -B mmlspark_tpu/native/build \
+      -DCMAKE_BUILD_TYPE=Release
+cmake --build mmlspark_tpu/native/build --config Release -j
+
+echo "== 2/4 tests =="
+python -m pytest tests/ -q
+
+echo "== 3/4 codegen artifacts =="
+python -m mmlspark_tpu.codegen docs/api
+
+echo "== 4/4 wheel =="
+rm -rf build dist *.egg-info
+python -m pip wheel . -w dist --no-deps --no-build-isolation
+ls -l dist/
+echo "done: pip install dist/*.whl"

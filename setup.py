@@ -1,0 +1,62 @@
+"""Build hooks: compile the native runtime into the wheel.
+
+The reference packages native artifacts into its published jars
+(ref: src/project/build.scala:86-97 — sbt packages + publishes every
+module; NativeLoader.java extracts per-OS .so from jar resources).
+Here the cmake library (libjpeg/libpng decode, OpenMP binning) builds
+during `pip wheel` and ships inside the wheel as package data; if the
+build toolchain is unavailable the wheel still builds — the loader
+rebuilds lazily on first use or falls back to pure numpy.
+"""
+
+import os
+import subprocess
+import sys
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NATIVE = os.path.join(HERE, "mmlspark_tpu", "native")
+
+
+def _read_version() -> str:
+    ns = {}
+    with open(os.path.join(HERE, "mmlspark_tpu", "version.py")) as f:
+        exec(f.read(), ns)
+    return ns["__version__"]
+
+
+def _build_native() -> bool:
+    lib = os.path.join(NATIVE, "lib", "libmml_native.so")
+    build_dir = os.path.join(NATIVE, "build")
+    os.makedirs(build_dir, exist_ok=True)
+    try:
+        subprocess.run(
+            ["cmake", "-S", NATIVE, "-B", build_dir,
+             "-DCMAKE_BUILD_TYPE=Release"],
+            check=True, capture_output=True, timeout=300)
+        subprocess.run(
+            ["cmake", "--build", build_dir, "--config", "Release", "-j"],
+            check=True, capture_output=True, timeout=600)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"warning: native build skipped ({e}); the installed "
+              f"package will rebuild lazily or fall back to numpy",
+              file=sys.stderr)
+        return False
+    return os.path.exists(lib)
+
+
+class BuildPyWithNative(build_py):
+    """Standard build_py preceded by the cmake native build, so the
+    .so lands in the source tree before package_data collection."""
+
+    def run(self):
+        _build_native()
+        super().run()
+
+
+setup(
+    version=_read_version(),
+    cmdclass={"build_py": BuildPyWithNative},
+)
