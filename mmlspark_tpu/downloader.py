@@ -172,12 +172,16 @@ class HTTPRepo:
         self.retries = retries
 
     def _filesystem(self):
-        from mmlspark_tpu.utils.filesystem import HTTPFileSystem
+        from mmlspark_tpu.utils.filesystem import (
+            HTTPFileSystem, WebDAVFileSystem, scheme_of,
+        )
         if self._fs is None:
             # single transport attempt per try — OUR retry loop wraps
             # fetch+verify together so corrupted-but-200 downloads are
             # also re-fetched, without multiplying attempts
-            self._fs = HTTPFileSystem(retries=1)
+            cls = WebDAVFileSystem if scheme_of(self.base_url).startswith(
+                "webdav") else HTTPFileSystem
+            self._fs = cls(retries=1)
         return self._fs
 
     def _fetch(self, rel: str) -> bytes:
@@ -215,6 +219,45 @@ class HTTPRepo:
 
         # hash failures re-fetch too: a truncated 200 body is transient
         return retry_with_backoff(fetch_and_verify, times=self.retries)
+
+    def publish(self, name: str, network_spec: Dict[str, Any],
+                variables: Any = None, dataset: str = "",
+                model_type: str = "",
+                input_shape: Optional[List[int]] = None,
+                layer_names: Optional[List[str]] = None,
+                blob: Optional[bytes] = None) -> ModelSchema:
+        """Publish to a WRITABLE remote repo (``webdav://`` base_url —
+        the HDFSRepo-publish analog, ref: ModelDownloader.scala:54-124).
+        Read-only ``http(s)://`` repos raise."""
+        fs = self._filesystem()
+        if blob is None:
+            from flax import serialization
+            blob = serialization.to_bytes(variables)
+        blob_url = f"{self.base_url}/{name}.msgpack"
+        fs.write_bytes(blob_url, blob)            # raises on read-only
+        schema = ModelSchema(
+            name=name, dataset=dataset, model_type=model_type,
+            uri=blob_url,
+            sha256=hashlib.sha256(blob).hexdigest(), size=len(blob),
+            input_shape=input_shape, layer_names=layer_names,
+            network_spec=network_spec)
+        import urllib.error
+        try:
+            # direct read (fs retries=1): a 404 means "first publish"
+            # and must not burn the repo-level retry budget
+            idx = json.loads(
+                fs.read_bytes(f"{self.base_url}/index.json").decode())
+        except (FileNotFoundError, urllib.error.HTTPError) as e:
+            # ONLY a missing index means "first publish" — any other
+            # failure must abort, or a transient fetch error would
+            # silently delist every previously published model
+            if isinstance(e, urllib.error.HTTPError) and e.code != 404:
+                raise
+            idx = {}
+        idx[name] = schema.to_json()
+        fs.write_bytes(f"{self.base_url}/index.json",
+                       json.dumps(idx, indent=1).encode("utf-8"))
+        return schema
 
 
 class ModelDownloader:

@@ -433,7 +433,7 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             latest = _latest_checkpoint(ckpt_dir)
             if latest is not None:
                 try:
-                    loaded = ser._load_pytree(latest)
+                    loaded = _load_checkpoint_pytree(latest)
                 except Exception as e:
                     raise RuntimeError(
                         f"failed to load checkpoint {latest!r}: {e}. "
@@ -890,11 +890,52 @@ class _InferApply:
         return self.module.apply(variables, x, train=False)
 
 
+def _is_remote(path: str) -> bool:
+    from mmlspark_tpu.utils import filesystem as fslib
+    return fslib.scheme_of(path) != "file"
+
+
+def _remote_steps(ckpt_dir: str) -> List[str]:
+    """Sorted step_XXXXXXXX names that have a COMPLETE checkpoint
+    (treedef.json is uploaded last, so its presence marks done)."""
+    import re
+    from mmlspark_tpu.utils import filesystem as fslib
+    fs = fslib.get_filesystem(ckpt_dir)
+    steps = set()
+    for f in fs.list_files(ckpt_dir.rstrip("/"), recursive=True):
+        m = re.search(r"(step_\d{8})/treedef\.json$", f)
+        if m:
+            steps.add(m.group(1))
+    return sorted(steps)
+
+
 def _save_checkpoint(ckpt_dir: str, step: int, state) -> None:
-    # multi-host: only the coordinator writes (hosts may share the FS)
+    # multi-host: only the coordinator writes (hosts share the FS —
+    # which may be a remote scheme like webdav://, the HDFS-staging
+    # analog of CNTKLearner.scala:18-67 dataTransfer=hdfs)
     if jax.process_index() != 0:
         return
     host = jax.device_get(state)
+    if _is_remote(ckpt_dir):
+        import tempfile
+        from mmlspark_tpu.utils import filesystem as fslib
+        fs = fslib.get_filesystem(ckpt_dir)
+        base = f"{ckpt_dir.rstrip('/')}/step_{step:08d}"
+        with tempfile.TemporaryDirectory() as td:
+            ser._save_pytree(host, td)
+            # treedef.json LAST: it is the completeness marker that
+            # _remote_steps / resume key on
+            names = sorted(os.listdir(td),
+                           key=lambda n: n == "treedef.json")
+            for fn in names:
+                with open(os.path.join(td, fn), "rb") as f:
+                    fs.write_bytes(f"{base}/{fn}", f.read())
+        for stale in _remote_steps(ckpt_dir)[:-3]:
+            try:
+                fs.delete_path(f"{ckpt_dir.rstrip('/')}/{stale}/")
+            except (IOError, NotImplementedError):
+                pass                   # pruning is best-effort
+        return
     path = os.path.join(ckpt_dir, f"step_{step:08d}")
     os.makedirs(path, exist_ok=True)
     ser._save_pytree(host, path)
@@ -907,7 +948,25 @@ def _save_checkpoint(ckpt_dir: str, step: int, state) -> None:
 
 
 def _latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    if _is_remote(ckpt_dir):
+        steps = _remote_steps(ckpt_dir)
+        return f"{ckpt_dir.rstrip('/')}/{steps[-1]}" if steps else None
     if not os.path.isdir(ckpt_dir):
         return None
     ckpts = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
     return os.path.join(ckpt_dir, ckpts[-1]) if ckpts else None
+
+
+def _load_checkpoint_pytree(path: str):
+    """ser._load_pytree from a local OR remote checkpoint directory."""
+    if not _is_remote(path):
+        return ser._load_pytree(path)
+    import tempfile
+    from mmlspark_tpu.utils import filesystem as fslib
+    fs = fslib.get_filesystem(path)
+    with tempfile.TemporaryDirectory() as td:
+        for fn in ("leaves.npz", "treedef.json"):
+            data = fs.read_bytes(f"{path.rstrip('/')}/{fn}")
+            with open(os.path.join(td, fn), "wb") as f:
+                f.write(data)
+        return ser._load_pytree(td)

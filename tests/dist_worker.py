@@ -219,6 +219,33 @@ def main() -> None:
         ub.model_to_string().encode()).hexdigest()[:16]
     unsafe = int(bool(ub.params.get("f32_unsafe")))
     print(f"F64BIN {pid} {b_digest},{u_digest},{unsafe}", flush=True)
+
+    # multi-host checkpoint/resume on a REMOTE (webdav://) filesystem:
+    # the coordinator writes checkpoints over HTTP PUT, every host
+    # resumes from the same remote step (the shared-FS requirement
+    # learner.py:452-463 enforces — previously only file:// could
+    # satisfy it; ref: CNTKLearner.scala:18-67 dataTransfer=hdfs)
+    if len(sys.argv) > 4 and sys.argv[4].startswith("webdav://"):
+        from mmlspark_tpu.models.learner import _latest_checkpoint
+        ck = f"{sys.argv[4]}/ckpt"
+        mk = lambda epochs: TPULearner(  # noqa: E731
+            networkSpec={"type": "mlp", "features": [8],
+                         "num_classes": 2},
+            epochs=epochs, batchSize=8 * nproc, learningRate=0.1,
+            computeDtype="float32", logEvery=1000,
+            checkpointDir=ck, checkpointEvery=2, resume=True,
+            meshAxes={"data": info.global_device_count})
+        mk(2).fit(local)
+        latest = _latest_checkpoint(ck)       # visible from EVERY host
+        step1 = int(latest.rsplit("step_", 1)[1]) if latest else -1
+        m2 = mk(4).fit(local)                 # resumes mid-training
+        leaf = np.concatenate([
+            np.asarray(a).ravel()
+            for a in jax.tree_util.tree_leaves(m2.get("weights"))])
+        wd_digest = hashlib.sha256(
+            np.round(leaf, 6).tobytes()).hexdigest()[:16]
+        print(f"WEBDAVCKPT {pid} {wd_digest},{step1}", flush=True)
+
     print(f"OK {pid}", flush=True)
 
 

@@ -26,13 +26,18 @@ def _free_port() -> int:
 
 
 @pytest.mark.parametrize("nproc", [2])
-def test_multiprocess_rendezvous_and_psum(nproc):
+def test_multiprocess_rendezvous_and_psum(nproc, tmp_path):
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_"))}
+    # remote-checkpoint seam: an in-process WebDAV server the workers
+    # write/resume checkpoints through (the shared-HDFS analog)
+    from mmlspark_tpu.testing.webdav import serve_webdav
+    dav_server, dav_url = serve_webdav(str(tmp_path / "dav_ckpt"))
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(port), str(pid), str(nproc)],
+            [sys.executable, WORKER, str(port), str(pid), str(nproc),
+             dav_url],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True, env=env)
         for pid in range(nproc)
@@ -40,12 +45,15 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=180)
+            out, err = p.communicate(timeout=240)
             outs.append((p.returncode, out, err))
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
         pytest.fail(f"distributed workers hung; partial: {outs}")
+    finally:
+        dav_server.shutdown()
+        dav_server.server_close()
 
     for rc, out, err in outs:
         assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
@@ -63,6 +71,7 @@ def test_multiprocess_rendezvous_and_psum(nproc):
     vote_gbdt = {}
     f64bin = {}
     devfeed = {}
+    webdav_ck = {}
     for rc, out, err in outs:
         for line in out.splitlines():
             if line.startswith("PSUM"):
@@ -95,6 +104,16 @@ def test_multiprocess_rendezvous_and_psum(nproc):
             if line.startswith("F64BIN"):
                 _, pid, vals = line.split()
                 f64bin[int(pid)] = vals
+            if line.startswith("WEBDAVCKPT"):
+                _, pid, vals = line.split()
+                webdav_ck[int(pid)] = vals
+    # multi-host checkpoint/resume on the NON-file (webdav://) scheme:
+    # every host saw the first run's remote checkpoint (step > 0) and
+    # the resumed run converged to identical replicated params
+    assert len(webdav_ck) == nproc, webdav_ck
+    assert len(set(webdav_ck.values())) == 1, webdav_ck
+    _wd_digest, wd_step = next(iter(webdav_ck.values())).split(",")
+    assert int(wd_step) > 0, webdav_ck
     # host-sharded training ran and produced identical replicated params
     assert len(trained) == nproc
     assert len(set(trained.values())) == 1, trained
