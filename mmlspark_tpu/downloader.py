@@ -74,13 +74,18 @@ def _sha256(path: str) -> str:
     return h.hexdigest()
 
 
-def retry_with_backoff(fn, times: int = 3, base_delay: float = 0.5):
+def retry_with_backoff(fn, times: int = 3, base_delay: float = 0.5,
+                       no_retry: tuple = ()):
     """ref: FaultToleranceUtils.retryWithTimeout
-    (ModelDownloader.scala:37-50)."""
+    (ModelDownloader.scala:37-50). Exception types in ``no_retry``
+    re-raise immediately — deterministic failures (4xx client errors)
+    must not burn the backoff budget."""
     last: Optional[Exception] = None
     for i in range(times):
         try:
             return fn()
+        except no_retry:
+            raise
         except Exception as e:  # noqa: BLE001 — intentional broad retry
             last = e
             log.warning("attempt %d/%d failed: %s", i + 1, times, e)
@@ -138,23 +143,32 @@ class LocalRepo:
                 blob: Optional[bytes] = None) -> ModelSchema:
         """Add a model to the repo (the zoo-maintainer path). Pass either
         a flax ``variables`` pytree or pre-serialized ``blob`` bytes."""
-        if blob is None:
-            from flax import serialization
-            blob = serialization.to_bytes(variables)
         blob_path = os.path.join(self.path, f"{name}.msgpack")
+        blob, schema = _blob_and_schema(
+            name, network_spec, variables, blob, f"file://{blob_path}",
+            dataset, model_type, input_shape, layer_names)
         with open(blob_path, "wb") as f:
             f.write(blob)
-        schema = ModelSchema(
-            name=name, dataset=dataset, model_type=model_type,
-            uri=f"file://{blob_path}",
-            sha256=hashlib.sha256(blob).hexdigest(), size=len(blob),
-            input_shape=input_shape, layer_names=layer_names,
-            network_spec=network_spec)
         idx = self._load_index()
         idx[name] = schema.to_json()
         with open(self._index_path(), "w") as f:
             json.dump(idx, f, indent=1)
         return schema
+
+
+def _blob_and_schema(name, network_spec, variables, blob, uri,
+                     dataset, model_type, input_shape, layer_names):
+    """Shared publish assembly for every repo flavor: serialize the
+    variables when no blob is given, hash, and build the ModelSchema."""
+    if blob is None:
+        from flax import serialization
+        blob = serialization.to_bytes(variables)
+    schema = ModelSchema(
+        name=name, dataset=dataset, model_type=model_type,
+        uri=uri, sha256=hashlib.sha256(blob).hexdigest(),
+        size=len(blob), input_shape=input_shape,
+        layer_names=layer_names, network_spec=network_spec)
+    return blob, schema
 
 
 class HTTPRepo:
@@ -230,17 +244,11 @@ class HTTPRepo:
         the HDFSRepo-publish analog, ref: ModelDownloader.scala:54-124).
         Read-only ``http(s)://`` repos raise."""
         fs = self._filesystem()
-        if blob is None:
-            from flax import serialization
-            blob = serialization.to_bytes(variables)
         blob_url = f"{self.base_url}/{name}.msgpack"
+        blob, schema = _blob_and_schema(
+            name, network_spec, variables, blob, blob_url,
+            dataset, model_type, input_shape, layer_names)
         fs.write_bytes(blob_url, blob)            # raises on read-only
-        schema = ModelSchema(
-            name=name, dataset=dataset, model_type=model_type,
-            uri=blob_url,
-            sha256=hashlib.sha256(blob).hexdigest(), size=len(blob),
-            input_shape=input_shape, layer_names=layer_names,
-            network_spec=network_spec)
         import urllib.error
         try:
             # direct read (fs retries=1): a 404 means "first publish"

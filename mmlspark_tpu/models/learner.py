@@ -930,11 +930,13 @@ def _save_checkpoint(ckpt_dir: str, step: int, state) -> None:
             for fn in names:
                 with open(os.path.join(td, fn), "rb") as f:
                     fs.write_bytes(f"{base}/{fn}", f.read())
-        for stale in _remote_steps(ckpt_dir)[:-3]:
-            try:
+        try:
+            stales = _remote_steps(ckpt_dir)[:-3]
+            for stale in stales:
                 fs.delete_path(f"{ckpt_dir.rstrip('/')}/{stale}/")
-            except (IOError, NotImplementedError):
-                pass                   # pruning is best-effort
+        except (IOError, OSError, NotImplementedError):
+            pass                       # pruning (incl. listing) is
+            #                            best-effort — the save landed
         return
     path = os.path.join(ckpt_dir, f"step_{step:08d}")
     os.makedirs(path, exist_ok=True)

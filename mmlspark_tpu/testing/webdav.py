@@ -67,14 +67,17 @@ class _DAVHandler(BaseHTTPRequestHandler):
             self._reply(404)
 
     def do_PUT(self):
+        # drain the body FIRST: replying 409/403 with unread body bytes
+        # would corrupt a keep-alive connection (the leftover bytes
+        # parse as the next request line)
+        n = int(self.headers.get("Content-Length", 0))
+        data = self.rfile.read(n) if n else b""
         p = self._local()
         if p is None:
             return self._reply(403)
         if not os.path.isdir(os.path.dirname(p)):
             # DAV: PUT into a missing collection is 409 Conflict
             return self._reply(409)
-        n = int(self.headers.get("Content-Length", 0))
-        data = self.rfile.read(n) if n else b""
         existed = os.path.exists(p)
         with open(p, "wb") as f:
             f.write(data)

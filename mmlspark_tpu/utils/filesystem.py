@@ -123,6 +123,14 @@ class HTTPFileSystem(FileSystem):
         return out
 
 
+class _NoRetry(Exception):
+    """Wraps a deterministic (4xx) HTTP error so the retry loop
+    re-raises it immediately instead of backing off."""
+
+    def __init__(self, error):
+        self.error = error
+
+
 class WebDAVFileSystem(HTTPFileSystem):
     """WRITABLE HTTP backend — WebDAV verbs over plain stdlib urllib
     (the role the reference's HDFS/wasb layer plays for staging training
@@ -140,43 +148,65 @@ class WebDAVFileSystem(HTTPFileSystem):
 
     @staticmethod
     def _http_url(path: str) -> str:
+        """webdav(s):// path -> final http(s) URL with the path
+        component percent-encoded. Convention: webdav paths are PLAIN
+        (unencoded) names — a file called 'my file.bin' is addressed as
+        .../my file.bin and encoded here, on the wire only."""
         if path.startswith("webdavs://"):
-            return "https://" + path[len("webdavs://"):]
-        if path.startswith("webdav://"):
-            return "http://" + path[len("webdav://"):]
-        return path
+            path = "https://" + path[len("webdavs://"):]
+        elif path.startswith("webdav://"):
+            path = "http://" + path[len("webdav://"):]
+        parsed = urllib.parse.urlsplit(path)
+        return urllib.parse.urlunsplit(parsed._replace(
+            path=urllib.parse.quote(parsed.path)))
 
-    def _request(self, path: str, method: str, data: bytes = None,
+    def _request(self, url: str, method: str, data: bytes = None,
                  headers: Optional[Dict[str, str]] = None,
-                 ok: tuple = (200, 201, 204, 207)) -> bytes:
-        req = urllib.request.Request(
-            self._http_url(path), data=data, method=method,
-            headers=headers or {})
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            if r.status not in ok:
-                raise IOError(f"{method} {path}: HTTP {r.status}")
-            return r.read()
+                 ok: tuple = (200, 201, 204, 207),
+                 retry: bool = True) -> bytes:
+        """One verb against a FINAL (already-encoded) http URL, retried
+        with backoff on transient errors like the read/write paths (4xx
+        client errors don't retry — they are deterministic)."""
+        from mmlspark_tpu.downloader import retry_with_backoff
+
+        def once() -> bytes:
+            req = urllib.request.Request(
+                url, data=data, method=method, headers=headers or {})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout) as r:
+                    if r.status not in ok:
+                        raise IOError(f"{method} {url}: HTTP {r.status}")
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                if 400 <= e.code < 500:
+                    raise _NoRetry(e) from e
+                raise
+
+        try:
+            return retry_with_backoff(
+                once, times=self.retries if retry else 1,
+                no_retry=(_NoRetry,))
+        except _NoRetry as e:
+            raise e.error
 
     def read_bytes(self, path: str) -> bytes:
         return self._fetch(self._http_url(path))
 
     def write_bytes(self, path: str, data: bytes) -> None:
-        from mmlspark_tpu.downloader import retry_with_backoff
+        url = self._http_url(path)
+        try:
+            self._request(url, "PUT", data=data)
+        except urllib.error.HTTPError as e:
+            if e.code != 409:
+                raise
+            self._mkcols(url)
+            self._request(url, "PUT", data=data)
 
-        def put() -> None:
-            try:
-                self._request(path, "PUT", data=data)
-            except urllib.error.HTTPError as e:
-                if e.code != 409:
-                    raise
-                self._mkcols(path)
-                self._request(path, "PUT", data=data)
-        retry_with_backoff(put, times=self.retries)
-
-    def _mkcols(self, path: str) -> None:
+    def _mkcols(self, url: str) -> None:
         """Create missing parent collections, shallowest first (the
         DAV spec's 409 for a PUT with no parent)."""
-        parsed = urllib.parse.urlparse(self._http_url(path))
+        parsed = urllib.parse.urlparse(url)
         root = f"{parsed.scheme}://{parsed.netloc}"
         parts = parsed.path.strip("/").split("/")[:-1]
         cur = root
@@ -193,15 +223,16 @@ class WebDAVFileSystem(HTTPFileSystem):
 
     def delete_path(self, path: str) -> None:
         try:
-            self._request(path, "DELETE")
+            self._request(self._http_url(path), "DELETE")
         except urllib.error.HTTPError as e:
             if e.code != 404:
                 raise
 
     def _propfind(self, url: str, depth: str
                   ) -> Tuple[List[str], List[str]]:
-        """One PROPFIND -> (file paths, collection paths), both as
-        absolute server paths, excluding the queried url itself."""
+        """One PROPFIND against a final URL -> (file paths, collection
+        paths) as ENCODED absolute server paths (ready for follow-up
+        requests), excluding the queried url itself."""
         import re
         body = self._request(url, "PROPFIND", headers={"Depth": depth})
         self_path = urllib.parse.urlparse(url).path.rstrip("/")
@@ -209,7 +240,7 @@ class WebDAVFileSystem(HTTPFileSystem):
         dirs: List[str] = []
         for href in re.findall(rb"<(?:[A-Za-z]\w*:)?href>([^<]+)</",
                                body):
-            h = urllib.parse.unquote(href.decode("utf-8").strip())
+            h = href.decode("utf-8").strip()
             h_path = urllib.parse.urlparse(h).path or h
             if not h_path.startswith("/"):
                 h_path = "/" + h_path
@@ -252,9 +283,12 @@ class WebDAVFileSystem(HTTPFileSystem):
             raise
         out = []
         for h_path in files:
-            leaf = h_path.rsplit("/", 1)[-1]
+            # hrefs are percent-encoded on the wire; returned webdav://
+            # paths are PLAIN, matching the write-side convention
+            dec = urllib.parse.unquote(h_path)
+            leaf = dec.rsplit("/", 1)[-1]
             if pattern is None or fnmatch.fnmatch(leaf, pattern):
-                out.append(f"{root}{h_path}")
+                out.append(f"{root}{dec}")
         return sorted(set(out))
 
 

@@ -228,3 +228,20 @@ class TestBinaryFilesRemote:
                bytes(r["value"]["bytes"])
                for r in table.rows()}
         assert got == {"a.bin": b"AAA", "b.bin": b"BBBB"}
+
+
+class TestWebDAVEncoding:
+    def test_names_with_spaces_roundtrip(self, dav):
+        """webdav paths are PLAIN names; percent-encoding happens on
+        the wire only — write, exists, list, and read a name with
+        spaces (hrefs come back encoded)."""
+        base, _ = dav
+        url = f"{base}/dir with space/my file.bin"
+        write_bytes(url, b"spacey")
+        fs = get_filesystem(url)
+        assert fs.exists(url)
+        assert read_bytes(url) == b"spacey"
+        listed = fs.list_files(f"{base}/dir with space")
+        assert listed == [url]
+        # the listed URL round-trips straight back into read_bytes
+        assert read_bytes(listed[0]) == b"spacey"
