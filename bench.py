@@ -55,12 +55,16 @@ BASELINE_IMGS_PER_SEC_PER_CHIP = 1000.0
 BASELINE_HIGGS_WALL_S = 35.0
 
 BATCH = 1024
-# 128 steps/epoch: each epoch is ONE device dispatch (lax.scan chunk), so
-# long chunks amortize the remote-backend tunnel's ~170 ms per-dispatch
-# latency out of the steady state (docs/perf_analysis.md §3). 3 epochs =
-# 1 warmup (compile+sync) + 2 timed chunks.
+# 128 steps/epoch: each epoch is ONE device dispatch (lax.scan chunk).
+# Chunk dispatches QUEUE asynchronously with no per-chunk overhead
+# (measured: 4 queued chunks = 4x one chunk's exec, vs ~335 ms extra
+# per chunk when syncing between them) — the only fixed cost in the
+# timed window is the FINAL value-readback RTT, so more timed chunks
+# amortize it: 2 timed chunks lose ~10% to it, 8 lose ~3%
+# (epochs=3 -> MFU 0.166, 9 -> 0.181, 17 -> 0.184 asymptote on the
+# bench ResNet). 9 epochs = 1 warmup (compile+sync) + 8 timed chunks.
 STEPS_PER_EPOCH = 128
-EPOCHS = 3
+EPOCHS = 9
 
 HIGGS_N, HIGGS_F = 1_000_000, 28
 HIGGS_VALID_N = 100_000

@@ -78,6 +78,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_uint8)]
         lib.mml_apply_bins_t_u8.restype = ctypes.c_int
+    if hasattr(lib, "mml_apply_bins_t_u8_range"):
+        lib.mml_apply_bins_t_u8_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_long, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.mml_apply_bins_t_u8_range.restype = ctypes.c_int
     return lib
 
 
@@ -190,14 +198,21 @@ def apply_bins(X: np.ndarray, upper_bounds: list) -> Optional[np.ndarray]:
     return out if rc == 0 else None
 
 
-def apply_bins_t_u8(X: np.ndarray,
-                    upper_bounds: list) -> Optional[np.ndarray]:
+def apply_bins_t_u8(X: np.ndarray, upper_bounds: list,
+                    feature_range: Optional[tuple] = None,
+                    ) -> Optional[np.ndarray]:
     """Fused bin+transpose+narrow: (n, f) f32/f64 features ->
     FEATURES-MAJOR (f, n) uint8 bins in one native pass (the GBDT
-    engine's ship layout). Requires every feature's bin count <= 256 and
-    the library built after the kernel landed (probed via hasattr)."""
+    engine's ship layout). ``feature_range=(j0, j1)`` bins only that
+    column slice into a (j1-j0, n) block without copying X — the unit
+    of the pipelined host-bin/device-ship overlap. Requires every
+    feature's bin count <= 256 and the library built after the kernel
+    landed (probed via hasattr)."""
     lib = get_lib()
     if lib is None or not hasattr(lib, "mml_apply_bins_t_u8"):
+        return None
+    if feature_range is not None and not hasattr(
+            lib, "mml_apply_bins_t_u8_range"):
         return None
     if any(len(u) + 1 > 256 for u in upper_bounds):
         return None
@@ -217,10 +232,22 @@ def apply_bins_t_u8(X: np.ndarray,
     offsets = np.zeros(f + 1, dtype=np.int64)
     for j, u in enumerate(upper_bounds):
         offsets[j + 1] = offsets[j] + len(u)
-    out = np.empty((f, n), dtype=np.uint8)
-    rc = lib.mml_apply_bins_t_u8(
-        X.ctypes.data_as(ctypes.c_void_p), is_f32, n, f,
-        bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if feature_range is None:
+        out = np.empty((f, n), dtype=np.uint8)
+        rc = lib.mml_apply_bins_t_u8(
+            X.ctypes.data_as(ctypes.c_void_p), is_f32, n, f,
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    else:
+        j0, j1 = int(feature_range[0]), int(feature_range[1])
+        if not 0 <= j0 < j1 <= f:
+            raise ValueError(f"feature_range {feature_range} outside "
+                             f"[0, {f})")
+        out = np.empty((j1 - j0, n), dtype=np.uint8)
+        rc = lib.mml_apply_bins_t_u8_range(
+            X.ctypes.data_as(ctypes.c_void_p), is_f32, n, f, j0, j1,
+            bounds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
     return out if rc == 0 else None

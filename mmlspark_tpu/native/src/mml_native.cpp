@@ -272,18 +272,26 @@ int mml_apply_bins(const double* X, long n, int f, const double* bounds,
 // so results match the f64 path bit-for-bit. Requires every feature's
 // bin count <= 256 (caller checks). Row-tiled so the strided input
 // reads stay within cache while output writes run contiguous.
-int mml_apply_bins_t_u8(const void* Xv, int x_is_f32, long n, int f,
-                        const double* bounds, const long* offsets,
-                        uint8_t* out) {
+// feature-RANGE variant: bins only columns [j0, j1) of the full-width
+// input into a (j1-j0, n) output block. This is the unit of the
+// pipelined ship: the caller bins one feature chunk while the previous
+// chunk's host->device transfer is in flight, so host binning and link
+// time overlap instead of serializing (offsets/bounds still index the
+// FULL feature set; X keeps its full row stride — no column copy).
+int mml_apply_bins_t_u8_range(const void* Xv, int x_is_f32, long n,
+                              int f, int j0, int j1,
+                              const double* bounds, const long* offsets,
+                              uint8_t* out) {
+  if (j0 < 0 || j1 > f || j0 >= j1) return 1;
   const float* Xf = static_cast<const float*>(Xv);
   const double* Xd = static_cast<const double*>(Xv);
   const long TILE = 8192;
   for (long t0 = 0; t0 < n; t0 += TILE) {
     const long t1 = std::min(n, t0 + TILE);
-    for (int j = 0; j < f; ++j) {
+    for (int j = j0; j < j1; ++j) {
       const double* lo = bounds + offsets[j];
       const double* hi = bounds + offsets[j + 1];
-      uint8_t* orow = out + static_cast<size_t>(j) * n;
+      uint8_t* orow = out + static_cast<size_t>(j - j0) * n;
       for (long i = t0; i < t1; ++i) {
         const double v = x_is_f32 ? static_cast<double>(Xf[i * f + j])
                                   : Xd[i * f + j];
@@ -295,6 +303,13 @@ int mml_apply_bins_t_u8(const void* Xv, int x_is_f32, long n, int f,
     }
   }
   return 0;
+}
+
+int mml_apply_bins_t_u8(const void* Xv, int x_is_f32, long n, int f,
+                        const double* bounds, const long* offsets,
+                        uint8_t* out) {
+  return mml_apply_bins_t_u8_range(Xv, x_is_f32, n, f, 0, f, bounds,
+                                   offsets, out);
 }
 
 }  // extern "C"
