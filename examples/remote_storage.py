@@ -1,0 +1,94 @@
+"""Remote storage end-to-end: checkpoints, model zoo, binary IO.
+
+The reference stages everything through HDFS/wasb — training data and
+checkpoints (ref: CNTKLearner.scala:18-67 ``dataTransfer=hdfs``), the
+model zoo (HDFSRepo, ModelDownloader.scala:54-124), and binary readers
+(HadoopUtils.scala). The TPU-native seam is the scheme-keyed filesystem
+registry with the writable ``webdav://`` backend: this example runs a
+real (in-process) WebDAV server and pushes every one of those flows
+through it —
+
+1. train with ``checkpointDir`` on the remote store, then RESUME a
+   longer run from the remote step;
+2. publish the trained weights to a remote zoo repo and fetch them back
+   sha256-verified through ModelDownloader's local cache;
+3. read a directory of binary blobs straight off the remote store.
+"""
+
+import _pathsetup  # noqa: F401 — repo root on sys.path
+
+import tempfile
+
+import numpy as np
+
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.downloader import HTTPRepo, ModelDownloader
+from mmlspark_tpu.io.binary import read_binary_files
+from mmlspark_tpu.models.learner import TPULearner, _latest_checkpoint
+from mmlspark_tpu.testing.webdav import serve_webdav
+from mmlspark_tpu.utils.filesystem import write_bytes
+
+
+def main():
+    store = tempfile.mkdtemp(prefix="remote_store_")
+    server, base = serve_webdav(store)
+    print(f"remote store: {base}")
+    try:
+        # -- 1) checkpoint/resume over the remote scheme ----------------
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(128, 8)).astype(np.float32)
+        y = (x[:, 0] - x[:, 3] > 0).astype(np.int64)
+        table = DataTable({"features": x, "label": y})
+        ck = f"{base}/run1/ckpt"
+
+        def learner(epochs):
+            return TPULearner(
+                networkSpec={"type": "mlp", "features": [16],
+                             "num_classes": 2},
+                epochs=epochs, batchSize=32, learningRate=0.1,
+                computeDtype="float32", logEvery=1000,
+                checkpointDir=ck, checkpointEvery=4, resume=True)
+
+        learner(2).fit(table)
+        latest = _latest_checkpoint(ck)
+        assert latest and latest.startswith("webdav://"), latest
+        step = int(latest.rsplit("step_", 1)[1])
+        print(f"checkpointed remotely at step {step}")
+
+        model = learner(5).fit(table)              # resumes, continues
+        acc = (np.asarray(model.transform(table)["scores"]).argmax(-1)
+               == y).mean()
+        print(f"resumed run holdout-free accuracy: {acc:.3f}")
+        assert acc > 0.85, acc
+
+        # -- 2) remote zoo publish + verified fetch ---------------------
+        from flax import serialization
+        repo = HTTPRepo(f"{base}/zoo")
+        blob = serialization.to_bytes(model.get("weights"))
+        schema = repo.publish(
+            "mlp_parity", {"type": "mlp", "features": [16],
+                           "num_classes": 2},
+            blob=blob, model_type="classification")
+        cache = tempfile.mkdtemp(prefix="zoo_cache_")
+        fetched = ModelDownloader(
+            local_path=cache, repo=HTTPRepo(f"{base}/zoo")
+        ).download_by_name("mlp_parity")
+        got = ModelDownloader(local_path=cache).local.read_blob(fetched)
+        assert got == blob
+        print(f"zoo round-trip verified ({len(blob)} bytes, "
+              f"sha256 {schema.sha256[:12]}...)")
+
+        # -- 3) binary reads off the remote store -----------------------
+        for i in range(3):
+            write_bytes(f"{base}/blobs/part-{i}.bin", bytes([i]) * 64)
+        blobs = read_binary_files(f"{base}/blobs", pattern="*.bin")
+        assert blobs.num_rows == 3
+        print(f"read {blobs.num_rows} remote binary files")
+        print("remote_storage example OK")
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+if __name__ == "__main__":
+    main()

@@ -25,10 +25,10 @@ semantics-changing attributes outside the supported envelope are
 rejected with actionable errors, so a graph that loads executes
 faithfully):
 
-  CNN family  : Conv, BatchNormalization, Relu, MaxPool, AveragePool,
-                GlobalAveragePool, Flatten
+  CNN family  : Conv (1-D and 2-D), BatchNormalization, Relu,
+                MaxPool, AveragePool, GlobalAveragePool, Flatten
   linear      : Gemm, MatMul
-  recurrent   : LSTM (forward / reverse / bidirectional)
+  recurrent   : LSTM, GRU (each forward / reverse / bidirectional)
   activations : Sigmoid, Tanh, Softmax, LogSoftmax, LeakyRelu, Clip
   elementwise : Add, Sub, Mul, Div, Neg, Exp, Sqrt, Pow
   structure   : Concat, Transpose, Reshape, Squeeze, Unsqueeze, Slice,
@@ -356,7 +356,7 @@ SUPPORTED_OPS = {
     "Sigmoid", "Tanh", "Softmax", "LogSoftmax", "LeakyRelu",
     "Sub", "Mul", "Div", "Neg", "Exp", "Sqrt", "Pow",
     "Concat", "Transpose", "Squeeze", "Unsqueeze", "Slice", "Shape",
-    "Gather", "Cast", "ReduceMean", "LSTM",
+    "Gather", "Cast", "ReduceMean", "LSTM", "GRU",
 }
 
 # inclusive default-domain opset envelope this importer implements
@@ -370,6 +370,26 @@ _LSTM_DEFAULT_ACTS = {
 
 def _node_label(node: OnnxNode) -> str:
     return f"{node.op_type} node {node.name or node.outputs[:1]}"
+
+
+def _validate_recurrent_envelope(node: OnnxNode, lbl: str) -> None:
+    """Checks common to every recurrent op (LSTM/GRU): cell clipping,
+    batch-major layout, direction values, per-row sequence lengths."""
+    a = node.attrs
+    if a.get("clip") is not None:
+        raise ValueError(f"{lbl}: cell clipping is not supported")
+    if a.get("layout", 0):
+        raise ValueError(
+            f"{lbl}: layout=1 (batch-major) is not supported — "
+            f"re-export with the default layout=0")
+    if a.get("direction", "forward") not in (
+            "forward", "reverse", "bidirectional"):
+        raise ValueError(
+            f"{lbl}: direction={a.get('direction')!r} invalid")
+    if len(node.inputs) > 4 and node.inputs[4]:
+        raise ValueError(
+            f"{lbl}: per-row sequence_lens is not supported — pad "
+            f"to fixed length (TPU graphs are static-shape)")
 
 
 def _validate_node(node: OnnxNode, opset: int,
@@ -387,20 +407,20 @@ def _validate_node(node: OnnxNode, opset: int,
             raise ValueError(
                 f"{lbl}: auto_pad={ap!r} is not supported — re-export "
                 f"with explicit 'pads' (auto_pad is deprecated in ONNX)")
-        # only 2-D convs/pools are implemented (NCHW); a Conv1d/3d
+        # 1-D (NCW) and 2-D (NCHW) convs/pools are implemented; a 3-D
         # export would otherwise die mid-inference in lax with an
         # unrelated-looking dimension_numbers error
         ks = a.get("kernel_shape")
-        if ks is not None and len(ks) != 2:
+        if ks is not None and len(ks) not in (1, 2):
             raise ValueError(
-                f"{lbl}: only 2-D spatial kernels are supported, got "
-                f"kernel_shape={ks}")
+                f"{lbl}: only 1-D/2-D spatial kernels are supported, "
+                f"got kernel_shape={ks}")
         if op == "Conv" and inits is not None and len(node.inputs) > 1:
             w = inits.get(node.inputs[1])
-            if w is not None and w.ndim != 4:
+            if w is not None and w.ndim not in (3, 4):
                 raise ValueError(
-                    f"{lbl}: only 2-D convolution (OIHW weights) is "
-                    f"supported, got weight rank {w.ndim}")
+                    f"{lbl}: only 1-D (OIW) / 2-D (OIHW) convolution "
+                    f"weights are supported, got rank {w.ndim}")
     if op in ("MaxPool", "AveragePool"):
         if a.get("ceil_mode", 0):
             raise ValueError(
@@ -430,26 +450,22 @@ def _validate_node(node: OnnxNode, opset: int,
             raise ValueError(
                 f"{lbl}: non-default activations {acts} are not "
                 f"supported (only {_LSTM_DEFAULT_ACTS[ndir]})")
-        if a.get("clip") is not None:
-            raise ValueError(f"{lbl}: cell clipping is not supported")
         if a.get("input_forget", 0):
             raise ValueError(f"{lbl}: input_forget=1 is not supported")
-        if a.get("layout", 0):
-            raise ValueError(
-                f"{lbl}: layout=1 (batch-major) is not supported — "
-                f"re-export with the default layout=0")
-        if a.get("direction", "forward") not in (
-                "forward", "reverse", "bidirectional"):
-            raise ValueError(
-                f"{lbl}: direction={a.get('direction')!r} invalid")
-        if len(node.inputs) > 4 and node.inputs[4]:
-            raise ValueError(
-                f"{lbl}: per-row sequence_lens is not supported — pad "
-                f"to fixed length (TPU graphs are static-shape)")
+        _validate_recurrent_envelope(node, lbl)
     if op == "LSTM" and len(node.inputs) > 7 and node.inputs[7]:
         raise ValueError(
             f"{lbl}: peephole weights (input P) are not supported — "
             f"the gates would compute without the P*c terms")
+    if op == "GRU":
+        ndir = 2 if a.get("direction", "forward") == "bidirectional" else 1
+        acts = a.get("activations")
+        if acts is not None and list(acts) != \
+                ["Sigmoid", "Tanh"] * ndir:
+            raise ValueError(
+                f"{lbl}: non-default activations {acts} are not "
+                f"supported (only Sigmoid/Tanh)")
+        _validate_recurrent_envelope(node, lbl)
     if op in ("Squeeze", "Unsqueeze") and opset >= 13 and "axes" in a:
         raise ValueError(
             f"{lbl}: attribute-form axes inside an opset-{opset} graph "
@@ -679,16 +695,21 @@ class OnnxApply:
             x = [env[i] if i else None for i in node.inputs]
             op = node.op_type
             if op == "Conv":
-                strides = a.get("strides", [1, 1])
-                pads = a.get("pads", [0] * 4)
-                dil = a.get("dilations", [1, 1])
+                w_c = jnp.asarray(x[1])
+                sp = w_c.ndim - 2          # spatial rank: 1-D or 2-D
+                strides = a.get("strides", [1] * sp)
+                pads = a.get("pads", [0] * (2 * sp))
+                dil = a.get("dilations", [1] * sp)
                 groups = int(a.get("group", 1))
+                dn = (("NCW", "OIW", "NCW") if sp == 1
+                      else ("NCHW", "OIHW", "NCHW"))
                 out = lax.conv_general_dilated(
-                    x[0], jnp.asarray(x[1]), strides, _pairs(pads),
+                    x[0], w_c, strides, _pairs(pads),
                     rhs_dilation=dil, feature_group_count=groups,
-                    dimension_numbers=("NCHW", "OIHW", "NCHW"))
+                    dimension_numbers=dn)
                 if len(x) > 2 and x[2] is not None:
-                    out = out + jnp.asarray(x[2])[None, :, None, None]
+                    bias_shape = (1, -1) + (1,) * sp
+                    out = out + jnp.asarray(x[2]).reshape(bias_shape)
             elif op == "BatchNormalization":
                 eps = a.get("epsilon", 1e-5)
                 scale, b, mean, var = (jnp.asarray(t) for t in x[1:5])
@@ -884,6 +905,8 @@ class OnnxApply:
                 out = jnp.clip(x[0], lo, hi)
             elif op == "LSTM":
                 out = self._lstm(node, x, a)
+            elif op == "GRU":
+                out = self._gru(node, x, a)
             else:  # pragma: no cover — load_onnx validated the op set
                 raise ValueError(f"unsupported op {op}")
             outs_t = out if isinstance(out, tuple) else (out,)
@@ -956,6 +979,69 @@ class OnnxApply:
             c_l.append(cT)
         Y = jnp.stack(ys_l, axis=1)                # (T, D, B, H)
         return Y, jnp.stack(h_l, 0), jnp.stack(c_l, 0)
+
+
+    @staticmethod
+    def _gru(node: OnnxNode, x: List[Any], a: Dict[str, Any]):
+        """ONNX GRU (gate order z,r,h; activations sigmoid/tanh —
+        load_onnx rejected anything else). Same TPU-first hoist as the
+        LSTM: the whole-sequence input projection is one MXU matmul;
+        lax.scan carries only the recurrent part. Honors
+        ``linear_before_reset`` both ways (=1 is what torch exports).
+        Returns (Y [T, dirs, B, H], Y_h [dirs, B, H])."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        X = x[0]                                   # (T, B, I)
+        W = jnp.asarray(x[1])                      # (D, 3H, I)
+        R = jnp.asarray(x[2])                      # (D, 3H, H)
+        hid = R.shape[-1]
+        bsz = X.shape[1]
+        bias = jnp.asarray(x[3]) if len(x) > 3 and x[3] is not None \
+            else None                              # (D, 6H)
+        h0 = x[5] if len(x) > 5 and x[5] is not None else None
+        lbr = int(a.get("linear_before_reset", 0))
+
+        def run_dir(d: int, reverse: bool):
+            Wd, Rd = W[d], R[d]
+            if bias is not None:
+                wb = bias[d, :3 * hid]             # (3H,)
+                rb = bias[d, 3 * hid:]             # (3H,)
+            else:
+                wb = rb = jnp.zeros((3 * hid,), X.dtype)
+            h = h0[d] if h0 is not None \
+                else jnp.zeros((bsz, hid), X.dtype)
+            xs = jnp.flip(X, 0) if reverse else X
+            xw = xs @ Wd.T + wb                    # (T, B, 3H) on MXU
+            Rz, Rr, Rh = jnp.split(Rd, 3, axis=0)
+            rbz, rbr, rbh = jnp.split(rb, 3)
+
+            def step(h, xt):
+                xz, xr, xh = jnp.split(xt, 3, axis=-1)
+                z = jax.nn.sigmoid(xz + h @ Rz.T + rbz)
+                r = jax.nn.sigmoid(xr + h @ Rr.T + rbr)
+                if lbr:
+                    hh = jnp.tanh(xh + r * (h @ Rh.T + rbh))
+                else:
+                    hh = jnp.tanh(xh + (r * h) @ Rh.T + rbh)
+                h = (1 - z) * hh + z * h
+                return h, h
+
+            hT, ys = lax.scan(step, h, xw)
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            return ys, hT
+
+        direction = a.get("direction", "forward")
+        revs = {"forward": [False], "reverse": [True],
+                "bidirectional": [False, True]}[direction]
+        ys_l, h_l = [], []
+        for d, rev in enumerate(revs):
+            ys, hT = run_dir(d, rev)
+            ys_l.append(ys)
+            h_l.append(hT)
+        return jnp.stack(ys_l, axis=1), jnp.stack(h_l, 0)
 
 
 def import_onnx_model(path: str, batch_size: int = 64,

@@ -104,10 +104,10 @@ class TestWireParsing:
             np.testing.assert_array_equal(graph.initializers[name], arr)
 
     def test_unsupported_op_rejected(self, tmp_path):
-        blob = ow.model([ow.node("GRU", ["x"], ["y"])], {}, "x", "y")
+        blob = ow.model([ow.node("Einsum", ["x"], ["y"])], {}, "x", "y")
         p = tmp_path / "bad.onnx"
         p.write_bytes(blob)
-        with pytest.raises(ValueError, match="GRU"):
+        with pytest.raises(ValueError, match="Einsum"):
             load_onnx(str(p))
 
     def test_not_onnx_rejected(self, tmp_path):
@@ -696,11 +696,11 @@ class TestLoadValidation:
             {"input": x}))
         np.testing.assert_allclose(out, x.mean(2), rtol=1e-5, atol=1e-6)
 
-    def test_conv1d_rejected_at_load(self, tmp_path):
+    def test_conv3d_weight_rank_rejected_at_load(self, tmp_path):
         p = self._write(tmp_path, [ow.node(
-            "Conv", ["input", "w"], ["output"], kernel_shape=[3])],
-            {"w": np.zeros((4, 3, 3), np.float32)})
-        with pytest.raises(ValueError, match="2-D"):
+            "Conv", ["input", "w"], ["output"])],  # rank via weights
+            {"w": np.zeros((4, 3, 3, 3, 3), np.float32)})
+        with pytest.raises(ValueError, match="rank 5"):
             load_onnx(p)
 
     def test_shape_start_end_attrs(self, tmp_path):
@@ -712,3 +712,138 @@ class TestLoadValidation:
         graph = load_onnx(str(p))
         out = np.asarray(OnnxApply(graph)({}, {"input": x}))
         np.testing.assert_array_equal(out, [3.0, 4.0])
+
+
+class TestGRUAndConv1d:
+    """Round-5 widening: GRU (torch exports linear_before_reset=1) and
+    1-D conv/pool — common in audio/text ONNX files."""
+
+    E, H, T, B = 12, 16, 10, 4
+
+    def _gru_weights(self, gru, sd, bidirectional):
+        def zrn(t):
+            r, z, n = np.split(t, 3, axis=0)
+            return np.concatenate([z, r, n], axis=0)
+        sfx = ["", "_reverse"] if bidirectional else [""]
+        W = np.stack([zrn(sd[f"weight_ih_l0{s}"]) for s in sfx])
+        R = np.stack([zrn(sd[f"weight_hh_l0{s}"]) for s in sfx])
+        Bb = np.stack([np.concatenate([zrn(sd[f"bias_ih_l0{s}"]),
+                                       zrn(sd[f"bias_hh_l0{s}"])])
+                       for s in sfx])
+        return W, R, Bb
+
+    @pytest.mark.parametrize("bidirectional", [False, True])
+    def test_gru_matches_torch(self, tmp_path, bidirectional):
+        import torch
+        import torch.nn as nn
+        torch.manual_seed(5)
+        gru = nn.GRU(self.E, self.H, bidirectional=bidirectional)
+        X = np.random.default_rng(20).normal(
+            size=(self.T, self.B, self.E)).astype(np.float32)
+        with torch.no_grad():
+            ref, _ = gru(torch.from_numpy(X))
+        sd = {k: v.detach().numpy() for k, v in gru.state_dict().items()}
+        W, R, Bb = self._gru_weights(gru, sd, bidirectional)
+        ndir = 2 if bidirectional else 1
+        nodes = [ow.node(
+            "GRU", ["input", "W", "R", "B"], ["y", "yh"],
+            hidden_size=self.H, linear_before_reset=1,
+            **({"direction": "bidirectional"} if bidirectional else {})),
+            # (T, D, B, H) -> (T, B, D*H) to match torch's layout
+            ow.node("Transpose", ["y"], ["yt"], perm=[0, 2, 1, 3]),
+            ow.node("Reshape", ["yt", "shape"], ["output"])]
+        inits = {"W": W, "R": R, "B": Bb,
+                 "shape": np.asarray([0, 0, -1], np.int64)}
+        p = tmp_path / "gru.onnx"
+        p.write_bytes(ow.model(nodes, inits, "input", "output"))
+        graph = load_onnx(str(p))
+        out = np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"input": X}))
+        np.testing.assert_allclose(out, ref.numpy(), rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_gru_linear_before_reset_0(self, tmp_path):
+        """The lbr=0 variant against a direct numpy recurrence."""
+        rng = np.random.default_rng(21)
+        E = H = 6
+        T, B = 5, 3
+        X = rng.normal(size=(T, B, E)).astype(np.float32)
+        W = rng.normal(scale=0.3, size=(1, 3 * H, E)).astype(np.float32)
+        R = rng.normal(scale=0.3, size=(1, 3 * H, H)).astype(np.float32)
+        nodes = [ow.node("GRU", ["input", "W", "R"], ["y"],
+                         hidden_size=H, linear_before_reset=0),
+                 ow.node("Squeeze", ["y", "ax"], ["output"])]
+        inits = {"W": W, "R": R, "ax": np.asarray([1], np.int64)}
+        p = tmp_path / "gru0.onnx"
+        p.write_bytes(ow.model(nodes, inits, "input", "output"))
+        graph = load_onnx(str(p))
+        out = np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"input": X}))
+
+        def sigm(v):
+            return 1 / (1 + np.exp(-v))
+        h = np.zeros((B, H), np.float32)
+        expect = []
+        Wz, Wr, Wh = np.split(W[0], 3, axis=0)
+        Rz, Rr, Rh = np.split(R[0], 3, axis=0)
+        for t in range(T):
+            z = sigm(X[t] @ Wz.T + h @ Rz.T)
+            r = sigm(X[t] @ Wr.T + h @ Rr.T)
+            hh = np.tanh(X[t] @ Wh.T + (r * h) @ Rh.T)
+            h = (1 - z) * hh + z * h
+            expect.append(h.copy())
+        np.testing.assert_allclose(out, np.stack(expect),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_conv1d_and_pool1d_match_torch(self, tmp_path):
+        import torch
+        import torch.nn.functional as F
+        rng = np.random.default_rng(22)
+        x = rng.normal(size=(2, 3, 20)).astype(np.float32)
+        w = rng.normal(scale=0.3, size=(5, 3, 4)).astype(np.float32)
+        b = rng.normal(size=5).astype(np.float32)
+        nodes = [
+            ow.node("Conv", ["input", "w", "b"], ["c"],
+                    kernel_shape=[4], strides=[2], pads=[1, 1],
+                    dilations=[1], group=1),
+            ow.node("Relu", ["c"], ["r"]),
+            ow.node("MaxPool", ["r"], ["output"], kernel_shape=[2],
+                    strides=[2], pads=[0, 0]),
+        ]
+        p = tmp_path / "c1d.onnx"
+        p.write_bytes(ow.model(nodes, {"w": w, "b": b},
+                               "input", "output"))
+        graph = load_onnx(str(p))
+        out = np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"input": x}))
+        with torch.no_grad():
+            ref = F.max_pool1d(torch.relu(F.conv1d(
+                torch.from_numpy(x), torch.from_numpy(w),
+                torch.from_numpy(b), stride=2, padding=1)), 2, 2)
+        np.testing.assert_allclose(out, ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_conv3d_rejected(self, tmp_path):
+        nodes = [ow.node("Conv", ["input", "w"], ["output"],
+                         kernel_shape=[3, 3, 3])]
+        p = tmp_path / "c3d.onnx"
+        p.write_bytes(ow.model(
+            nodes, {"w": np.zeros((4, 3, 3, 3, 3), np.float32)},
+            "input", "output"))
+        with pytest.raises(ValueError, match="1-D/2-D"):
+            load_onnx(str(p))
+
+    def test_gru_nondefault_activations_rejected(self, tmp_path):
+        nodes = [ow.node("GRU", ["input", "W", "R"], ["output"],
+                         hidden_size=4,
+                         activations=["Relu", "Tanh"])]
+        p = tmp_path / "grubad.onnx"
+        p.write_bytes(ow.model(
+            nodes, {"W": np.zeros((1, 12, 3), np.float32),
+                    "R": np.zeros((1, 12, 4), np.float32)},
+            "input", "output"))
+        with pytest.raises(ValueError, match="activations"):
+            load_onnx(str(p))
