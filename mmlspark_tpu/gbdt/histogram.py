@@ -36,13 +36,16 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     weight: jnp.ndarray, leaf_of_row: jnp.ndarray,
                     num_leaves: int, num_bins: int,
                     method: str = "scatter",
-                    axis_name: Optional[str] = None) -> jnp.ndarray:
+                    axis_name: Optional[str] = None,
+                    true_shape=None) -> jnp.ndarray:
     """Per-(leaf, feature, bin) sums of grad/hess/count.
 
     bins: (F, N) int32 features-major; grad/hess/weight: (N,) f32;
     leaf_of_row: (N,) int32. weight doubles as the padding/bagging mask
     (0 = row ignored). Returns (3, L, F, B) f32, psum'd over
-    ``axis_name`` when given.
+    ``axis_name`` when given. ``true_shape`` (pallas only) marks bins
+    pre-padded to the kernel's block multiples — see
+    pallas_hist.padded_bins_shape.
     """
     if method == "onehot":
         hist = _hist_onehot(bins, grad, hess, weight, leaf_of_row,
@@ -51,7 +54,8 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         from mmlspark_tpu.gbdt.pallas_hist import hist_pallas
         hist = hist_pallas(
             bins, grad, hess, weight, leaf_of_row, num_leaves, num_bins,
-            interpret=jax.default_backend() not in ("tpu", "axon"))
+            interpret=jax.default_backend() not in ("tpu", "axon"),
+            true_shape=true_shape)
     else:
         hist = _hist_scatter(bins, grad, hess, weight, leaf_of_row,
                              num_leaves, num_bins)

@@ -145,37 +145,18 @@ def _hist_kernel(bins_ref, stats_ref, leaf_ref, out_ref, *,
         out_ref[:] = out_ref[:] + contrib
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("num_leaves", "num_bins", "interpret"))
-def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
-                weight: jnp.ndarray, leaf_of_row: jnp.ndarray,
-                num_leaves: int, num_bins: int,
-                interpret: bool = False) -> jnp.ndarray:
-    """(3, L, F, B) float32 histogram via the Pallas MXU kernel.
-
-    ``bins`` is features-major (F, N) — consumed directly, no transpose.
-    Same contract as histogram.build_histogram's other methods; rows
-    with weight 0 (padding/bagging) contribute nothing.
-    """
-    f, n = bins.shape
-
-    # bins padded to a multiple of 32 keeps fc*B 128-divisible for any
-    # fc that is a multiple of 8 (bin values never reach the pad slots)
+def _block_plan(f: int, n: int, num_bins: int, num_leaves: int):
+    """The kernel's block geometry for TRUE input shape (f, n):
+    returns (nibble, c, fc, b_pad, f_target, n_target). Both
+    hist_pallas's internal padding and grow_tree's once-per-tree
+    pre-padding (padded_bins_shape) derive from this single function,
+    so they cannot drift."""
     b_pad = -(-num_bins // 32) * 32
-
-    # single-leaf hot path (the tree grower only ever builds these) at
-    # B >= 128 routes to the digit-decomposition kernel: VPU one-hot
-    # work per row drops from O(B) to O(4h + l), h*l = B. Measured on
-    # v5e at HIGGS shape: 255-bin boost loop 16.4 s -> 5.0 s; at B=64
-    # the direct one-hot is still faster (fewer, larger matmuls), so it
-    # keeps the small-B range
     if num_leaves == 1 and b_pad >= 128 and _nibble_hl(b_pad):
-        return _hist_pallas_nibble(bins, grad, hess, weight, f, n,
-                                   num_bins, b_pad, interpret)
-
-    # row chunk: one full chunk for small inputs, else fixed slices —
-    # capped so the one-hot block (c * fc * B * 4 bytes, fc >= 8) can
-    # never exceed the VMEM budget even at the fc floor
+        fc = min(8, f + ((-f) % 8))
+        c = min(8192, max(512, n + ((-n) % 512)))
+        return (True, c, fc, b_pad,
+                f + ((-f) % fc), n + ((-n) % c))
     row_chunk = ROW_CHUNK_SINGLE if num_leaves == 1 else ROW_CHUNK
     row_cap = max(128, (VMEM_ONEHOT_BYTES // 4 // (8 * b_pad))
                   // 128 * 128)
@@ -184,13 +165,9 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         c = row_chunk
     else:
         c = n + ((-n) % 8)          # single chunk, sublane-aligned
-    pad_rows = (-n) % c
-
-    # feature chunk: bounded so the VMEM one-hot block fits the budget
     elems = VMEM_ONEHOT_BYTES // 4 // c
     fc = max(8, (elems // b_pad) // 8 * 8)
     fc = min(fc, f + ((-f) % 8))
-    pad_feats = (-f) % fc
     if c * fc * b_pad * 4 > 2 * VMEM_ONEHOT_BYTES:
         # the fc/row floors could not respect the budget (huge num_bins)
         # — fail loudly rather than letting Mosaic's allocator throw a
@@ -199,15 +176,73 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             f"num_bins={num_bins} is beyond the Pallas histogram's VMEM "
             f"tiling range (block {c}x{fc}x{b_pad}); use "
             f"hist_method='onehot'")
+    return (False, c, fc, b_pad,
+            f + ((-f) % fc), n + ((-n) % c))
 
-    if pad_rows:
-        bins = jnp.pad(bins, ((0, 0), (0, pad_rows)))
-        grad = jnp.pad(grad, (0, pad_rows))
-        hess = jnp.pad(hess, (0, pad_rows))
-        weight = jnp.pad(weight, (0, pad_rows))   # 0-weight padding
-        leaf_of_row = jnp.pad(leaf_of_row, (0, pad_rows))
-    if pad_feats:
-        bins = jnp.pad(bins, ((0, pad_feats), (0, 0)))
+
+def padded_bins_shape(f: int, n: int, num_bins: int,
+                      num_leaves: int = 1):
+    """(f_target, n_target) the kernel will pad a TRUE (f, n) bins
+    matrix to. Callers that invoke the histogram many times on the same
+    bins (grow_tree: once per split) pre-pad ONCE to this shape and
+    pass ``true_shape`` — profiling showed the per-call pad of the full
+    (F, N) matrix was 17% of the boost loop."""
+    _, _, _, _, f_t, n_t = _block_plan(f, n, num_bins, num_leaves)
+    return f_t, n_t
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_leaves", "num_bins",
+                                    "interpret", "true_shape"))
+def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
+                weight: jnp.ndarray, leaf_of_row: jnp.ndarray,
+                num_leaves: int, num_bins: int,
+                interpret: bool = False,
+                true_shape=None) -> jnp.ndarray:
+    """(3, L, F, B) float32 histogram via the Pallas MXU kernel.
+
+    ``bins`` is features-major (F, N) — consumed directly, no transpose.
+    Same contract as histogram.build_histogram's other methods; rows
+    with weight 0 (padding/bagging) contribute nothing.
+
+    ``true_shape=(f, n)`` marks ``bins`` as ALREADY padded to
+    padded_bins_shape(f, n, ...): the per-call full-matrix pad is then
+    a no-op (profiled at 17% of the boost loop when left inside the
+    split loop); grad/hess/weight/leaf_of_row stay true-n sized and
+    are padded here (cheap (N,) pads). The returned histogram is
+    always sliced to the TRUE f."""
+    f, n = true_shape if true_shape is not None else bins.shape
+
+    nibble, c, fc, b_pad, f_tgt, n_tgt = _block_plan(
+        f, n, num_bins, num_leaves)
+    if bins.shape[0] > f_tgt or bins.shape[1] > n_tgt:
+        raise ValueError(
+            f"bins {bins.shape} exceed the kernel target "
+            f"({f_tgt}, {n_tgt}) for true_shape ({f}, {n})")
+
+    # single-leaf hot path (the tree grower only ever builds these) at
+    # B >= 128 routes to the digit-decomposition kernel: VPU one-hot
+    # work per row drops from O(B) to O(4h + l), h*l = B. Measured on
+    # v5e at HIGGS shape: 255-bin boost loop 16.4 s -> 5.0 s; at B=64
+    # the direct one-hot is still faster (fewer, larger matmuls), so it
+    # keeps the small-B range
+    # ONE padding block for both kernel paths, keyed off the plan's
+    # targets (pre-padded bins make these no-ops — see true_shape)
+    pad_rows = n_tgt - bins.shape[1]
+    pad_feats = f_tgt - bins.shape[0]
+    stat_pad = n_tgt - n
+    if pad_rows or pad_feats:
+        bins = jnp.pad(bins, ((0, pad_feats), (0, pad_rows)))
+    if stat_pad:
+        grad = jnp.pad(grad, (0, stat_pad))
+        hess = jnp.pad(hess, (0, stat_pad))
+        weight = jnp.pad(weight, (0, stat_pad))   # 0-weight padding
+        if not nibble:                 # nibble kernel is single-leaf
+            leaf_of_row = jnp.pad(leaf_of_row, (0, stat_pad))
+
+    if nibble:
+        return _hist_pallas_nibble(bins, grad, hess, weight, f, n,
+                                   num_bins, b_pad, c, fc, interpret)
     f_p, n_p = bins.shape
 
     stats = jnp.stack([grad * weight, hess * weight, weight],
@@ -233,28 +268,19 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
 
     # (3L, F_p*B_pad) -> (3, L, F, B)
     hist = out.reshape(3, num_leaves, f_p, b_pad)
-    if pad_feats or b_pad != num_bins:
+    if f_p != f or b_pad != num_bins:
         hist = hist[:, :, :f, :num_bins]
     return hist
 
 
-def _hist_pallas_nibble(bins, grad, hess, weight, f, n, num_bins, b_pad,
-                        interpret):
+def _hist_pallas_nibble(bins, grad, hess, weight, f, n, num_bins,
+                        b_pad, c, fc, interpret):
     """Single-leaf histogram through the digit-decomposition kernel.
     The tiny per-step VMEM footprint (no (fc*B, C) one-hot block) lets
-    row chunks grow to 8192, cutting grid-step count ~8x as well."""
+    row chunks grow to 8192, cutting grid-step count ~8x as well.
+    Block geometry comes from _block_plan; inputs arrive already padded
+    to the plan's targets by hist_pallas."""
     h, l = _nibble_hl(b_pad)
-    fc = min(8, f + ((-f) % 8))
-    c = min(8192, max(512, n + ((-n) % 512)))
-    pad_rows = (-n) % c
-    pad_feats = (-f) % fc
-    if pad_rows:
-        bins = jnp.pad(bins, ((0, 0), (0, pad_rows)))
-        grad = jnp.pad(grad, (0, pad_rows))
-        hess = jnp.pad(hess, (0, pad_rows))
-        weight = jnp.pad(weight, (0, pad_rows))   # 0-weight padding
-    if pad_feats:
-        bins = jnp.pad(bins, ((0, pad_feats), (0, 0)))
     f_p, n_p = bins.shape
 
     stats = jnp.stack([grad * weight, hess * weight, weight],
@@ -278,6 +304,6 @@ def _hist_pallas_nibble(bins, grad, hess, weight, f, n, num_bins, b_pad,
     # the (3, 1, F, B) contract
     hist = out.reshape(3, h, f_p, l).transpose(0, 2, 1, 3) \
         .reshape(3, 1, f_p, b_pad)
-    if pad_feats or b_pad != num_bins:
+    if f_p != f or b_pad != num_bins:
         hist = hist[:, :, :f, :num_bins]
     return hist

@@ -138,11 +138,27 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     min_data = float(p.min_data_in_leaf)
     zero_leaf = jnp.zeros(n, dtype=jnp.int32)
 
+    # the split loop builds one histogram per split on the SAME bins:
+    # pre-pad once to the Pallas kernel's block multiples so the
+    # per-call full-matrix pad is a no-op (profiled at 17% of the
+    # boost loop — 62 pads of the (F, N) matrix per tree otherwise;
+    # the padded copy lives only inside this tree's program)
+    if p.hist_method == "pallas":
+        from mmlspark_tpu.gbdt.pallas_hist import padded_bins_shape
+        f_tgt, n_tgt = padded_bins_shape(f, n, B, 1)
+        bins_hist = (jnp.pad(bins, ((0, f_tgt - f), (0, n_tgt - n)))
+                     if (f_tgt, n_tgt) != (f, n) else bins)
+        hist_true_shape = (f, n)
+    else:
+        bins_hist = bins
+        hist_true_shape = None
+
     def leaf_hist(mask_weight):
         """(3, F, B) histogram of the rows selected by mask_weight."""
-        h = build_histogram(bins, grad, hess, mask_weight, zero_leaf,
-                            1, B, method=p.hist_method,
-                            axis_name=hist_axis)       # (3, 1, F, B)
+        h = build_histogram(bins_hist, grad, hess, mask_weight,
+                            zero_leaf, 1, B, method=p.hist_method,
+                            axis_name=hist_axis,
+                            true_shape=hist_true_shape)  # (3, 1, F, B)
         return h[:, 0]
 
     def best_split_voting(hist, depth_ok, hist_sub=None):

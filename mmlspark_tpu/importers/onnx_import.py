@@ -15,8 +15,8 @@ protobuf WIRE FORMAT directly (varint / length-delimited walking over
 the public onnx.proto field numbers — ModelProto.{graph=7,
 opset_import=8}, GraphProto.{node=1, initializer=5, input=11,
 output=12}, NodeProto.{input=1, output=2, name=3, op_type=4,
-attribute=5}, AttributeProto.{name=1, f=2, i=3, s=4, t=5, ints=8,
-strings=7}, TensorProto.{dims=1, data_type=2, float_data=4,
+attribute=5}, AttributeProto.{name=1, f=2, i=3, s=4, t=5, floats=7,
+ints=8, strings=9}, TensorProto.{dims=1, data_type=2, float_data=4,
 int32_data=5, int64_data=7, name=8, raw_data=9},
 ValueInfoProto.{name=1, type=2} with nested tensor_type/shape dims).
 
@@ -234,6 +234,7 @@ def _parse_attribute(buf: bytes) -> Tuple[str, Any]:
     name = ""
     out: Any = None
     ints: List[int] = []
+    floats: List[float] = []
     strings: List[str] = []
     for field, wt, val in _fields(buf):
         if field == 1:
@@ -246,7 +247,18 @@ def _parse_attribute(buf: bytes) -> Tuple[str, Any]:
             out = val.decode("utf-8", "replace")
         elif field == 5:                    # t (tensor)
             out = _parse_tensor(val)[1]
-        elif field == 7:                    # strings (repeated bytes)
+        elif field == 6:                    # g (GraphProto — subgraph)
+            # subgraph-carrying ops (If/Loop/Scan) are outside the
+            # supported set; the op check rejects them, so the bytes
+            # are skipped here rather than mis-parsed
+            pass
+        elif field == 7:                    # floats (repeated fixed32)
+            if wt == 5:
+                floats.append(struct.unpack("<f", val)[0])
+            else:                           # packed
+                floats.extend(
+                    struct.unpack(f"<{len(val) // 4}f", val))
+        elif field == 9:                    # strings (repeated bytes)
             strings.append(val.decode("utf-8", "replace"))
         elif field == 8:                    # ints (repeated)
             if wt == 0:
@@ -258,6 +270,8 @@ def _parse_attribute(buf: bytes) -> Tuple[str, Any]:
                     ints.append(_signed(d))
     if ints:
         return name, ints
+    if floats:
+        return name, floats
     if strings:
         return name, strings
     return name, out
@@ -372,6 +386,28 @@ def _node_label(node: OnnxNode) -> str:
     return f"{node.op_type} node {node.name or node.outputs[:1]}"
 
 
+_CONSTANT_SPELLINGS = ("value", "value_float", "value_int",
+                       "value_floats", "value_ints")
+
+
+def _constant_value(attrs: Dict[str, Any]) -> Optional[np.ndarray]:
+    """The numpy value of a Constant node under any of the value_*
+    attribute spellings (opset 12+); None when only unsupported forms
+    (sparse/string) are present. numpy (not jnp) so shape-computing
+    chains that consume constants stay concrete under jit."""
+    if "value" in attrs:
+        return np.asarray(attrs["value"])
+    if "value_float" in attrs:
+        return np.asarray(attrs["value_float"], np.float32)
+    if "value_int" in attrs:
+        return np.asarray(attrs["value_int"], np.int64)
+    if "value_floats" in attrs:
+        return np.asarray(attrs["value_floats"], np.float32)
+    if "value_ints" in attrs:
+        return np.asarray(attrs["value_ints"], np.int64)
+    return None
+
+
 def _validate_recurrent_envelope(node: OnnxNode, lbl: str) -> None:
     """Checks common to every recurrent op (LSTM/GRU): cell clipping,
     batch-major layout, direction values, per-row sequence lengths."""
@@ -435,6 +471,11 @@ def _validate_node(node: OnnxNode, opset: int,
         if len(node.outputs) > 1 and node.outputs[1]:
             raise ValueError(
                 f"{lbl}: the Indices output is not supported")
+    if op == "Constant" and not any(
+            k in a for k in _CONSTANT_SPELLINGS):
+        raise ValueError(
+            f"{lbl}: only tensor/float/int (scalar or list) constant "
+            f"values are supported, got attributes {sorted(a)}")
     if op == "Concat" and "axis" not in a:
         raise ValueError(f"{lbl}: required attribute 'axis' missing")
     if op == "Cast":
@@ -630,7 +671,9 @@ class OnnxApply:
         consts: Dict[str, np.ndarray] = {}
         for node in graph.nodes:
             if node.op_type == "Constant" and node.outputs:
-                consts[node.outputs[0]] = np.asarray(node.attrs["value"])
+                v_c = _constant_value(node.attrs)
+                if v_c is not None:
+                    consts[node.outputs[0]] = v_c
         needed = set()
         for node in graph.nodes:
             for slot in _SHAPE_SLOTS.get(node.op_type, ()):
@@ -894,9 +937,11 @@ class OnnxApply:
             elif op == "Identity":
                 out = x[0]
             elif op == "Constant":
-                # numpy (not jnp) so shape-computing chains that consume
-                # constants stay concrete under jit
-                out = np.asarray(a["value"])
+                out = _constant_value(a)
+                if out is None:  # pragma: no cover — load validated
+                    raise ValueError(
+                        f"{_node_label(node)}: no supported value "
+                        f"attribute (have {sorted(a)})")
             elif op == "Clip":
                 lo = x[1] if len(x) > 1 and x[1] is not None \
                     else a.get("min", -np.inf)

@@ -78,8 +78,13 @@ def _attr(name: str, value: Any) -> bytes:
     if isinstance(value, (list, tuple)) and value and \
             isinstance(value[0], str):
         for v in value:
-            out += _ld(7, v.encode())                 # strings
+            out += _ld(9, v.encode())                 # strings
         out += _int_field(20, 8)                      # type = STRINGS
+    elif isinstance(value, (list, tuple)) and value and \
+            isinstance(value[0], float):
+        for v in value:
+            out += _tag(7, 5) + struct.pack("<f", v)  # floats
+        out += _int_field(20, 6)                      # type = FLOATS
     elif isinstance(value, (list, tuple)):
         for v in value:
             out += _int_field(8, int(v))              # ints

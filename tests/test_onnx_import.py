@@ -847,3 +847,53 @@ class TestGRUAndConv1d:
             "input", "output"))
         with pytest.raises(ValueError, match="activations"):
             load_onnx(str(p))
+
+
+class TestConstantVariants:
+    """Constant value_* attribute spellings (opset 12+) and repeated
+    float attributes."""
+
+    def _run(self, tmp_path, nodes, x):
+        p = tmp_path / "c.onnx"
+        p.write_bytes(ow.model(nodes, {}, "input", "output"))
+        graph = load_onnx(str(p))
+        return np.asarray(OnnxApply(graph)({}, {"input": x}))
+
+    def test_value_float_and_ints(self, tmp_path):
+        x = np.ones((2, 3), np.float32)
+        nodes = [
+            ow.node("Constant", [], ["c"], value_float=2.5),
+            ow.node("Mul", ["input", "c"], ["m"]),
+            ow.node("Constant", [], ["shape"], value_ints=[3, 2]),
+            ow.node("Reshape", ["m", "shape"], ["output"]),
+        ]
+        out = self._run(tmp_path, nodes, x)
+        assert out.shape == (3, 2)
+        np.testing.assert_allclose(out, 2.5)
+
+    def test_value_int_scalar_gathers(self, tmp_path):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        nodes = [
+            ow.node("Constant", [], ["i"], value_int=2),
+            ow.node("Gather", ["input", "i"], ["output"], axis=0),
+        ]
+        out = self._run(tmp_path, nodes, x)
+        np.testing.assert_allclose(out, x[2])
+
+    def test_unsupported_constant_form_rejected(self, tmp_path):
+        nodes = [ow.node("Constant", [], ["c"], value_string="oops")]
+        p = tmp_path / "bad.onnx"
+        p.write_bytes(ow.model(nodes, {}, "x", "c"))
+        with pytest.raises(ValueError, match="constant"):
+            load_onnx(str(p))
+
+    def test_value_floats_list(self, tmp_path):
+        """Repeated-float attribute (field 7 per onnx.proto) decodes
+        as floats, not as a mis-numbered strings/graph field."""
+        x = np.zeros((1, 3), np.float32)
+        nodes = [
+            ow.node("Constant", [], ["c"], value_floats=[1.5, -2.0, 0.25]),
+            ow.node("Add", ["input", "c"], ["output"]),
+        ]
+        out = self._run(tmp_path, nodes, x)
+        np.testing.assert_allclose(out, [[1.5, -2.0, 0.25]])
