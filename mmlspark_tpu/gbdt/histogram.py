@@ -47,6 +47,10 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     pre-padded to the kernel's block multiples — see
     pallas_hist.padded_bins_shape.
     """
+    if true_shape is not None and method != "pallas":
+        raise ValueError(
+            "true_shape (pre-padded bins) is a pallas-only contract; "
+            f"method={method!r} would return phantom padded features")
     if method == "onehot":
         hist = _hist_onehot(bins, grad, hess, weight, leaf_of_row,
                             num_leaves, num_bins)

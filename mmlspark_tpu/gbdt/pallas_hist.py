@@ -150,7 +150,14 @@ def _block_plan(f: int, n: int, num_bins: int, num_leaves: int):
     returns (nibble, c, fc, b_pad, f_target, n_target). Both
     hist_pallas's internal padding and grow_tree's once-per-tree
     pre-padding (padded_bins_shape) derive from this single function,
-    so they cannot drift."""
+    so they cannot drift.
+
+    Routing: the single-leaf hot path (the tree grower only ever
+    builds these) at B >= 128 takes the digit-decomposition kernel —
+    VPU one-hot work per row drops from O(B) to O(4h + l), h*l = B
+    (measured on v5e at HIGGS shape: 255-bin boost loop 16.4s -> 5.0s).
+    At B < 128 the direct one-hot kernel is still faster (fewer,
+    larger matmuls)."""
     b_pad = -(-num_bins // 32) * 32
     if num_leaves == 1 and b_pad >= 128 and _nibble_hl(b_pad):
         fc = min(8, f + ((-f) % 8))
@@ -220,12 +227,6 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             f"bins {bins.shape} exceed the kernel target "
             f"({f_tgt}, {n_tgt}) for true_shape ({f}, {n})")
 
-    # single-leaf hot path (the tree grower only ever builds these) at
-    # B >= 128 routes to the digit-decomposition kernel: VPU one-hot
-    # work per row drops from O(B) to O(4h + l), h*l = B. Measured on
-    # v5e at HIGGS shape: 255-bin boost loop 16.4 s -> 5.0 s; at B=64
-    # the direct one-hot is still faster (fewer, larger matmuls), so it
-    # keeps the small-B range
     # ONE padding block for both kernel paths, keyed off the plan's
     # targets (pre-padded bins make these no-ops — see true_shape)
     pad_rows = n_tgt - bins.shape[1]

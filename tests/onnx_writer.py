@@ -81,9 +81,9 @@ def _attr(name: str, value: Any) -> bytes:
             out += _ld(9, v.encode())                 # strings
         out += _int_field(20, 8)                      # type = STRINGS
     elif isinstance(value, (list, tuple)) and value and \
-            isinstance(value[0], float):
+            any(isinstance(v, (float, np.floating)) for v in value):
         for v in value:
-            out += _tag(7, 5) + struct.pack("<f", v)  # floats
+            out += _tag(7, 5) + struct.pack("<f", float(v))  # floats
         out += _int_field(20, 6)                      # type = FLOATS
     elif isinstance(value, (list, tuple)):
         for v in value:
