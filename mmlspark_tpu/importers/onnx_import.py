@@ -652,12 +652,19 @@ class OnnxApply:
     dimension_numbers, no transposes)."""
 
     def __init__(self, graph: OnnxGraph, input_shape=None):
+        """``input_shape``: per-row shape to unflatten table rows to —
+        a tuple for single-input graphs, or a dict {input_name: shape}
+        for multi-input ones (None entries leave rows as-is)."""
         self.nodes = graph.nodes
         self.input_names = graph.inputs
         self.output_names = graph.outputs
         self.opset = graph.opset
         # per-row shape (e.g. (3, 224, 224)) to unflatten table rows to
-        self.input_shape = tuple(input_shape) if input_shape else None
+        if isinstance(input_shape, dict):
+            self.input_shape = {k: (tuple(v) if v else None)
+                                for k, v in input_shape.items()}
+        else:
+            self.input_shape = tuple(input_shape) if input_shape else None
         # int-element graph inputs (token ids) — TPUModel reads this to
         # feed int32 instead of the float compute dtype
         infos = [graph.input_infos.get(n, (None, None))
@@ -728,10 +735,25 @@ class OnnxApply:
         # static overlay: small integer constants stay concrete numpy
         # even when the weights pytree arrives traced (see __init__)
         env.update(self._static)
-        vals = list(inputs.values())
-        for name, v in zip(self.input_names, vals):
-            if self.input_shape:
-                v = v.reshape((v.shape[0],) + self.input_shape)
+        # bind by NAME when the feed keys are the graph input names
+        # (multi-input models — dict param storage may reorder);
+        # positional zip only for the single-input case (whose feed key
+        # is "input") — a positional fallback for several inputs could
+        # silently cross-bind same-shaped columns
+        if set(self.input_names) <= set(inputs.keys()):
+            bound = [(n, inputs[n]) for n in self.input_names]
+        elif len(self.input_names) == 1:
+            bound = list(zip(self.input_names, inputs.values()))
+        else:
+            raise KeyError(
+                f"multi-input graph needs feeds keyed by its input "
+                f"names {self.input_names}, got {sorted(inputs)}")
+        for name, v in bound:
+            shp = (self.input_shape.get(name)
+                   if isinstance(self.input_shape, dict)
+                   else self.input_shape)
+            if shp:
+                v = v.reshape((v.shape[0],) + tuple(shp))
             env[name] = v
         for node in self.nodes:
             a = node.attrs
@@ -1090,34 +1112,76 @@ class OnnxApply:
 
 
 def import_onnx_model(path: str, batch_size: int = 64,
-                      input_shape=None):
+                      input_shape=None, feed_cols=None):
     """ONNX file -> ready-to-serve TPUModel (the ModelDownloader /
     ImageFeaturizer contract). Weights are the graph initializers; the
-    modelFn is the jax graph executor. ``input_shape`` (e.g.
-    [3, 224, 224]) unflattens table rows; when omitted it is inferred
-    from the graph's declared input shape (trailing dims after the
+    modelFn is the jax graph executor.
+
+    Single-input graphs feed from the ``images`` column; ``input_shape``
+    (e.g. [3, 224, 224]) unflattens table rows, inferred from the
+    graph's declared input shape when omitted (trailing dims after the
     batch axis — a symbolic batch dim_param is the dynamic-batch
-    convention and is ignored). Integer-typed graph inputs (token ids)
-    make the model feed int32 rows instead of floats."""
+    convention). Integer-typed graph inputs (token ids) make the model
+    feed int32 rows instead of floats.
+
+    MULTI-input graphs (two-tower scorers, sequence+mask models) feed
+    each graph input from the table column of the same name —
+    ``feed_cols={input_name: column}`` overrides the mapping;
+    ``input_shape`` may then be a {input_name: shape} dict. All inputs
+    must share one element class (all integer or all float): TPUModel's
+    feed casts per model, not per column."""
     from mmlspark_tpu.models.tpu_model import TPUModel
 
     graph = load_onnx(path)
-    if len(graph.inputs) != 1:
+    if not graph.inputs:
+        raise ValueError("graph declares no runtime inputs")
+    elems = [graph.input_infos.get(n, (None, None))[0]
+             for n in graph.inputs]
+    int_flags = {e in _INT_ELEM_TYPES for e in elems if e is not None}
+    if len(int_flags) > 1:
         raise ValueError(
-            f"expected a single graph input, got {graph.inputs}")
+            f"graph mixes integer and float inputs "
+            f"({dict(zip(graph.inputs, elems))}); TPUModel feeds one "
+            f"element class per model — split the graph or cast inside "
+            f"it")
+    if feed_cols:
+        unknown = sorted(set(feed_cols) - set(graph.inputs))
+        if unknown:
+            raise ValueError(
+                f"feed_cols keys {unknown} are not graph inputs "
+                f"{graph.inputs}")
     apply_fn = OnnxApply(graph, input_shape=input_shape)
-    if apply_fn.input_shape is None:
-        _elem, dims = graph.input_infos.get(
-            graph.inputs[0], (None, None))
+
+    def _declared(name):
+        _e, dims = graph.input_infos.get(name, (None, None))
         if dims and len(dims) > 1 and all(
                 d is not None for d in dims[1:]):
-            apply_fn.input_shape = tuple(dims[1:])
-    model = TPUModel(
+            return tuple(dims[1:])
+        return None
+
+    shared = dict(
         modelFn=apply_fn,
-        weights={k: np.asarray(v) for k, v in graph.initializers.items()},
-        inputCol="images", outputCol="scores", batchSize=batch_size,
+        weights={k: np.asarray(v)
+                 for k, v in graph.initializers.items()},
+        outputCol="scores", batchSize=batch_size,
         computeDtype="float32")
-    return model
+    if len(graph.inputs) == 1:
+        if apply_fn.input_shape is None:
+            apply_fn.input_shape = _declared(graph.inputs[0])
+        return TPUModel(inputCol="images", **shared)
+    if apply_fn.input_shape is not None and not isinstance(
+            apply_fn.input_shape, dict):
+        raise ValueError(
+            "multi-input graphs need input_shape as a "
+            "{input_name: shape} dict (or omitted)")
+    # a PARTIAL dict still infers the unlisted inputs from the
+    # declared value infos (an explicit None entry disables)
+    given = dict(apply_fn.input_shape or {})
+    apply_fn.input_shape = {
+        n: given[n] if n in given else _declared(n)
+        for n in graph.inputs}
+    feed = {n: (feed_cols or {}).get(n, n) for n in graph.inputs}
+    return TPUModel(feedDict=feed, **shared)
 
 
 def onnx_summary(path: str) -> Dict[str, Any]:

@@ -897,3 +897,94 @@ class TestConstantVariants:
         ]
         out = self._run(tmp_path, nodes, x)
         np.testing.assert_allclose(out, [[1.5, -2.0, 0.25]])
+
+
+class TestMultiInput:
+    """Multi-input graphs (two-tower scorers, sequence+mask) feed each
+    graph input from its table column through TPUModel's feedDict."""
+
+    def _two_tower(self, tmp_path):
+        rng = np.random.default_rng(30)
+        wu = rng.normal(scale=0.3, size=(6, 4)).astype(np.float32)
+        wi = rng.normal(scale=0.3, size=(5, 4)).astype(np.float32)
+        nodes = [
+            ow.node("MatMul", ["user", "wu"], ["eu"]),
+            ow.node("MatMul", ["item", "wi"], ["ei"]),
+            ow.node("Mul", ["eu", "ei"], ["prod"]),
+            ow.node("ReduceMean", ["prod"], ["score"],
+                    axes=[1], keepdims=1),
+        ]
+        graph = b""
+        for nd in nodes:
+            graph += ow._ld(1, nd)
+        for name, arr in (("wu", wu), ("wi", wi)):
+            graph += ow._ld(5, ow.tensor(name, arr))
+        graph += ow._ld(11, ow._value_info("user", 1, ["N", 6]))
+        graph += ow._ld(11, ow._value_info("item", 1, ["N", 5]))
+        graph += ow._ld(12, ow._value_info("score", 1, ["N", 1]))
+        opset_b = ow._ld(1, b"") + ow._int_field(2, 17)
+        blob = ow._int_field(1, 8) + ow._ld(8, opset_b) + ow._ld(7, graph)
+        p = tmp_path / "tower.onnx"
+        p.write_bytes(blob)
+        return str(p), wu, wi
+
+    def test_two_tower_scores(self, tmp_path):
+        from mmlspark_tpu.core.table import DataTable
+        path, wu, wi = self._two_tower(tmp_path)
+        model = import_onnx_model(path, batch_size=4)
+        rng = np.random.default_rng(31)
+        u = rng.normal(size=(7, 6)).astype(np.float32)
+        it = rng.normal(size=(7, 5)).astype(np.float32)
+        out = np.asarray(model.transform(
+            DataTable({"user": u, "item": it}))["scores"])
+        ref = ((u @ wu) * (it @ wi)).mean(1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_feed_cols_override_and_save_load(self, tmp_path):
+        from mmlspark_tpu.core.serialize import load_stage
+        from mmlspark_tpu.core.table import DataTable
+        path, wu, wi = self._two_tower(tmp_path)
+        model = import_onnx_model(
+            path, feed_cols={"user": "u_feats", "item": "i_feats"})
+        rng = np.random.default_rng(32)
+        u = rng.normal(size=(3, 6)).astype(np.float32)
+        it = rng.normal(size=(3, 5)).astype(np.float32)
+        table = DataTable({"u_feats": u, "i_feats": it})
+        ref = np.asarray(model.transform(table)["scores"])
+        model.save(str(tmp_path / "stage"))
+        back = load_stage(str(tmp_path / "stage"))
+        np.testing.assert_array_equal(
+            np.asarray(back.transform(table)["scores"]), ref)
+
+    def test_mixed_elem_classes_rejected(self, tmp_path):
+        nodes = [ow.node("Gather", ["emb", "ids"], ["g"], axis=0),
+                 ow.node("Mul", ["g", "scale"], ["out"])]
+        graph = b""
+        for nd in nodes:
+            graph += ow._ld(1, nd)
+        graph += ow._ld(5, ow.tensor(
+            "emb", np.zeros((10, 4), np.float32)))
+        graph += ow._ld(11, ow._value_info("ids", 7, ["N"]))      # int64
+        graph += ow._ld(11, ow._value_info("scale", 1, ["N", 1]))  # f32
+        graph += ow._ld(12, ow._value_info("out", 1, ["N", 4]))
+        opset_b = ow._ld(1, b"") + ow._int_field(2, 17)
+        blob = ow._int_field(1, 8) + ow._ld(8, opset_b) + ow._ld(7, graph)
+        p = tmp_path / "mixed.onnx"
+        p.write_bytes(blob)
+        with pytest.raises(ValueError, match="element class"):
+            import_onnx_model(str(p))
+
+    def test_partial_shape_dict_still_infers(self, tmp_path):
+        """A partial {input: shape} dict pins the listed inputs and
+        still infers the rest from declared value infos."""
+        from mmlspark_tpu.core.table import DataTable
+        path, wu, wi = self._two_tower(tmp_path)
+        model = import_onnx_model(
+            path, input_shape={"user": (6,)})   # 'item' inferred (5,)
+        shp = model.get("modelFn").input_shape
+        assert shp == {"user": (6,), "item": (5,)}, shp
+
+    def test_feed_cols_typo_rejected(self, tmp_path):
+        path, _, _ = self._two_tower(tmp_path)
+        with pytest.raises(ValueError, match="usr"):
+            import_onnx_model(path, feed_cols={"usr": "u"})
