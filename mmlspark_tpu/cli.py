@@ -33,6 +33,7 @@ Commands::
     mmlspark-tpu run <spec> --data D --save M [--score-out P]
     mmlspark-tpu score --model M --data D --out P
     mmlspark-tpu serve --model M [--host H] [--port N]
+    mmlspark-tpu import-onnx model.onnx --out M
 """
 
 from __future__ import annotations
@@ -204,6 +205,29 @@ def cmd_score(args) -> int:
     return 0
 
 
+def cmd_import_onnx(args) -> int:
+    from mmlspark_tpu.importers.onnx_import import import_onnx_model
+    model = import_onnx_model(
+        args.onnx, batch_size=args.batch_size,
+        input_shape=json.loads(args.input_shape)
+        if args.input_shape else None)
+    model.save(args.out)
+    # summarize from the model just built — re-parsing the protobuf
+    # would decode every initializer a second time
+    apply_fn = model.get("modelFn")
+    ops: Dict[str, int] = {}
+    for node in apply_fn.nodes:
+        ops[node.op_type] = ops.get(node.op_type, 0) + 1
+    print(json.dumps({"saved": args.out, "ops": dict(sorted(ops.items())),
+                      "opset": apply_fn.opset,
+                      "inputs": apply_fn.input_names}))
+    print(f"model saved to {args.out} — score it with "
+          f"`mmlspark-tpu score --model {args.out} ...` or serve it "
+          f"with `mmlspark-tpu serve --model {args.out}`",
+          file=sys.stderr)
+    return 0
+
+
 def cmd_serve(args) -> int:
     from mmlspark_tpu.core.serialize import load_stage
     from mmlspark_tpu.serving.fleet import json_row_scoring_pipeline
@@ -264,6 +288,19 @@ def main(argv=None) -> int:
     p.add_argument("--data", required=True)
     p.add_argument("--out", required=True)
     p.set_defaults(fn=cmd_score)
+
+    p = sub.add_parser(
+        "import-onnx",
+        help="ONNX file -> saved TPUModel stage (then score/serve it)")
+    p.add_argument("onnx")
+    p.add_argument("--out", required=True,
+                   help="directory to save the imported model stage")
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--input-shape",
+                   help='JSON per-row shape, e.g. "[3,224,224]" or '
+                        '{"user": [6]} for multi-input graphs '
+                        '(default: inferred from the graph)')
+    p.set_defaults(fn=cmd_import_onnx)
 
     p = sub.add_parser("serve", help="HTTP-serve a saved model")
     p.add_argument("--model", required=True)

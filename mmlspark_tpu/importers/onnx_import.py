@@ -1150,6 +1150,12 @@ def import_onnx_model(path: str, batch_size: int = 64,
             raise ValueError(
                 f"feed_cols keys {unknown} are not graph inputs "
                 f"{graph.inputs}")
+    if isinstance(input_shape, dict):
+        unknown = sorted(set(input_shape) - set(graph.inputs))
+        if unknown:
+            raise ValueError(
+                f"input_shape keys {unknown} are not graph inputs "
+                f"{graph.inputs}")
     apply_fn = OnnxApply(graph, input_shape=input_shape)
 
     def _declared(name):

@@ -257,3 +257,37 @@ def test_cli_serve_scores_over_http(installed_venv, tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+def test_cli_import_onnx_then_score(installed_venv, tmp_path):
+    """ONNX file -> saved stage -> scored table, all through console
+    scripts from the installed wheel (zero Python written)."""
+    from tests import onnx_writer as ow
+    venv, _ = installed_venv
+    rng = np.random.default_rng(5)
+    w = rng.normal(scale=0.3, size=(4, 3)).astype(np.float32)
+    b = rng.normal(size=3).astype(np.float32)
+    nodes = [ow.node("Gemm", ["input", "w", "b"], ["output"],
+                     alpha=1.0, beta=1.0)]
+    onnx_path = tmp_path / "lin.onnx"
+    onnx_path.write_bytes(ow.model(
+        nodes, {"w": w, "b": b}, ("input", 1, ["N", 4]), "output"))
+
+    model_dir = tmp_path / "onnx_model"
+    r = _run_in_venv(venv, argv=[
+        "mmlspark-tpu", "import-onnx", str(onnx_path),
+        "--out", str(model_dir)], timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    info = json.loads(r.stdout.strip().splitlines()[0])
+    assert info["ops"] == {"Gemm": 1}
+
+    x = rng.normal(size=(6, 4)).astype(np.float32)
+    npz_path = tmp_path / "in.npz"   # vector columns ship as npz
+    np.savez(npz_path, images=x)
+    out_dir = tmp_path / "scored"
+    r = _run_in_venv(venv, argv=[
+        "mmlspark-tpu", "score", "--model", str(model_dir),
+        "--data", str(npz_path), "--out", str(out_dir)], timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    scored = np.load(out_dir / "columns.npz")["scores"]
+    np.testing.assert_allclose(scored, x @ w + b, rtol=1e-5, atol=1e-6)
