@@ -29,10 +29,12 @@ faithfully):
                 MaxPool, AveragePool, GlobalAveragePool, Flatten
   linear      : Gemm, MatMul
   recurrent   : LSTM, GRU (each forward / reverse / bidirectional)
-  activations : Sigmoid, Tanh, Softmax, LogSoftmax, LeakyRelu, Clip
-  elementwise : Add, Sub, Mul, Div, Neg, Exp, Sqrt, Pow
-  structure   : Concat, Transpose, Reshape, Squeeze, Unsqueeze, Slice,
-                Shape, Gather, Cast, Identity, Constant, ReduceMean
+  activations : Sigmoid, Tanh, Softmax, LogSoftmax, LeakyRelu, Clip,
+                Erf (the BERT-GELU building block)
+  elementwise : Add, Sub, Mul, Div, Neg, Exp, Sqrt, Pow, Where
+  structure   : Concat, Split, Transpose, Reshape, Squeeze, Unsqueeze,
+                Slice, Shape, Gather, Cast, Expand, Identity, Constant,
+                ReduceMean
 
 Opset-version semantics are honored where they differ: Squeeze /
 Unsqueeze axes move from attribute (opset <= 12) to input (>= 13),
@@ -371,6 +373,7 @@ SUPPORTED_OPS = {
     "Sub", "Mul", "Div", "Neg", "Exp", "Sqrt", "Pow",
     "Concat", "Transpose", "Squeeze", "Unsqueeze", "Slice", "Shape",
     "Gather", "Cast", "ReduceMean", "LSTM", "GRU",
+    "Erf", "Where", "Split", "Expand",
 }
 
 # inclusive default-domain opset envelope this importer implements
@@ -476,6 +479,11 @@ def _validate_node(node: OnnxNode, opset: int,
         raise ValueError(
             f"{lbl}: only tensor/float/int (scalar or list) constant "
             f"values are supported, got attributes {sorted(a)}")
+    if op == "Split" and opset >= 13 and "split" in a:
+        raise ValueError(
+            f"{lbl}: attribute-form split sizes inside an "
+            f"opset-{opset} graph (moved to an input at opset 13) — "
+            f"file is inconsistent")
     if op == "Concat" and "axis" not in a:
         raise ValueError(f"{lbl}: required attribute 'axis' missing")
     if op == "Cast":
@@ -620,6 +628,8 @@ _SHAPE_SLOTS = {
     "Unsqueeze": (1,),
     "Slice": (1, 2, 3, 4),
     "ReduceMean": (1,),
+    "Split": (1,),
+    "Expand": (1,),
 }
 
 _INT64_MAX = (1 << 63) - 1
@@ -824,6 +834,10 @@ class OnnxApply:
                 out = -x[0]
             elif op == "Exp":
                 out = jnp.exp(x[0])
+            elif op == "Erf":
+                out = lax.erf(x[0])
+            elif op == "Where":
+                out = jnp.where(x[0], x[1], x[2])
             elif op == "Sqrt":
                 out = jnp.sqrt(x[0])
             elif op == "Sigmoid":
@@ -920,6 +934,38 @@ class OnnxApply:
                         en_s = None
                     idx[ax % x[0].ndim] = slice(st_s, en_s, sp)
                 out = x[0][tuple(idx)]
+            elif op == "Split":
+                ax = int(a.get("axis", 0)) % x[0].ndim
+                sizes = (list(a["split"]) if "split" in a
+                         else self._static_ints(node, 1, x))
+                n_out = len([o for o in node.outputs if o])
+                if sizes is None:
+                    # even split; ONNX lets the LAST chunk be smaller
+                    # when the axis is not divisible (ceil-sized rest)
+                    n_out = int(a.get("num_outputs", n_out))
+                    dim = x[0].shape[ax]
+                    chunk = -(-dim // n_out)
+                    sizes = [chunk] * (dim // chunk)
+                    if dim % chunk:
+                        sizes.append(dim % chunk)
+                    if len(sizes) != n_out:
+                        raise ValueError(
+                            f"{_node_label(node)}: cannot split axis "
+                            f"of size {dim} into {n_out} outputs")
+                bounds = np.cumsum(sizes)[:-1].tolist()
+                out = tuple(jnp.split(x[0], bounds, axis=ax))
+            elif op == "Expand":
+                target = self._static_ints(node, 1, x)
+                # ONNX Expand: bidirectional broadcast; a target dim of
+                # 1 keeps the input's dim
+                shape = list(x[0].shape)
+                nd = max(len(target), len(shape))
+                shape = [1] * (nd - len(shape)) + shape
+                target = [1] * (nd - len(target)) + list(target)
+                final = [max(s_, int(t)) for s_, t in zip(shape, target)]
+                out = jnp.broadcast_to(
+                    x[0].reshape(shape) if len(shape) != x[0].ndim
+                    else x[0], final)
             elif op == "Shape":
                 # array shapes are static under jit — returning numpy
                 # keeps Shape->Gather->Concat->Reshape chains concrete.

@@ -46,7 +46,8 @@ def _float_field(field: int, v: float) -> bytes:
 
 
 _NP_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.float64): 11,
-               np.dtype(np.int64): 7, np.dtype(np.int32): 6}
+               np.dtype(np.int64): 7, np.dtype(np.int32): 6,
+               np.dtype(np.bool_): 9}
 
 
 def tensor(name: str, arr: np.ndarray, storage: str = "raw") -> bytes:

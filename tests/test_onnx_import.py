@@ -988,3 +988,185 @@ class TestMultiInput:
         path, _, _ = self._two_tower(tmp_path)
         with pytest.raises(ValueError, match="usr"):
             import_onnx_model(path, feed_cols={"usr": "u"})
+
+
+class TestTransformerBlockImport:
+    """A BERT-style encoder block from genuine ONNX bytes — exercises
+    the round-5 op set as real exporters compose it: LayerNorm as a
+    ReduceMean/Sub/Pow/Sqrt/Div chain, fused-QKV MatMul + Split,
+    batched attention MatMuls with a Where-masked Softmax, and the
+    erf-form GELU. Parity against an identically-parameterized torch
+    module."""
+
+    B, T, D, H = 2, 6, 16, 4
+
+    def test_block_matches_torch(self, tmp_path):
+        import torch
+        D, H, T = self.D, self.H, self.T
+        hd = D // H
+        rng = np.random.default_rng(40)
+
+        def w(shape, scale=0.25):
+            return rng.normal(scale=scale, size=shape).astype(np.float32)
+
+        inits = {
+            "ln_g": w((D,), 1.0) * 0 + 1.0, "ln_b": w((D,), 0.1),
+            "wqkv": w((D, 3 * D)), "bqkv": w((3 * D,), 0.05),
+            "wo": w((D, D)), "bo": w((D,), 0.05),
+            "ln2_g": w((D,), 1.0) * 0 + 1.0, "ln2_b": w((D,), 0.1),
+            "w1": w((D, 4 * D)), "b1": w((4 * D,), 0.05),
+            "w2": w((4 * D, D)), "b2": w((D,), 0.05),
+            "eps": np.asarray([1e-5], np.float32),
+            "half": np.asarray([0.5], np.float32),
+            "one": np.asarray([1.0], np.float32),
+            "sqrt2": np.asarray([np.sqrt(2.0)], np.float32),
+            "scale": np.asarray([1.0 / np.sqrt(hd)], np.float32),
+            "neg": np.asarray([-1e9], np.float32),
+            "mask": np.tril(np.ones((T, T), bool)),
+            "h_shape": np.asarray([0, 0, H, hd], np.int64),
+            "m_shape": np.asarray([0, 0, D], np.int64),
+            "two": np.asarray([2.0], np.float32),
+        }
+
+        def ln(x_in, g, b, prefix):
+            return [
+                ow.node("ReduceMean", [x_in], [f"{prefix}.mu"],
+                        axes=[-1], keepdims=1),
+                ow.node("Sub", [x_in, f"{prefix}.mu"], [f"{prefix}.c"]),
+                ow.node("Pow", [f"{prefix}.c", "two"], [f"{prefix}.c2"]),
+                ow.node("ReduceMean", [f"{prefix}.c2"], [f"{prefix}.v"],
+                        axes=[-1], keepdims=1),
+                ow.node("Add", [f"{prefix}.v", "eps"], [f"{prefix}.ve"]),
+                ow.node("Sqrt", [f"{prefix}.ve"], [f"{prefix}.sd"]),
+                ow.node("Div", [f"{prefix}.c", f"{prefix}.sd"],
+                        [f"{prefix}.n"]),
+                ow.node("Mul", [f"{prefix}.n", g], [f"{prefix}.ng"]),
+                ow.node("Add", [f"{prefix}.ng", b], [f"{prefix}.out"]),
+            ]
+
+        nodes = []
+        nodes += ln("x", "ln_g", "ln_b", "l1")
+        nodes += [
+            ow.node("MatMul", ["l1.out", "wqkv"], ["qkv0"]),
+            ow.node("Add", ["qkv0", "bqkv"], ["qkv"]),
+            ow.node("Split", ["qkv"], ["q", "k", "v"], axis=-1,
+                    num_outputs=3),
+        ]
+        for nm in ("q", "k", "v"):
+            nodes += [
+                ow.node("Reshape", [nm, "h_shape"], [f"{nm}h"]),
+                ow.node("Transpose", [f"{nm}h"], [f"{nm}t"],
+                        perm=[0, 2, 1, 3]),          # (B, H, T, hd)
+            ]
+        nodes += [
+            ow.node("Transpose", ["kt"], ["ktt"], perm=[0, 1, 3, 2]),
+            ow.node("MatMul", ["qt", "ktt"], ["sc0"]),
+            ow.node("Mul", ["sc0", "scale"], ["sc"]),
+            ow.node("Where", ["mask", "sc", "neg"], ["scm"]),
+            ow.node("Softmax", ["scm"], ["attn"], axis=-1),
+            ow.node("MatMul", ["attn", "vt"], ["ctx"]),
+            ow.node("Transpose", ["ctx"], ["ctxt"], perm=[0, 2, 1, 3]),
+            ow.node("Reshape", ["ctxt", "m_shape"], ["ctxm"]),
+            ow.node("MatMul", ["ctxm", "wo"], ["proj0"]),
+            ow.node("Add", ["proj0", "bo"], ["proj"]),
+            ow.node("Add", ["x", "proj"], ["res1"]),
+        ]
+        nodes += ln("res1", "ln2_g", "ln2_b", "l2")
+        nodes += [
+            ow.node("MatMul", ["l2.out", "w1"], ["m0"]),
+            ow.node("Add", ["m0", "b1"], ["m1"]),
+            # erf-form GELU: 0.5 * x * (1 + erf(x / sqrt(2)))
+            ow.node("Div", ["m1", "sqrt2"], ["g0"]),
+            ow.node("Erf", ["g0"], ["g1"]),
+            ow.node("Add", ["g1", "one"], ["g2"]),
+            ow.node("Mul", ["m1", "g2"], ["g3"]),
+            ow.node("Mul", ["g3", "half"], ["gelu"]),
+            ow.node("MatMul", ["gelu", "w2"], ["m2"]),
+            ow.node("Add", ["m2", "b2"], ["m3"]),
+            ow.node("Add", ["res1", "m3"], ["out"]),
+        ]
+        graph = b"".join(ow._ld(1, nd) for nd in nodes)
+        for name, arr in inits.items():
+            graph += ow._ld(5, ow.tensor(name, arr))
+        graph += ow._ld(11, ow._value_info("x", 1, ["N", T, D]))
+        graph += ow._ld(12, ow._value_info("out", 1, ["N", T, D]))
+        blob = (ow._int_field(1, 8)
+                + ow._ld(8, ow._ld(1, b"") + ow._int_field(2, 17))
+                + ow._ld(7, graph))
+        p = tmp_path / "block.onnx"
+        p.write_bytes(blob)
+
+        # torch twin with the SAME math
+        def torch_ref(x):
+            t = {k: torch.from_numpy(np.asarray(v))
+                 for k, v in inits.items()}
+            h = torch.nn.functional.layer_norm(
+                x, (D,), t["ln_g"], t["ln_b"], eps=1e-5)
+            qkv = h @ t["wqkv"] + t["bqkv"]
+            q, k, v = qkv.split(D, dim=-1)
+            def heads(z):
+                return z.reshape(self.B, T, H, hd).permute(0, 2, 1, 3)
+            q, k, v = heads(q), heads(k), heads(v)
+            sc = (q @ k.transpose(-1, -2)) / np.sqrt(hd)
+            sc = sc.masked_fill(~t["mask"], -1e9)
+            ctx = torch.softmax(sc, dim=-1) @ v
+            ctx = ctx.permute(0, 2, 1, 3).reshape(self.B, T, D)
+            x = x + ctx @ t["wo"] + t["bo"]
+            h2 = torch.nn.functional.layer_norm(
+                x, (D,), t["ln2_g"], t["ln2_b"], eps=1e-5)
+            m = h2 @ t["w1"] + t["b1"]
+            m = torch.nn.functional.gelu(m)      # erf-form by default
+            return x + m @ t["w2"] + t["b2"]
+
+        x = rng.normal(size=(self.B, T, D)).astype(np.float32)
+        with torch.no_grad():
+            ref = torch_ref(torch.from_numpy(x)).numpy()
+        graph_p = load_onnx(str(p))
+        out = np.asarray(OnnxApply(graph_p)(
+            {k: np.asarray(v) for k, v in graph_p.initializers.items()},
+            {"x": x}))
+        np.testing.assert_allclose(out, ref, rtol=2e-4, atol=2e-5)
+
+    def test_split_sizes_input_form(self, tmp_path):
+        x = np.arange(24, dtype=np.float32).reshape(2, 12)
+        nodes = [ow.node("Split", ["input", "sizes"],
+                         ["a", "b", "c"], axis=1),
+                 ow.node("Concat", ["c", "b", "a"], ["output"], axis=1)]
+        inits = {"sizes": np.asarray([3, 4, 5], np.int64)}
+        p = tmp_path / "sp.onnx"
+        p.write_bytes(ow.model(nodes, inits, "input", "output",
+                               int_data_names=("sizes",)))
+        graph = load_onnx(str(p))
+        out = np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"input": x}))
+        ref = np.concatenate([x[:, 7:], x[:, 3:7], x[:, :3]], axis=1)
+        np.testing.assert_allclose(out, ref)
+
+    def test_expand_broadcast(self, tmp_path):
+        x = np.arange(3, dtype=np.float32).reshape(3, 1)
+        nodes = [ow.node("Expand", ["input", "shape"], ["output"])]
+        inits = {"shape": np.asarray([2, 3, 4], np.int64)}
+        p = tmp_path / "ex.onnx"
+        p.write_bytes(ow.model(nodes, inits, "input", "output",
+                               int_data_names=("shape",)))
+        graph = load_onnx(str(p))
+        out = np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"input": x}))
+        assert out.shape == (2, 3, 4)
+        np.testing.assert_allclose(out, np.broadcast_to(x, (2, 3, 4)))
+
+    def test_split_uneven_num_outputs(self, tmp_path):
+        """ONNX spec: with num_outputs on a non-divisible axis, chunks
+        are ceil-sized with a smaller last one ([4,4,2] for 10/3)."""
+        x = np.arange(20, dtype=np.float32).reshape(2, 10)
+        nodes = [ow.node("Split", ["input"], ["a", "b", "c"], axis=1,
+                         num_outputs=3),
+                 ow.node("Concat", ["c", "a", "b"], ["output"], axis=1)]
+        p = tmp_path / "spu.onnx"
+        p.write_bytes(ow.model(nodes, {}, "input", "output", opset=18))
+        graph = load_onnx(str(p))
+        out = np.asarray(OnnxApply(graph)({}, {"input": x}))
+        ref = np.concatenate([x[:, 8:], x[:, :4], x[:, 4:8]], axis=1)
+        np.testing.assert_allclose(out, ref)
