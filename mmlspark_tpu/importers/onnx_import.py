@@ -31,10 +31,12 @@ faithfully):
   recurrent   : LSTM, GRU (each forward / reverse / bidirectional)
   activations : Sigmoid, Tanh, Softmax, LogSoftmax, LeakyRelu, Clip,
                 Erf (the BERT-GELU building block)
-  elementwise : Add, Sub, Mul, Div, Neg, Exp, Sqrt, Pow, Where
+  elementwise : Add, Sub, Mul, Div, Neg, Exp, Sqrt, Pow, Where,
+                Min, Max (variadic)
   structure   : Concat, Split, Transpose, Reshape, Squeeze, Unsqueeze,
                 Slice, Shape, Gather, Cast, Expand, Identity, Constant,
-                ReduceMean
+                ReduceMean, ReduceSum, ReduceMax, ReduceMin,
+                ArgMax, ArgMin
 
 Opset-version semantics are honored where they differ: Squeeze /
 Unsqueeze axes move from attribute (opset <= 12) to input (>= 13),
@@ -374,6 +376,8 @@ SUPPORTED_OPS = {
     "Concat", "Transpose", "Squeeze", "Unsqueeze", "Slice", "Shape",
     "Gather", "Cast", "ReduceMean", "LSTM", "GRU",
     "Erf", "Where", "Split", "Expand",
+    "Min", "Max", "ReduceSum", "ReduceMax", "ReduceMin",
+    "ArgMax", "ArgMin",
 }
 
 # inclusive default-domain opset envelope this importer implements
@@ -524,11 +528,17 @@ def _validate_node(node: OnnxNode, opset: int,
             len(node.inputs) < 2 or not node.inputs[1]):
         raise ValueError(
             f"{lbl}: required 'axes' input missing (opset >= 13)")
-    if op == "ReduceMean" and opset >= 18 and "axes" in a:
+    axes_input_opset = {"ReduceSum": 13, "ReduceMean": 18,
+                        "ReduceMax": 18, "ReduceMin": 18}
+    if op in axes_input_opset and opset >= axes_input_opset[op] \
+            and "axes" in a:
         raise ValueError(
             f"{lbl}: attribute-form axes inside an opset-{opset} graph "
-            f"(axes moved to an input at opset 18) — file is "
-            f"inconsistent")
+            f"(axes moved to an input at opset "
+            f"{axes_input_opset[op]}) — file is inconsistent")
+    if op in ("ArgMax", "ArgMin") and a.get("select_last_index", 0):
+        raise ValueError(
+            f"{lbl}: select_last_index=1 is not supported")
     if op == "Reshape" and a.get("allowzero", 0):
         raise ValueError(
             f"{lbl}: allowzero=1 is not supported (0 always means "
@@ -628,6 +638,9 @@ _SHAPE_SLOTS = {
     "Unsqueeze": (1,),
     "Slice": (1, 2, 3, 4),
     "ReduceMean": (1,),
+    "ReduceSum": (1,),
+    "ReduceMax": (1,),
+    "ReduceMin": (1,),
     "Split": (1,),
     "Expand": (1,),
 }
@@ -990,18 +1003,35 @@ class OnnxApply:
             elif op == "Cast":
                 out = _lib_for(x[0]).asarray(x[0]).astype(
                     _TENSOR_DTYPES[a["to"]])
-            elif op == "ReduceMean":
-                # axes: attribute through opset 17, input from opset 18
-                axes = (a.get("axes") if self.opset < 18
+            elif op in ("ReduceMean", "ReduceSum", "ReduceMax",
+                        "ReduceMin"):
+                # axes: attribute in old opsets, input once moved
+                # (ReduceSum at 13, the others at 18)
+                moved = 13 if op == "ReduceSum" else 18
+                axes = (a.get("axes") if self.opset < moved
                         else self._static_ints(node, 1, x))
                 keep = bool(a.get("keepdims", 1))
-                if not axes and self.opset >= 18 and \
+                if not axes and self.opset >= moved and \
                         a.get("noop_with_empty_axes", 0):
                     out = x[0]
                 else:
-                    out = jnp.mean(
-                        x[0], axis=tuple(axes) if axes else None,
-                        keepdims=keep)
+                    fn = {"ReduceMean": jnp.mean, "ReduceSum": jnp.sum,
+                          "ReduceMax": jnp.max,
+                          "ReduceMin": jnp.min}[op]
+                    out = fn(x[0], axis=tuple(axes) if axes else None,
+                             keepdims=keep)
+            elif op in ("Min", "Max"):
+                fn = jnp.minimum if op == "Min" else jnp.maximum
+                out = x[0]
+                for t in x[1:]:
+                    out = fn(out, t)
+            elif op in ("ArgMax", "ArgMin"):
+                fn = jnp.argmax if op == "ArgMax" else jnp.argmin
+                ax = int(a.get("axis", 0))
+                out = fn(x[0], axis=ax)
+                if int(a.get("keepdims", 1)):
+                    out = jnp.expand_dims(out, ax)
+                out = out.astype(jnp.int32)
             elif op == "Identity":
                 out = x[0]
             elif op == "Constant":

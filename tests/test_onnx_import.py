@@ -1170,3 +1170,84 @@ class TestTransformerBlockImport:
         out = np.asarray(OnnxApply(graph)({}, {"input": x}))
         ref = np.concatenate([x[:, 8:], x[:, :4], x[:, 4:8]], axis=1)
         np.testing.assert_allclose(out, ref)
+
+
+class TestReduceAndArg:
+    """ReduceSum/Max/Min (opset-split axes forms), variadic Min/Max,
+    ArgMax/ArgMin — the classifier-tail and pooling ops."""
+
+    def _run(self, tmp_path, nodes, inits, x, opset=17, int_names=()):
+        p = tmp_path / "r.onnx"
+        p.write_bytes(ow.model(nodes, inits, "input", "output",
+                               opset=opset, int_data_names=int_names))
+        graph = load_onnx(str(p))
+        return np.asarray(OnnxApply(graph)(
+            {k: np.asarray(v) for k, v in graph.initializers.items()},
+            {"input": x}))
+
+    def test_reduce_sum_axes_input_opset13(self, tmp_path):
+        x = np.random.default_rng(50).normal(size=(2, 3, 4)
+                                             ).astype(np.float32)
+        nodes = [ow.node("ReduceSum", ["input", "ax"], ["output"],
+                         keepdims=0)]
+        out = self._run(tmp_path, nodes,
+                        {"ax": np.asarray([1], np.int64)}, x,
+                        opset=13, int_names=("ax",))
+        np.testing.assert_allclose(out, x.sum(1), rtol=1e-5, atol=1e-6)
+
+    def test_reduce_max_min_attr_form(self, tmp_path):
+        x = np.random.default_rng(51).normal(size=(3, 5)
+                                             ).astype(np.float32)
+        nodes = [ow.node("ReduceMax", ["input"], ["mx"],
+                         axes=[1], keepdims=1),
+                 ow.node("ReduceMin", ["input"], ["mn"],
+                         axes=[1], keepdims=1),
+                 ow.node("Sub", ["mx", "mn"], ["output"])]
+        out = self._run(tmp_path, nodes, {}, x)
+        ref = x.max(1, keepdims=True) - x.min(1, keepdims=True)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_variadic_min_max(self, tmp_path):
+        rng = np.random.default_rng(52)
+        x = rng.normal(size=(4,)).astype(np.float32)
+        b = rng.normal(size=(4,)).astype(np.float32)
+        c = rng.normal(size=(4,)).astype(np.float32)
+        nodes = [ow.node("Max", ["input", "b", "c"], ["hi"]),
+                 ow.node("Min", ["input", "b", "c"], ["lo"]),
+                 ow.node("Sub", ["hi", "lo"], ["output"])]
+        out = self._run(tmp_path, nodes, {"b": b, "c": c}, x)
+        ref = np.maximum(np.maximum(x, b), c) - \
+            np.minimum(np.minimum(x, b), c)
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_argmax_classifier_tail(self, tmp_path):
+        """The common export ending: logits -> ArgMax class ids."""
+        from mmlspark_tpu.core.table import DataTable
+        rng = np.random.default_rng(53)
+        w = rng.normal(scale=0.3, size=(6, 4)).astype(np.float32)
+        nodes = [ow.node("MatMul", ["input", "w"], ["logits"]),
+                 ow.node("ArgMax", ["logits"], ["output"],
+                         axis=-1, keepdims=0)]
+        p = tmp_path / "clf.onnx"
+        p.write_bytes(ow.model(nodes, {"w": w},
+                               ("input", 1, ["N", 6]), "output"))
+        model = import_onnx_model(str(p), batch_size=4)
+        x = rng.normal(size=(9, 6)).astype(np.float32)
+        out = np.asarray(model.transform(
+            DataTable({"images": x}))["scores"])
+        np.testing.assert_array_equal(out, (x @ w).argmax(-1))
+
+    def test_argmax_select_last_index_rejected(self, tmp_path):
+        nodes = [ow.node("ArgMax", ["input"], ["output"],
+                         select_last_index=1)]
+        p = tmp_path / "bad.onnx"
+        p.write_bytes(ow.model(nodes, {}, "input", "output"))
+        with pytest.raises(ValueError, match="select_last_index"):
+            load_onnx(str(p))
+
+    def test_reduce_sum_attr_in_new_opset_rejected(self, tmp_path):
+        nodes = [ow.node("ReduceSum", ["input"], ["output"], axes=[0])]
+        p = tmp_path / "rs.onnx"
+        p.write_bytes(ow.model(nodes, {}, "input", "output", opset=13))
+        with pytest.raises(ValueError, match="opset 13"):
+            load_onnx(str(p))
