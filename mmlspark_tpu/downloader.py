@@ -19,7 +19,6 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from mmlspark_tpu.core.logging_utils import get_logger
@@ -79,19 +78,16 @@ def retry_with_backoff(fn, times: int = 3, base_delay: float = 0.5,
     """ref: FaultToleranceUtils.retryWithTimeout
     (ModelDownloader.scala:37-50). Exception types in ``no_retry``
     re-raise immediately — deterministic failures (4xx client errors)
-    must not burn the backoff budget."""
-    last: Optional[Exception] = None
-    for i in range(times):
-        try:
-            return fn()
-        except no_retry:
-            raise
-        except Exception as e:  # noqa: BLE001 — intentional broad retry
-            last = e
-            log.warning("attempt %d/%d failed: %s", i + 1, times, e)
-            if i < times - 1:
-                time.sleep(base_delay * (2 ** i))
-    raise last  # type: ignore[misc]
+    must not burn the backoff budget.
+
+    Back-compat shim over the unified ``utils.resilience.RetryPolicy``
+    (exponential backoff + full jitter)."""
+    from mmlspark_tpu.utils.resilience import RetryPolicy
+    if not isinstance(no_retry, tuple):      # bare class, like `except`
+        no_retry = (no_retry,)
+    return RetryPolicy(max_attempts=times, base_delay=base_delay,
+                       no_retry=no_retry,
+                       name="downloader").call(fn)
 
 
 class LocalRepo:

@@ -23,7 +23,8 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from mmlspark_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mmlspark_tpu.gbdt.binning import BinMapper

@@ -14,7 +14,6 @@ error-split → output parser → flatten.
 from __future__ import annotations
 
 import json
-import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
@@ -33,6 +32,7 @@ from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.io.minibatch import (
     FixedMiniBatchTransformer, FlattenBatch, HasMiniBatcher,
 )
+from mmlspark_tpu.utils.resilience import Deadline, RetryPolicy
 
 log = get_logger("io.http")
 
@@ -107,18 +107,30 @@ def send_request(req: Dict[str, Any], timeout: float) -> Dict[str, Any]:
         return HTTPSchema.response(0, f"{type(e).__name__}: {e}", None)
 
 
-def advanced_handler(req: Dict[str, Any], timeout: float, retries: List[int]
-                     ) -> Dict[str, Any]:
+def retryable_response(resp: Optional[Dict[str, Any]]) -> bool:
+    """Only 429, 5xx, and connection errors (statusCode 0) may burn the
+    backoff budget; other 4xx/3xx are deterministic and fail fast."""
+    if resp is None:
+        return False
+    code = resp["statusLine"]["statusCode"]
+    return code == 0 or code == 429 or code >= 500
+
+
+def advanced_handler(req: Dict[str, Any], timeout: float, retries: List[int],
+                     deadline: Optional["Deadline"] = None) -> Dict[str, Any]:
     """Retry-with-backoff on 429/5xx/connection errors
-    (ref: HTTPClients.scala:47 HandlingUtils.advancedHandling)."""
-    resp = send_request(req, timeout)
-    for backoff_ms in retries:
-        code = resp["statusLine"]["statusCode"]
-        if 200 <= code < 300 or (300 <= code < 500 and code != 429):
-            return resp
-        time.sleep(backoff_ms / 1000.0)
-        resp = send_request(req, timeout)
-    return resp
+    (ref: HTTPClients.scala:47 HandlingUtils.advancedHandling).
+
+    ``retries`` is the backoff schedule in MILLISECONDS; each gap gets
+    full jitter (delay ~ U[0, entry]) via the unified RetryPolicy so
+    synchronized client retries decorrelate. Non-retryable client errors
+    (4xx bar 429) return immediately without sleeping. ``deadline``
+    optionally caps the whole call (attempts + backoffs)."""
+    policy = RetryPolicy(schedule=[ms / 1000.0 for ms in retries],
+                         name="io.http")
+    return policy.call(lambda: send_request(req, timeout),
+                       retry_result=retryable_response,
+                       deadline=deadline)
 
 
 class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):

@@ -190,6 +190,18 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False
 # static under a traced ring schedule.)
 
 
+def _hop_dispatch(full, branch):
+    """Non-causal hop: every hop is fully visible, so no branching is
+    needed — except on legacy jax, whose SPMD lowering of a pallas_call
+    inlined straight into the ring's fori_loop body emits an
+    unpartitionable PartitionId. There, route through a (degenerate)
+    real lax.switch exactly like the causal path, which lowers fine."""
+    from mmlspark_tpu.utils.jax_compat import LEGACY_SHARD_MAP
+    if LEGACY_SHARD_MAP:
+        return lax.switch(jnp.clip(branch * 0, 0, 1), (full, full), None)
+    return full(None)
+
+
 def _hop_forward(q, k_cur, v_cur, branch, causal, interpret):
     """One ring hop -> (out_i f32 (B,Lq,H,D), lse_i f32 (BH,Lqp,1))."""
     from mmlspark_tpu.ops.flash_attention import _flash_forward, _lse_pad
@@ -209,7 +221,7 @@ def _hop_forward(q, k_cur, v_cur, branch, causal, interpret):
                          jnp.float32))
 
     if not causal:
-        return full(None)
+        return _hop_dispatch(full, branch)
     return lax.switch(branch, (full, diag, masked), None)
 
 
@@ -235,7 +247,7 @@ def _hop_backward(q, k_cur, v_cur, out, lse, g, branch, causal, interpret):
                 jnp.zeros(v_cur.shape, jnp.float32))
 
     if not causal:
-        return full(None)
+        return _hop_dispatch(full, branch)
     return lax.switch(branch, (full, diag, masked), None)
 
 
@@ -390,7 +402,7 @@ def seq_parallel_apply(module, variables, tokens, mesh, axis: str = "seq"):
     The compiled program is cached per (module, mesh, axis), so repeated
     calls hit the jit cache."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mmlspark_tpu.utils.jax_compat import shard_map
 
     key = (module, mesh, axis)
     run = _SP_APPLY_CACHE.get(key)
@@ -428,7 +440,7 @@ def make_seq_parallel_train_step(module, mesh, optimizer,
     """
     import optax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mmlspark_tpu.utils.jax_compat import shard_map
 
     axes = (data_axis, seq_axis)
 
@@ -472,7 +484,7 @@ def make_seq_parallel_attention(mesh, kind: str = "ring",
     loops instead call ring_attention directly inside their own
     shard_map."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mmlspark_tpu.utils.jax_compat import shard_map
 
     fn = ring_attention if kind == "ring" else ulysses_attention
 

@@ -6,12 +6,14 @@ PartitionConsolidator.scala).
 """
 
 from mmlspark_tpu.serving.fleet import (
-    PartitionConsolidator, ServingFleet, json_scoring_pipeline,
+    PartitionConsolidator, ServingFleet, ServingUnavailable,
+    json_row_scoring_pipeline, json_scoring_pipeline,
 )
 from mmlspark_tpu.serving.server import (
     HTTPSource, ServingEngine, SharedSingleton, SharedVariable, serve_model,
 )
 
 __all__ = ["HTTPSource", "PartitionConsolidator", "ServingEngine",
-           "ServingFleet", "SharedSingleton", "SharedVariable",
+           "ServingFleet", "ServingUnavailable", "SharedSingleton",
+           "SharedVariable", "json_row_scoring_pipeline",
            "json_scoring_pipeline", "serve_model"]

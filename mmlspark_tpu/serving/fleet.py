@@ -22,8 +22,15 @@ from __future__ import annotations
 
 import itertools
 import json
+import threading
+import urllib.error
 import urllib.request
-from typing import Any, Dict, List
+from collections import deque
+from concurrent.futures import (
+    FIRST_COMPLETED, Future, TimeoutError as _FutureTimeout,
+    wait as _futures_wait,
+)
+from typing import Any, Dict, List, Optional
 
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.params import IntParam
@@ -31,8 +38,24 @@ from mmlspark_tpu.core.schema import Schema
 from mmlspark_tpu.core.stage import Transformer
 from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.serving.server import HTTPSource, ServingEngine
+from mmlspark_tpu.utils.resilience import CircuitBreaker
 
 log = get_logger("serving.fleet")
+
+
+class ServingUnavailable(RuntimeError):
+    """Every candidate engine failed at the transport level (or was
+    skipped by an open circuit). ``attempts`` is the per-engine log:
+    ``[{"engine": i, "address": ..., "error": ..., "skipped": bool}]`` —
+    the typed replacement for leaking raw urllib errors to callers."""
+
+    def __init__(self, attempts: List[Dict[str, Any]]):
+        self.attempts = list(attempts)
+        detail = "; ".join(
+            f"{a['address']}: {a['error']}" for a in self.attempts)
+        super().__init__(
+            f"no serving engine available after "
+            f"{len(self.attempts)} attempt(s): {detail or 'none tried'}")
 
 
 def json_scoring_pipeline(model, field: str = "features",
@@ -89,21 +112,52 @@ def json_row_scoring_pipeline(pipeline, reply_col: str = "prediction"):
     return Lambda.apply(handle)
 
 
+# engine-reported statuses worth failing over for: overload/shedding
+# (503 + Retry-After), serving timeout (504), gateway-ish 502, and 429.
+# Anything else 4xx/5xx is the REQUEST's problem (poison row -> 500) and
+# must surface to the caller unchanged — retrying it on another replica
+# would just poison that one too.
+_FAILOVER_CODES = frozenset({429, 502, 503, 504})
+
+
 class ServingFleet:
     """N serving engines over one pipeline — one per host in a real
     deployment, N ports on one host in simulation/tests. Replies always
     flow through the engine that accepted the request (the reference's
-    reply-routing invariant, DistributedHTTPSource.scala:188-192)."""
+    reply-routing invariant, DistributedHTTPSource.scala:188-192).
+
+    The client side (``post``) is a resilient stand-in for an external
+    load balancer: round-robin with a per-engine ``CircuitBreaker`` (a
+    dead or shedding engine stops receiving traffic after
+    ``failure_threshold`` failures until ``breaker_cooldown`` elapses),
+    failover of idempotent scoring requests onto the next replica, and
+    optional request hedging (Dean & Barroso, *The Tail at Scale*): when
+    ``hedge_percentile`` is set, a request still unanswered after that
+    latency percentile fires a duplicate on another replica and the first
+    reply wins."""
 
     def __init__(self, pipeline, n_engines: int = 2,
                  host: str = "127.0.0.1", base_port: int = 18700,
                  batch_size: int = 64, reply_col: str = "reply",
-                 workers: int = 1):
+                 workers: int = 1,
+                 failure_threshold: int = 3,
+                 breaker_cooldown: float = 2.0,
+                 hedge_percentile: Optional[float] = None,
+                 hedge_min_s: float = 0.02,
+                 max_parked: Optional[int] = None):
         self.engines: List[ServingEngine] = []
+        self.transport_errors = 0
+        self.hedged_requests = 0
+        self._stats_lock = threading.Lock()
+        self.hedge_percentile = hedge_percentile
+        self.hedge_min_s = hedge_min_s
+        self._latencies: "deque[float]" = deque(maxlen=256)
+        self._probe_lock = threading.Lock()   # single-flight all-open probe
         port = base_port
         try:
             for _ in range(n_engines):
-                source = HTTPSource(host=host, port=port)
+                source = HTTPSource(host=host, port=port,
+                                    max_parked=max_parked)
                 port = source.port + 1      # skip whatever port-scan used
                 try:
                     engine = ServingEngine(source, pipeline,
@@ -121,22 +175,271 @@ class ServingFleet:
         # itertools.count: next() is atomic under the GIL, so
         # concurrent client threads can't tear the round-robin
         self._next = itertools.count()
+        self.breakers: List[CircuitBreaker] = [
+            CircuitBreaker(failure_threshold=failure_threshold,
+                           cooldown=breaker_cooldown,
+                           name=f"engine{i}@{e.source.address}")
+            for i, e in enumerate(self.engines)]
         log.info("fleet of %d engines: %s", n_engines, self.addresses)
 
     @property
     def addresses(self) -> List[str]:
         return [e.source.address for e in self.engines]
 
-    def post(self, payload: Any, timeout: float = 30.0) -> Dict[str, Any]:
-        """Round-robin client — the stand-in for an external load
-        balancer in tests/examples."""
-        addr = self.addresses[next(self._next) % len(self.engines)]
-        body = payload if isinstance(payload, bytes) \
-            else json.dumps(payload).encode()
+    # -- transport ---------------------------------------------------------
+
+    @staticmethod
+    def _http_post(addr: str, body: bytes,
+                   timeout: float) -> Dict[str, Any]:
+        import time as _time
         req = urllib.request.Request(
             addr, data=body, headers={"Content-Type": "application/json"})
+        t0 = _time.perf_counter()
         with urllib.request.urlopen(req, timeout=timeout) as r:
-            return json.loads(r.read())
+            return {"body": json.loads(r.read()),
+                    "latency": _time.perf_counter() - t0}
+
+    @staticmethod
+    def _submit(fn, *args) -> "Future":
+        """Run ``fn`` on a fresh DAEMON thread, returning a Future.
+        Deliberately not a ThreadPoolExecutor: its non-daemon workers
+        are joined by the atexit hook, so an abandoned hedge leg stuck
+        against a stalled engine would block interpreter exit for its
+        whole transport timeout; daemon threads also can't starve each
+        other the way a fixed-size pool full of zombie legs can."""
+        fut: "Future" = Future()
+
+        def run():
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001 — future protocol
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True,
+                         name="fleet-hedge").start()
+        return fut
+
+    def _hedge_threshold(self) -> Optional[float]:
+        if self.hedge_percentile is None:
+            return None
+        with self._stats_lock:
+            if len(self._latencies) < 16:
+                return None
+            lat = sorted(self._latencies)
+        idx = min(len(lat) - 1,
+                  int(self.hedge_percentile / 100.0 * len(lat)))
+        return max(self.hedge_min_s, lat[idx])
+
+    def _record_latency(self, dt: float) -> None:
+        with self._stats_lock:
+            self._latencies.append(dt)
+
+    def _classify_and_record(self, breaker: CircuitBreaker,
+                             err: Optional[BaseException]) -> None:
+        """Breaker bookkeeping for one transport outcome: success, or an
+        app-level HTTP status (engine alive and answering — e.g. a
+        poison row's 500), counts as healthy; failover statuses and
+        transport failures count against the engine."""
+        if err is None or (isinstance(err, urllib.error.HTTPError)
+                           and err.code not in _FAILOVER_CODES):
+            breaker.record_success()
+        else:
+            breaker.record_failure()
+
+    def _attempt(self, i: int, body: bytes, timeout: float, tried: set,
+                 allow_hedge: bool) -> Dict[str, Any]:
+        """One logical attempt against engine ``i``, hedged onto another
+        replica if allowed and the reply is slower than the hedge
+        threshold. ALL breaker recording happens here — for a hedged
+        primary the outcome is recorded when its leg actually finishes
+        (a stalled primary must still open its circuit even though the
+        hedge rescued the request). Raises the (winning) transport
+        error on failure."""
+        breaker = self.breakers[i]
+        addr = self.addresses[i]
+        threshold = self._hedge_threshold() if allow_hedge else None
+        if threshold is None or threshold >= timeout:
+            try:
+                result = self._http_post(addr, body, timeout)
+            except Exception as e:
+                self._classify_and_record(breaker, e)
+                raise
+            self._classify_and_record(breaker, None)
+            return result
+        import time as _time
+        start = _time.monotonic()
+        f1 = self._submit(self._http_post, addr, body, timeout)
+        f1.add_done_callback(
+            lambda f: self._classify_and_record(breaker, f.exception()))
+        try:
+            return f1.result(timeout=threshold)
+        except _FutureTimeout:
+            pass                       # slow — fire the hedge
+        # allow() (not a bare state check) so a half-open replica's
+        # probe budget also gates hedge traffic — a barely-recovered
+        # engine must not get a thundering herd of hedges
+        j = next((k for k in range(len(self.engines))
+                  if k != i and k not in tried
+                  and self.breakers[k].allow()),
+                 None)
+        if j is None:
+            return f1.result(
+                timeout=max(0.001, start + timeout - _time.monotonic()))
+        with self._stats_lock:
+            self.hedged_requests += 1
+        tried.add(j)   # the hedge consumed replica j for this request
+        f2 = self._submit(self._http_post, self.addresses[j], body,
+                          timeout)
+        f2.add_done_callback(
+            lambda f: self._classify_and_record(self.breakers[j],
+                                                f.exception()))
+        pending = {f1, f2}
+        first_error: Optional[BaseException] = None
+        while pending:
+            remaining = start + timeout - _time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"hedged request to {addr} timed out after {timeout}s")
+            done, pending = _futures_wait(
+                pending, timeout=remaining, return_when=FIRST_COMPLETED)
+            if not done:
+                raise TimeoutError(
+                    f"hedged request to {addr} timed out after {timeout}s")
+            for f in done:
+                err = f.exception()
+                if err is None:
+                    return f.result()
+                first_error = first_error or err
+        raise first_error  # both legs failed — surface the primary's
+
+    # -- the client --------------------------------------------------------
+
+    def post(self, payload: Any, timeout: float = 30.0,
+             idempotent: bool = True) -> Dict[str, Any]:
+        """Failover-aware round-robin client — the stand-in for an
+        external load balancer in tests/examples.
+
+        Engines whose circuit is open are skipped; transport failures
+        and overload statuses (429/502/503/504) fail over to the next
+        replica when ``idempotent`` (scoring requests are). When every
+        candidate fails, raises ``ServingUnavailable`` carrying the
+        per-engine attempt log. Application-level HTTP errors (e.g. a
+        poison row's 500) propagate unchanged."""
+        body = payload if isinstance(payload, bytes) \
+            else json.dumps(payload).encode()
+        n = len(self.engines)
+        start = next(self._next)
+        order = [(start + k) % n for k in range(n)]
+        max_tries = n if idempotent else 1
+        attempts: List[Dict[str, Any]] = []
+        tried: set = set()
+        for i in order:
+            if len(tried) >= max_tries:
+                break
+            if i in tried:
+                continue   # already consumed as a hedge leg
+            breaker = self.breakers[i]
+            if not breaker.allow():
+                attempts.append({"engine": i, "address": self.addresses[i],
+                                 "error": "circuit open", "skipped": True})
+                continue
+            tried.add(i)
+            try:
+                # _attempt owns ALL breaker recording (incl. hedge legs)
+                result = self._attempt(i, body, timeout, tried,
+                                       allow_hedge=idempotent)
+            except urllib.error.HTTPError as e:
+                if e.code in _FAILOVER_CODES:
+                    attempts.append(
+                        {"engine": i, "address": self.addresses[i],
+                         "error": f"HTTP {e.code}", "skipped": False})
+                    continue
+                # app-level error: the engine is alive and answering —
+                # the request itself is at fault. Surface it unchanged.
+                raise
+            except Exception as e:  # noqa: BLE001 — URLError/timeout/...
+                with self._stats_lock:
+                    self.transport_errors += 1
+                attempts.append(
+                    {"engine": i, "address": self.addresses[i],
+                     "error": f"{type(e).__name__}: {e}", "skipped": False})
+                continue
+            self._record_latency(result["latency"])
+            return result["body"]
+        if not tried and order:
+            # every circuit open: last-resort probe of the round-robin
+            # head so the fleet can rediscover a recovered engine even
+            # before the breaker cooldown elapses. SINGLE-FLIGHT: only
+            # one caller at a time pays the probe's timeout against a
+            # possibly-stalled engine; everyone else fails fast — the
+            # whole point of an open circuit during a total outage.
+            if not self._probe_lock.acquire(blocking=False):
+                attempts.append(
+                    {"engine": order[0], "address": self.addresses[order[0]],
+                     "error": "circuit open (probe in flight)",
+                     "skipped": True})
+                raise ServingUnavailable(attempts)
+            try:
+                return self._probe(order[0], body, timeout, attempts)
+            finally:
+                self._probe_lock.release()
+        raise ServingUnavailable(attempts)
+
+    def _probe(self, i: int, body: bytes, timeout: float,
+               attempts: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """The all-circuits-open last-resort probe of engine ``i``."""
+        try:
+            result = self._http_post(self.addresses[i], body, timeout)
+        except urllib.error.HTTPError as e:
+            if e.code not in _FAILOVER_CODES:
+                # engine alive and answering: the post() contract —
+                # app-level errors (a poison row's 500) propagate
+                # unchanged — holds on the probe path too, and an
+                # answering engine force-closes its breaker
+                self.breakers[i].reset()
+                raise
+            self.breakers[i].record_failure()
+            attempts.append(
+                {"engine": i, "address": self.addresses[i],
+                 "error": f"HTTP {e.code}", "skipped": False})
+            raise ServingUnavailable(attempts) from e
+        except Exception as e:  # noqa: BLE001 — URLError/timeout/...
+            with self._stats_lock:
+                self.transport_errors += 1
+            attempts.append(
+                {"engine": i, "address": self.addresses[i],
+                 "error": f"{type(e).__name__}: {e}", "skipped": False})
+            raise ServingUnavailable(attempts) from e
+        # a real scored reply while OPEN: force the breaker closed
+        self.breakers[i].reset()
+        self._record_latency(result["latency"])
+        return result["body"]
+
+    # -- observability -----------------------------------------------------
+
+    def health(self, timeout: float = 2.0) -> List[Dict[str, Any]]:
+        """Poll every engine's /healthz; unreachable engines report
+        ``{"reachable": False, "error": ...}``."""
+        out = []
+        for e in self.engines:
+            url = f"{e.source.address}/healthz"
+            try:
+                with urllib.request.urlopen(url, timeout=timeout) as r:
+                    out.append({"reachable": True,
+                                **json.loads(r.read())})
+            except urllib.error.HTTPError as err:
+                try:
+                    out.append({"reachable": True,
+                                **json.loads(err.read())})
+                except Exception:  # noqa: BLE001
+                    out.append({"reachable": True,
+                                "status": f"HTTP {err.code}"})
+            except Exception as err:  # noqa: BLE001
+                out.append({"reachable": False,
+                            "error": f"{type(err).__name__}: {err}"})
+        return out
 
     def counters(self) -> Dict[str, int]:
         return {
@@ -145,7 +448,18 @@ class ServingFleet:
                             for e in self.engines),
             "answered": sum(e.source.requests_answered
                             for e in self.engines),
+            "rejected": sum(e.source.requests_rejected
+                            for e in self.engines),
+            "transport_errors": self.transport_errors,
+            "hedged": self.hedged_requests,
+            "workers_restarted": sum(e.workers_restarted
+                                     for e in self.engines),
         }
+
+    def kill_engine(self, index: int, close_source: bool = True) -> None:
+        """Chaos hook: crash (or stall, with ``close_source=False``) one
+        engine mid-load; the breaker + failover path must absorb it."""
+        self.engines[index].kill(close_source=close_source)
 
     def stop_all(self) -> None:
         for e in self.engines:

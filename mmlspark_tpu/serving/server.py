@@ -98,17 +98,71 @@ class HTTPSource:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8899,
                  api_path: str = "/", max_queue: int = 10_000,
-                 reply_timeout: float = 60.0, port_scan: int = 20):
+                 reply_timeout: float = 60.0, port_scan: int = 20,
+                 max_parked: Optional[int] = None,
+                 retry_after_s: int = 1):
         self.api_path = api_path
         self.queue: "queue.Queue[_ParkedRequest]" = queue.Queue(max_queue)
         self.requests_seen = 0
         self.requests_accepted = 0
         self.requests_answered = 0
+        self.requests_rejected = 0
+        # the parked-request table is BOUNDED: a stalled engine must shed
+        # load with 503 + Retry-After, not hold thousands of connections
+        # hostage until reply_timeout (the load-shedding half of the
+        # Tail-at-Scale story). Default bound = the queue bound.
+        self.max_parked = max_parked if max_parked is not None else max_queue
+        self.retry_after_s = max(1, int(retry_after_s))
+        # set by ServingEngine.start(): () -> bool engine liveness; the
+        # /healthz endpoint folds it into its verdict
+        self.health_probe: Optional[Callable[[], bool]] = None
         self._pending: Dict[str, _ParkedRequest] = {}
         self._lock = threading.Lock()
         source = self
 
         class Handler(BaseHTTPRequestHandler):
+            def _send_json(self, code: int, payload: Dict[str, Any],
+                           headers: Optional[Dict[str, str]] = None):
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _shed(self, reason: str):
+                with source._lock:
+                    source.requests_rejected += 1
+                self._send_json(
+                    503, {"error": reason,
+                          "retry_after": source.retry_after_s},
+                    {"Retry-After": str(source.retry_after_s)})
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path_only = self.path.split("?", 1)[0].rstrip("/")
+                if path_only != "/healthz":
+                    self.send_error(404, f"unknown path {path_only}")
+                    return
+                healthy = True
+                if source.health_probe is not None:
+                    try:
+                        healthy = bool(source.health_probe())
+                    except Exception:  # noqa: BLE001 — probe crash = sick
+                        healthy = False
+                with source._lock:
+                    stats = {
+                        "status": "ok" if healthy else "unhealthy",
+                        "seen": source.requests_seen,
+                        "accepted": source.requests_accepted,
+                        "answered": source.requests_answered,
+                        "rejected": source.requests_rejected,
+                        "parked": len(source._pending),
+                        "queue_depth": source.queue.qsize(),
+                    }
+                self._send_json(200 if healthy else 503, stats)
+
             def do_POST(self):  # noqa: N802 (http.server API)
                 with source._lock:
                     source.requests_seen += 1
@@ -124,7 +178,14 @@ class HTTPSource:
                     {k: v for k, v in self.headers.items()})
                 parked = _ParkedRequest(uuid_lib.uuid4().hex, req)
                 with source._lock:
-                    source._pending[parked.id] = parked
+                    if len(source._pending) >= source.max_parked:
+                        shed = True
+                    else:
+                        source._pending[parked.id] = parked
+                        shed = False
+                if shed:
+                    self._shed("parked-request table full")
+                    return
                 try:
                     source.queue.put_nowait(parked)
                     with source._lock:
@@ -132,7 +193,7 @@ class HTTPSource:
                 except queue.Full:
                     with source._lock:
                         source._pending.pop(parked.id, None)
-                    self.send_error(503, "queue full")
+                    self._shed("queue full")
                     return
                 resp = parked.wait(reply_timeout)
                 with source._lock:
@@ -244,8 +305,12 @@ class ServingEngine:
         # is only if it locks)
         self.workers = max(1, int(workers))
         self._stop = threading.Event()
+        self._killed = threading.Event()   # chaos kill: no restart
         self._threads: List[threading.Thread] = []
+        self._threads_lock = threading.Lock()
+        self._supervisor: Optional[threading.Thread] = None
         self.batches_processed = 0
+        self.workers_restarted = 0
         self._stats_lock = threading.Lock()
 
     def _respond_ok(self, rid: str, rep: Any) -> None:
@@ -317,27 +382,78 @@ class ServingEngine:
                 self.source.respond(rid, HTTPSchema.response(
                     500, f"pipeline error: {e}", None))
 
+    def _worker_loop(self):
+        while not self._stop.is_set():
+            try:
+                n = self.process_one_batch()
+            except Exception as e:  # noqa: BLE001 — keep serving
+                log.error("serving loop error (continuing): %s", e)
+                n = 0
+            if n == 0:
+                time.sleep(0.005)
+
+    def _spawn_worker(self) -> threading.Thread:
+        t = threading.Thread(target=self._worker_loop, daemon=True)
+        t.start()
+        return t
+
+    def _supervise(self, interval: float = 0.1):
+        """Liveness watchdog: a worker thread that dies (a BaseException
+        like SystemExit escaping the loop's Exception guard) is detected
+        and respawned, so one crashed drainer can't silently halve — or
+        zero — the engine's throughput. Chaos kills (``kill()``) and
+        normal ``stop()`` suppress restarts."""
+        while not self._stop.wait(interval):
+            with self._threads_lock:
+                for i, t in enumerate(self._threads):
+                    if t.is_alive() or self._stop.is_set():
+                        continue
+                    log.error("serving worker died; restarting")
+                    self._threads[i] = self._spawn_worker()
+                    with self._stats_lock:
+                        self.workers_restarted += 1
+
+    def is_alive(self) -> bool:
+        """Engine liveness for /healthz: not killed and at least one
+        drainer thread running."""
+        if self._killed.is_set() or self._stop.is_set():
+            return False
+        with self._threads_lock:
+            return any(t.is_alive() for t in self._threads)
+
     def start(self) -> "ServingEngine":
-        def loop():
-            while not self._stop.is_set():
-                try:
-                    n = self.process_one_batch()
-                except Exception as e:  # noqa: BLE001 — keep serving
-                    log.error("serving loop error (continuing): %s", e)
-                    n = 0
-                if n == 0:
-                    time.sleep(0.005)
-        for _ in range(self.workers):
-            t = threading.Thread(target=loop, daemon=True)
-            t.start()
-            self._threads.append(t)
+        with self._threads_lock:
+            self._threads = [self._spawn_worker()
+                             for _ in range(self.workers)]
+        self._supervisor = threading.Thread(target=self._supervise,
+                                            daemon=True)
+        self._supervisor.start()
+        self.source.health_probe = self.is_alive
         return self
+
+    def kill(self, close_source: bool = True) -> None:
+        """Chaos hook: simulate a crashed engine — workers exit and are
+        NOT restarted. ``close_source=True`` also drops the listener
+        (clients see connection-refused, the crashed-process shape);
+        ``close_source=False`` keeps accepting but never replies (the
+        stalled-engine shape: parked requests run out their timeout)."""
+        self._killed.set()
+        self._stop.set()
+        if close_source:
+            self.source.close()
 
     def stop(self) -> None:
         self._stop.set()
-        for t in self._threads:
+        if self._supervisor is not None:
+            self._supervisor.join(timeout=5)
+        with self._threads_lock:
+            threads = list(self._threads)
+        for t in threads:
             t.join(timeout=5)
-        self.source.close()
+        try:
+            self.source.close()
+        except Exception:  # noqa: BLE001 — already closed by kill()
+            pass
 
 
 def serve_model(pipeline: Transformer, host: str = "127.0.0.1",

@@ -8,7 +8,6 @@ input order while at most ``concurrency`` tasks are in flight.
 
 from __future__ import annotations
 
-import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
@@ -47,16 +46,14 @@ def retry_with_backoff(fn: Callable[[], U],
                        ) -> U:
     """ref: downloader FaultToleranceUtils.retryWithTimeout
     (ModelDownloader.scala:37-50) and HTTP retry
-    (HTTPClients.scala:47-97)."""
-    delay = initial_delay
-    for attempt in range(retries + 1):
-        try:
-            return fn()
-        except exceptions as e:
-            if attempt == retries:
-                raise
-            if on_retry:
-                on_retry(e, attempt)
-            time.sleep(delay)
-            delay *= backoff
-    raise RuntimeError("unreachable")
+    (HTTPClients.scala:47-97).
+
+    Back-compat shim over the unified ``utils.resilience.RetryPolicy``
+    (``retries`` is the number of RE-tries, so ``retries + 1`` total
+    attempts; exceptions outside ``exceptions`` propagate immediately)."""
+    from mmlspark_tpu.utils.resilience import RetryPolicy
+    if not isinstance(exceptions, tuple):    # bare class, like `except`
+        exceptions = (exceptions,)
+    return RetryPolicy(max_attempts=retries + 1, base_delay=initial_delay,
+                       multiplier=backoff, retry_on=exceptions,
+                       name="async_utils").call(fn, on_retry=on_retry)

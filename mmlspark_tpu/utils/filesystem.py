@@ -86,12 +86,18 @@ class HTTPFileSystem(FileSystem):
         self.timeout = timeout
 
     def _fetch(self, url: str) -> bytes:
-        from mmlspark_tpu.downloader import retry_with_backoff
-
         def once() -> bytes:
-            with urllib.request.urlopen(url, timeout=self.timeout) as r:
-                return r.read()
-        return retry_with_backoff(once, times=self.retries)
+            try:
+                with urllib.request.urlopen(url, timeout=self.timeout) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                # 4xx (bar 429) is deterministic — a missing object will
+                # still be missing after the backoff; don't burn budget
+                if 400 <= e.code < 500 and e.code != 429:
+                    raise _NoRetry(e) from e
+                raise
+
+        return _call_with_retry(once, self.retries, "http_fs")
 
     def read_bytes(self, path: str) -> bytes:
         return self._fetch(path)
@@ -131,6 +137,19 @@ class _NoRetry(Exception):
         self.error = error
 
 
+def _call_with_retry(once, retries: int, name: str):
+    """Shared retry wrapper for the HTTP/WebDAV verbs: run ``once``
+    (which wraps its own deterministic failures in ``_NoRetry``) under
+    the unified RetryPolicy, unwrapping fast-fail errors back to the
+    original exception."""
+    from mmlspark_tpu.utils.resilience import RetryPolicy
+    try:
+        return RetryPolicy(max_attempts=max(1, retries),
+                           no_retry=(_NoRetry,), name=name).call(once)
+    except _NoRetry as e:
+        raise e.error
+
+
 class WebDAVFileSystem(HTTPFileSystem):
     """WRITABLE HTTP backend — WebDAV verbs over plain stdlib urllib
     (the role the reference's HDFS/wasb layer plays for staging training
@@ -167,7 +186,6 @@ class WebDAVFileSystem(HTTPFileSystem):
         """One verb against a FINAL (already-encoded) http URL, retried
         with backoff on transient errors like the read/write paths (4xx
         client errors don't retry — they are deterministic)."""
-        from mmlspark_tpu.downloader import retry_with_backoff
 
         def once() -> bytes:
             req = urllib.request.Request(
@@ -183,12 +201,8 @@ class WebDAVFileSystem(HTTPFileSystem):
                     raise _NoRetry(e) from e
                 raise
 
-        try:
-            return retry_with_backoff(
-                once, times=self.retries if retry else 1,
-                no_retry=(_NoRetry,))
-        except _NoRetry as e:
-            raise e.error
+        return _call_with_retry(once, self.retries if retry else 1,
+                                "webdav")
 
     def read_bytes(self, path: str) -> bytes:
         return self._fetch(self._http_url(path))

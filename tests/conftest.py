@@ -18,8 +18,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from mmlspark_tpu.utils.jax_compat import set_cpu_device_count  # noqa: E402
+
+set_cpu_device_count(8)
 
 import pytest  # noqa: E402
 

@@ -14,13 +14,14 @@ import sys
 
 import jax
 
-# CPU backend with 2 virtual devices per process, configured before any
-# backend use (env vars don't work here — sitecustomize pins the platform)
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+# CPU backend with 2 virtual devices per process, configured before any
+# backend use (env vars don't work here — sitecustomize pins the platform)
+from mmlspark_tpu.utils.jax_compat import set_cpu_device_count  # noqa: E402
+
+set_cpu_device_count(2)
 
 
 def main() -> None:
@@ -28,7 +29,8 @@ def main() -> None:
 
     import numpy as np
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
+    from mmlspark_tpu.utils.jax_compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from mmlspark_tpu.core.table import DataTable

@@ -17,6 +17,10 @@ import pytest
 from mmlspark_tpu.gbdt import train
 from mmlspark_tpu.testing.benchmarks import BenchmarkComparer
 
+# minutes of single-core training per case: excluded from the
+# tier-1 wall budget, run via the full suite / -m slow
+pytestmark = pytest.mark.slow
+
 HERE = os.path.dirname(__file__)
 CLF_CSV = os.path.join(HERE, "resources", "benchmarks_classifier.csv")
 REG_CSV = os.path.join(HERE, "resources", "benchmarks_regressor.csv")

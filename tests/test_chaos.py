@@ -1,0 +1,361 @@
+"""Chaos-injection suite: the fleet's availability properties under
+injected faults (Basiri et al., *Chaos Engineering* — verify the
+property by injecting the faults that threaten it; Dean & Barroso,
+*The Tail at Scale* — failover + circuit breaking bound the damage a
+dead or stalled replica can do).
+
+All faults are seeded and deterministic (see
+mmlspark_tpu/testing/chaos.py); nothing here depends on wall-clock
+beyond generous upper bounds, so the suite runs in tier-1 under the
+``chaos`` marker.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu.serving import ServingFleet, ServingUnavailable
+from mmlspark_tpu.serving.server import serve_model
+from mmlspark_tpu.stages.basic import Lambda
+from mmlspark_tpu.testing.chaos import ChaosError, FaultInjector
+from mmlspark_tpu.utils.resilience import CircuitBreaker
+
+pytestmark = pytest.mark.chaos
+
+
+def echo_pipeline():
+    def handle(table):
+        return table.with_column("reply", [
+            {"echo": json.loads(r["entity"].decode())["x"]}
+            for r in table["request"]])
+    return Lambda.apply(handle)
+
+
+def _post(addr, payload, timeout=5.0):
+    req = urllib.request.Request(
+        addr, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestFaultInjectorDeterminism:
+    def test_same_seed_same_decisions(self):
+        a = FaultInjector(seed=42, error_rate=0.3, drop_rate=0.2)
+        b = FaultInjector(seed=42, error_rate=0.3, drop_rate=0.2)
+        keys = [json.dumps({"x": i}).encode() for i in range(200)]
+        assert [a.decide("error", k) for k in keys] == \
+               [b.decide("error", k) for k in keys]
+        assert [a.decide("drop", k) for k in keys] == \
+               [b.decide("drop", k) for k in keys]
+        # the rate is actually realized (hash uniformity sanity)
+        frac = sum(a.decide("error", k) for k in keys) / len(keys)
+        assert 0.15 < frac < 0.45
+
+    def test_different_seed_different_decisions(self):
+        keys = [json.dumps({"x": i}).encode() for i in range(200)]
+        a = FaultInjector(seed=1, error_rate=0.3)
+        b = FaultInjector(seed=2, error_rate=0.3)
+        assert [a.decide("error", k) for k in keys] != \
+               [b.decide("error", k) for k in keys]
+
+    def test_decisions_independent_of_batching(self):
+        # the same request key gets the same fate no matter how the
+        # engine batched it — the property that makes poison-row
+        # isolation deterministic under retry
+        inj = FaultInjector(seed=7, error_rate=0.5)
+        k = json.dumps({"x": 3}).encode()
+        assert len({inj.decide("error", k) for _ in range(10)}) == 1
+
+
+class TestInjectedFaults:
+    def test_injected_errors_500_only_the_poisoned_rows(self):
+        inj = FaultInjector(seed=11, error_rate=0.3)
+        engine = serve_model(inj.wrap(echo_pipeline()), port=19400,
+                             batch_size=8)
+        try:
+            results = {}
+            for i in range(20):
+                payload = {"x": i}
+                try:
+                    results[i] = _post(engine.source.address, payload)[1]
+                except urllib.error.HTTPError as e:
+                    results[i] = e.code
+            expect_poison = {
+                i for i in range(20)
+                if inj.decide("error", json.dumps({"x": i}).encode())}
+            assert expect_poison, "seed 11 should poison some of 0..19"
+            assert expect_poison != set(range(20))
+            for i in range(20):
+                if i in expect_poison:
+                    assert results[i] == 500, (i, results[i])
+                else:
+                    assert results[i] == {"echo": i}, (i, results[i])
+            assert inj.injected_errors > 0
+        finally:
+            engine.stop()
+
+    def test_injected_drops_500_only_the_dropped_rows(self):
+        inj = FaultInjector(seed=5, drop_rate=0.3)
+        engine = serve_model(inj.wrap(echo_pipeline()), port=19410,
+                             batch_size=8)
+        try:
+            dropped, ok = 0, 0
+            for i in range(20):
+                expect_drop = inj.decide(
+                    "drop", json.dumps({"x": i}).encode())
+                try:
+                    status, body = _post(engine.source.address, {"x": i})
+                    assert not expect_drop and body == {"echo": i}
+                    ok += 1
+                except urllib.error.HTTPError as e:
+                    assert expect_drop and e.code == 500
+                    dropped += 1
+            assert dropped > 0 and ok > 0
+            assert inj.injected_drops == dropped
+        finally:
+            engine.stop()
+
+    def test_injected_latency_slows_the_batch(self):
+        inj = FaultInjector(seed=3, latency_s=0.3, latency_rate=1.0)
+        engine = serve_model(inj.wrap(echo_pipeline()), port=19420,
+                             batch_size=8)
+        try:
+            t0 = time.perf_counter()
+            status, body = _post(engine.source.address, {"x": 1})
+            dt = time.perf_counter() - t0
+            assert status == 200 and body == {"echo": 1}
+            assert dt >= 0.3
+            assert inj.injected_latency_rows >= 1
+        finally:
+            engine.stop()
+
+    @pytest.mark.filterwarnings(
+        "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+    def test_worker_kill_supervisor_restarts_and_recovers(self):
+        inj = FaultInjector(seed=1)
+        engine = serve_model(inj.wrap(echo_pipeline()), port=19430,
+                             batch_size=4)
+        try:
+            assert _post(engine.source.address, {"x": 0})[1] == {"echo": 0}
+            inj.arm_worker_kill(1)
+            # this request's worker dies mid-batch; the client times out
+            with pytest.raises(Exception):
+                _post(engine.source.address, {"x": 1}, timeout=1.0)
+            deadline = time.time() + 5
+            while engine.workers_restarted == 0 and time.time() < deadline:
+                time.sleep(0.05)
+            assert engine.workers_restarted >= 1
+            assert engine.is_alive()
+            # service recovered: the restarted worker drains new requests
+            assert _post(engine.source.address, {"x": 2})[1] == {"echo": 2}
+            assert inj.worker_kills_fired == 1
+        finally:
+            engine.stop()
+
+
+class TestFleetAvailability:
+    def test_99pct_availability_with_engine_killed_mid_load(self):
+        """The acceptance drill: 1 of 3 engines hard-killed mid-load
+        under concurrent clients — >=99% of all requests (in-flight and
+        subsequent) succeed via circuit-breaking failover."""
+        fleet = ServingFleet(echo_pipeline(), n_engines=3,
+                             base_port=19500, batch_size=8, workers=1,
+                             failure_threshold=2, breaker_cooldown=30.0)
+        n_clients, per_client = 6, 30
+        kill_after = 30            # requests completed before the kill
+        results = {}
+        completed = threading.Event()
+        count_lock = threading.Lock()
+        done_count = [0]
+
+        def client(cid):
+            for j in range(per_client):
+                key = cid * per_client + j
+                try:
+                    body = fleet.post({"x": key}, timeout=5.0)
+                    results[key] = (body == {"echo": key})
+                except Exception:  # noqa: BLE001 — availability metric
+                    results[key] = False
+                with count_lock:
+                    done_count[0] += 1
+                    if done_count[0] >= kill_after:
+                        completed.set()
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(n_clients)]
+            for t in threads:
+                t.start()
+            assert completed.wait(timeout=30)
+            FaultInjector.kill_engine(fleet, 1)     # mid-load crash
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+        finally:
+            fleet.stop_all()
+        total = n_clients * per_client
+        successes = sum(results.values())
+        assert len(results) == total
+        assert successes / total >= 0.99, (
+            f"availability {successes}/{total} under 1-of-3 engine kill")
+        # the dead engine's circuit opened: failures stopped burning time
+        assert fleet.breakers[1].state == CircuitBreaker.OPEN
+        c = fleet.counters()
+        assert c["transport_errors"] >= 1
+
+    def test_stalled_engine_bounded_timeout_waits(self):
+        """A STALLED engine (accepts, never replies) is the expensive
+        failure: clients burn their full timeout against it. The circuit
+        must open after ``failure_threshold`` timeouts, and no single
+        request may wait out the client timeout against the dead engine
+        more than once (its failover attempt answers)."""
+        client_timeout = 1.0
+        fleet = ServingFleet(echo_pipeline(), n_engines=3,
+                             base_port=19520, batch_size=8,
+                             failure_threshold=2, breaker_cooldown=60.0)
+        durations = []
+        try:
+            for i in range(5):      # warm + deterministic rotation
+                assert fleet.post({"x": i})["echo"] == i
+            FaultInjector.stall_engine(fleet, 0)
+            for i in range(30):
+                t0 = time.perf_counter()
+                body = fleet.post({"x": 100 + i}, timeout=client_timeout)
+                durations.append(time.perf_counter() - t0)
+                assert body == {"echo": 100 + i}
+        finally:
+            fleet.stop_all()
+        # every request succeeded; none paid the stall timeout twice
+        assert max(durations) < 2 * client_timeout
+        # once the circuit opened (<= threshold timeout-burns), the
+        # stalled engine stopped costing anyone anything
+        slow = [d for d in durations if d > 0.9 * client_timeout]
+        assert len(slow) <= 2, (
+            f"{len(slow)} requests burned a timeout on the stalled "
+            f"engine; circuit should have opened after 2")
+        assert fleet.breakers[0].state == CircuitBreaker.OPEN
+
+    def test_shedding_503_retry_after_then_recovery(self):
+        """Overfill the bounded parked-request table: extra load is shed
+        with 503 + Retry-After instead of queuing unboundedly, and the
+        engine returns to normal service once drained."""
+        gate = threading.Event()
+
+        def gated(table):
+            gate.wait(10)
+            return table.with_column(
+                "reply", [{"ok": 1} for _ in table["request"]])
+
+        fleet = ServingFleet(Lambda.apply(gated), n_engines=1,
+                             base_port=19540, batch_size=1,
+                             max_parked=3)
+        addr = fleet.addresses[0]
+        codes, retry_afters = [], []
+        lock = threading.Lock()
+
+        def raw_post():
+            req = urllib.request.Request(
+                addr, data=b'{"x": 0}',
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=15) as r:
+                    with lock:
+                        codes.append(r.status)
+            except urllib.error.HTTPError as e:
+                with lock:
+                    codes.append(e.code)
+                    retry_afters.append(e.headers.get("Retry-After"))
+
+        try:
+            threads = [threading.Thread(target=raw_post)
+                       for _ in range(10)]
+            for t in threads:
+                t.start()
+            deadline = time.time() + 5
+            while time.time() < deadline and \
+                    fleet.engines[0].source.requests_rejected == 0:
+                time.sleep(0.02)
+            gate.set()              # drain
+            for t in threads:
+                t.join(timeout=30)
+            shed = [c for c in codes if c == 503]
+            served = [c for c in codes if c == 200]
+            assert shed, f"expected shedding, got {codes}"
+            assert served, f"expected some service, got {codes}"
+            assert all(ra is not None and int(ra) >= 1
+                       for ra in retry_afters)
+            assert fleet.counters()["rejected"] == len(shed)
+            # recovery: drained engine serves normally again
+            status, body = _post(addr, {"x": 1})
+            assert status == 200 and body == {"ok": 1}
+        finally:
+            fleet.stop_all()
+
+    def test_hedged_request_beats_slow_replica(self):
+        """Tail-at-Scale hedging: when one replica turns slow, a hedge
+        fired after the observed latency percentile answers from a fast
+        replica well before the slow one would."""
+        inj = FaultInjector(seed=9, latency_s=1.5, latency_rate=1.0)
+        fleet = ServingFleet(echo_pipeline(), n_engines=2,
+                             base_port=19560, batch_size=8,
+                             hedge_percentile=95, hedge_min_s=0.05)
+        try:
+            for i in range(20):     # prime the latency window (fast)
+                assert fleet.post({"x": i})["echo"] == i
+            # engine 0 turns slow (still alive, still answers — just
+            # pathologically late)
+            fleet.engines[0].pipeline = inj.wrap(echo_pipeline())
+            t_slow = []
+            for i in range(6):
+                t0 = time.perf_counter()
+                assert fleet.post({"x": 100 + i}, timeout=10.0)[
+                    "echo"] == 100 + i
+                t_slow.append(time.perf_counter() - t0)
+            assert fleet.hedged_requests >= 1
+            # every request beat the 1.5s injected latency via its hedge
+            assert max(t_slow) < 1.4, t_slow
+        finally:
+            fleet.stop_all()
+
+    def test_all_engines_down_raises_typed_error(self):
+        fleet = ServingFleet(echo_pipeline(), n_engines=2,
+                             base_port=19580, batch_size=4,
+                             failure_threshold=1, breaker_cooldown=30.0)
+        try:
+            assert fleet.post({"x": 1})["echo"] == 1
+            FaultInjector.kill_engine(fleet, 0)
+            FaultInjector.kill_engine(fleet, 1)
+            with pytest.raises(ServingUnavailable) as ei:
+                fleet.post({"x": 2}, timeout=2.0)
+            # the attempt log names every engine tried
+            assert len(ei.value.attempts) >= 1
+            assert all("address" in a and "error" in a
+                       for a in ei.value.attempts)
+            # subsequent calls fail FAST (circuits open -> last-resort
+            # probe against one engine, not a full sweep)
+            t0 = time.perf_counter()
+            with pytest.raises(ServingUnavailable):
+                fleet.post({"x": 3}, timeout=2.0)
+            assert time.perf_counter() - t0 < 2.0
+            c = fleet.counters()
+            assert c["transport_errors"] >= 2
+        finally:
+            fleet.stop_all()
+
+
+class TestChaosWrapperUnit:
+    def test_wrap_raises_chaos_error_for_poisoned_batch(self):
+        from mmlspark_tpu.core.table import DataTable
+        from mmlspark_tpu.io.http import HTTPSchema
+        inj = FaultInjector(seed=13, error_rate=1.0)
+        wrapped = inj.wrap(echo_pipeline())
+        table = DataTable({
+            "id": ["a"],
+            "request": [HTTPSchema.request("/", "POST", b'{"x": 1}')]})
+        with pytest.raises(ChaosError):
+            wrapped.transform(table)

@@ -696,7 +696,7 @@ class TestVotingParallel:
         appears in no psum."""
         import re
         import jax
-        from jax import shard_map
+        from mmlspark_tpu.utils.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
         from mmlspark_tpu.gbdt.tree import GrowParams, grow_tree
 

@@ -122,6 +122,68 @@ class TestServing:
         finally:
             engine.stop()
 
+    def test_poison_rows_isolated_multi_worker(self):
+        # satellite coverage: poison isolation must hold when workers>1
+        # drains the queue from several loop threads concurrently —
+        # interleaved poison and healthy rows across racing micro-batches,
+        # and healthy batchmates NEVER receive a 500
+        def handle(table):
+            replies = []
+            for req in table["request"]:
+                body = json.loads(req["entity"].decode())
+                if body.get("boom"):
+                    raise RuntimeError("poison row")
+                replies.append({"ok": body["x"]})
+            return table.with_column("reply", replies)
+
+        engine = serve_model(Lambda.apply(handle), port=19050,
+                             batch_size=4, workers=3)
+        try:
+            results: dict = {}
+            poison = {i for i in range(24) if i % 4 == 0}
+
+            def client(i):
+                payload = {"boom": True, "x": i} if i in poison \
+                    else {"x": i}
+                try:
+                    results[i] = _post(engine.source.address, payload,
+                                       timeout=30)[1]
+                except urllib.error.HTTPError as e:
+                    results[i] = e.code
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(24)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i in range(24):
+                if i in poison:
+                    assert results[i] == 500, (i, results[i])
+                else:
+                    assert results[i] == {"ok": i}, (i, results[i])
+        finally:
+            engine.stop()
+
+    def test_healthz_endpoint(self, echo_server):
+        # GET /healthz: liveness + counters without touching the scoring
+        # path (the failover probe target)
+        url = f"{echo_server.source.address}/healthz"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = json.loads(r.read())
+        assert r.status == 200
+        assert body["status"] == "ok"
+        for key in ("seen", "accepted", "answered", "rejected",
+                    "parked", "queue_depth"):
+            assert key in body, body
+        # non-healthz GETs are 404, POST routing unaffected
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{echo_server.source.address}/other", timeout=5)
+        assert ei.value.code == 404
+        assert _post(echo_server.source.address, {"x": 2})[1] == \
+            {"doubled": 4}
+
     def test_error_col_splits_rows(self):
         # pipelines can flag per-row failures via an 'error' column
         # instead of raising (the errorCol convention of the reference)
@@ -363,6 +425,7 @@ class TestServingThroughput:
     real-chip number; this guards the machinery from regressing into
     per-request recompiles or serialized batching on any backend)."""
 
+    @pytest.mark.slow   # wall-clock floor: meaningless on a contended host
     def test_fleet_qps_floor(self):
         import concurrent.futures
         import time as _time

@@ -18,6 +18,12 @@ Calibration (idle 1-core CI host, CPU backend):
 import time
 
 import numpy as np
+import pytest
+
+# wall-clock floors are only meaningful on a host matching the
+# calibration (native lib built, current jax); weak/legacy CI
+# images run them via the full suite, not tier-1
+pytestmark = pytest.mark.slow
 
 from mmlspark_tpu.core.table import DataTable
 

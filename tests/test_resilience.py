@@ -1,0 +1,493 @@
+"""Unified resilience layer tests: RetryPolicy / CircuitBreaker /
+Deadline semantics, the back-compat shims that route every legacy retry
+entry point through them, the advanced_handler 4xx fast-fail + jitter
+regression, and the grep guard that keeps ad-hoc sleep-loop retries from
+reappearing outside utils/resilience.py.
+"""
+
+import http.server
+import json
+import os
+import random
+import threading
+import urllib.error
+
+import pytest
+
+from mmlspark_tpu.io.http import HTTPSchema, advanced_handler, send_request
+from mmlspark_tpu.utils.resilience import (
+    CircuitBreaker, CircuitOpenError, Deadline, DeadlineExceeded,
+    RetryPolicy,
+)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise IOError("transient")
+            return "ok"
+
+        slept = []
+        policy = RetryPolicy(max_attempts=4, base_delay=0.1,
+                             rng=random.Random(0))
+        assert policy.call(flaky, sleep=slept.append) == "ok"
+        assert len(calls) == 3 and len(slept) == 2
+
+    def test_raises_last_error_when_exhausted(self):
+        policy = RetryPolicy(max_attempts=3, base_delay=0.0)
+        with pytest.raises(ValueError, match="always"):
+            policy.call(lambda: (_ for _ in ()).throw(ValueError("always")),
+                        sleep=lambda s: None)
+
+    def test_no_retry_classification_fails_fast(self):
+        calls = []
+
+        class Fatal(Exception):
+            pass
+
+        def fatal():
+            calls.append(1)
+            raise Fatal("deterministic")
+
+        policy = RetryPolicy(max_attempts=5, no_retry=(Fatal,))
+        with pytest.raises(Fatal):
+            policy.call(fatal, sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_unlisted_exceptions_propagate_immediately(self):
+        calls = []
+
+        def typeerr():
+            calls.append(1)
+            raise TypeError("not retryable here")
+
+        policy = RetryPolicy(max_attempts=5, retry_on=(IOError,))
+        with pytest.raises(TypeError):
+            policy.call(typeerr, sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_full_jitter_bounds(self):
+        policy = RetryPolicy(base_delay=1.0, multiplier=2.0, max_delay=8.0,
+                             rng=random.Random(42))
+        for attempt, upper in [(0, 1.0), (1, 2.0), (2, 4.0), (3, 8.0),
+                               (4, 8.0)]:
+            for _ in range(50):
+                d = policy.backoff(attempt)
+                assert 0.0 <= d <= upper
+
+    def test_jitter_none_is_deterministic_upper_bound(self):
+        policy = RetryPolicy(base_delay=0.5, multiplier=2.0, jitter="none")
+        assert [policy.backoff(i) for i in range(3)] == [0.5, 1.0, 2.0]
+
+    def test_explicit_schedule(self):
+        policy = RetryPolicy(schedule=[0.1, 0.5, 1.0], jitter="none")
+        assert policy.max_attempts == 4
+        assert [policy.backoff(i) for i in range(3)] == [0.1, 0.5, 1.0]
+
+    def test_retry_result_returns_last_error_value(self):
+        results = iter([{"code": 500}, {"code": 500}, {"code": 500}])
+        policy = RetryPolicy(schedule=[0.0, 0.0])
+        out = policy.call(lambda: next(results),
+                          retry_result=lambda r: r["code"] >= 500,
+                          sleep=lambda s: None)
+        assert out == {"code": 500}    # HTTP semantics: hand it back
+
+    def test_retry_result_stops_on_success(self):
+        results = iter([{"code": 503}, {"code": 200}])
+        policy = RetryPolicy(schedule=[0.0, 0.0])
+        out = policy.call(lambda: next(results),
+                          retry_result=lambda r: r["code"] >= 500,
+                          sleep=lambda s: None)
+        assert out == {"code": 200}
+
+    def test_deadline_cuts_the_loop(self):
+        clock = FakeClock()
+        dl = Deadline(1.0, clock=clock)
+        calls = []
+
+        def failing():
+            calls.append(1)
+            clock.advance(0.6)     # each attempt costs 0.6s of budget
+            raise IOError("slow failure")
+
+        policy = RetryPolicy(max_attempts=10, base_delay=0.01,
+                             jitter="none")
+        with pytest.raises(IOError):
+            policy.call(failing, deadline=dl, sleep=lambda s: None)
+        assert len(calls) == 2     # third attempt would exceed budget
+
+    def test_expired_deadline_raises_before_first_attempt(self):
+        clock = FakeClock()
+        dl = Deadline(0.5, clock=clock)
+        clock.advance(1.0)
+        with pytest.raises(DeadlineExceeded):
+            RetryPolicy().call(lambda: "never", deadline=dl)
+
+    def test_breaker_integration(self):
+        br = CircuitBreaker(failure_threshold=2, cooldown=60.0,
+                            clock=FakeClock(), name="p")
+        policy = RetryPolicy(max_attempts=2, base_delay=0.0)
+        with pytest.raises(IOError):
+            policy.call(lambda: (_ for _ in ()).throw(IOError("x")),
+                        breaker=br, sleep=lambda s: None)
+        assert br.state == CircuitBreaker.OPEN
+        with pytest.raises(CircuitOpenError):
+            policy.call(lambda: "fine", breaker=br)
+
+    def test_breaker_not_tripped_by_no_retry_client_errors(self):
+        # a deterministic 4xx-style failure means the backend ANSWERED;
+        # a burst of bad requests must not open the circuit on it
+        class BadRequest(Exception):
+            pass
+
+        br = CircuitBreaker(failure_threshold=2, cooldown=60.0,
+                            clock=FakeClock(), name="p2")
+        policy = RetryPolicy(max_attempts=3, no_retry=(BadRequest,))
+        for _ in range(5):
+            with pytest.raises(BadRequest):
+                policy.call(lambda: (_ for _ in ()).throw(BadRequest()),
+                            breaker=br, sleep=lambda s: None)
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_bare_exception_class_accepted(self):
+        # anywhere `except` accepts a bare class, the policy does too
+        policy = RetryPolicy(max_attempts=3, no_retry=KeyError,
+                             retry_on=IOError)
+        with pytest.raises(KeyError):
+            policy.call(lambda: (_ for _ in ()).throw(KeyError("k")),
+                        sleep=lambda s: None)
+        from mmlspark_tpu import downloader
+        from mmlspark_tpu.utils import async_utils
+        with pytest.raises(KeyError):
+            downloader.retry_with_backoff(
+                lambda: (_ for _ in ()).throw(KeyError("k")),
+                no_retry=KeyError)
+        with pytest.raises(ValueError):
+            async_utils.retry_with_backoff(
+                lambda: (_ for _ in ()).throw(ValueError("v")),
+                exceptions=KeyError)
+
+
+class TestDeadline:
+    def test_remaining_and_clamp(self):
+        clock = FakeClock()
+        dl = Deadline(2.0, clock=clock)
+        assert dl.remaining() == pytest.approx(2.0)
+        assert dl.clamp(5.0) == pytest.approx(2.0)
+        assert dl.clamp(0.5) == pytest.approx(0.5)
+        clock.advance(3.0)
+        assert dl.expired and dl.clamp(1.0) == 0.0
+
+    def test_unbounded(self):
+        dl = Deadline.none()
+        assert dl.remaining() == float("inf") and not dl.expired
+        dl.check()   # never raises
+
+
+class TestCircuitBreaker:
+    def test_closed_to_open_to_half_open_to_closed(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=3, cooldown=10.0,
+                            clock=clock, name="t")
+        assert br.state == CircuitBreaker.CLOSED and br.allow()
+        for _ in range(3):
+            br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert not br.allow()
+        assert br.retry_after() == pytest.approx(10.0)
+        clock.advance(10.1)
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert br.allow()             # one probe admitted
+        assert not br.allow()         # ...and only one
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED and br.allow()
+
+    def test_half_open_failure_reopens(self):
+        clock = FakeClock()
+        br = CircuitBreaker(failure_threshold=1, cooldown=5.0, clock=clock)
+        br.record_failure()
+        clock.advance(5.1)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == CircuitBreaker.OPEN
+        assert br.times_opened == 2
+
+    def test_failure_rate_threshold(self):
+        br = CircuitBreaker(failure_threshold=100, failure_rate=0.5,
+                            window=10, min_calls=4, clock=FakeClock())
+        for outcome in [False, True, False, True]:
+            (br.record_failure if outcome else br.record_success)()
+        assert br.state == CircuitBreaker.OPEN   # 2/4 >= 0.5
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(failure_threshold=3, clock=FakeClock())
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED
+
+    def test_call_wrapper(self):
+        br = CircuitBreaker(failure_threshold=1, cooldown=60.0,
+                            clock=FakeClock())
+        with pytest.raises(IOError):
+            br.call(lambda: (_ for _ in ()).throw(IOError("x")))
+        with pytest.raises(CircuitOpenError):
+            br.call(lambda: "nope")
+        snap = br.snapshot()
+        assert snap["state"] == "open" and snap["times_opened"] == 1
+
+
+class TestBackCompatShims:
+    """downloader / async_utils keep their public signatures but route
+    through RetryPolicy — exactly one retry implementation remains."""
+
+    def test_downloader_shim(self, monkeypatch):
+        from mmlspark_tpu import downloader
+        monkeypatch.setattr("mmlspark_tpu.utils.resilience.time.sleep",
+                            lambda s: None)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise IOError("x")
+            return "ok"
+
+        assert downloader.retry_with_backoff(flaky, times=3,
+                                             base_delay=0.01) == "ok"
+        assert len(calls) == 2
+
+        class Nope(Exception):
+            pass
+
+        calls.clear()
+
+        def fatal():
+            calls.append(1)
+            raise Nope()
+
+        with pytest.raises(Nope):
+            downloader.retry_with_backoff(fatal, no_retry=(Nope,))
+        assert len(calls) == 1
+
+    def test_async_utils_shim(self, monkeypatch):
+        from mmlspark_tpu.utils import async_utils
+        monkeypatch.setattr("mmlspark_tpu.utils.resilience.time.sleep",
+                            lambda s: None)
+        seen = []
+
+        def flaky():
+            if len(seen) < 2:
+                raise KeyError("x")
+            return 7
+
+        # retries=3 means 4 total attempts; on_retry sees (exc, attempt)
+        assert async_utils.retry_with_backoff(
+            flaky, retries=3, initial_delay=0.01,
+            on_retry=lambda e, i: seen.append((type(e), i))) == 7
+        assert seen == [(KeyError, 0), (KeyError, 1)]
+        # exceptions filter: unlisted types propagate on first raise
+        with pytest.raises(ValueError):
+            async_utils.retry_with_backoff(
+                lambda: (_ for _ in ()).throw(ValueError("v")),
+                exceptions=(KeyError,))
+
+    def test_http_filesystem_404_fails_fast(self, monkeypatch, tmp_path):
+        """4xx on the HTTP read path is deterministic: one request, no
+        backoff burn (the no_retry classification of the migration)."""
+        from mmlspark_tpu.utils.filesystem import HTTPFileSystem
+        hits = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                hits.append(self.path)
+                self.send_error(404, "nope")
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            fs = HTTPFileSystem(retries=3, timeout=5.0)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                fs.read_bytes(
+                    f"http://127.0.0.1:{srv.server_address[1]}/x.bin")
+            assert ei.value.code == 404
+            assert len(hits) == 1, f"404 was retried: {hits}"
+        finally:
+            srv.shutdown()
+
+    def test_http_filesystem_5xx_still_retries(self, monkeypatch):
+        from mmlspark_tpu.utils.filesystem import HTTPFileSystem
+        monkeypatch.setattr("mmlspark_tpu.utils.resilience.time.sleep",
+                            lambda s: None)
+        hits = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                hits.append(1)
+                if len(hits) < 3:
+                    self.send_error(503, "warming up")
+                    return
+                body = b"finally"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            fs = HTTPFileSystem(retries=3, timeout=5.0)
+            data = fs.read_bytes(
+                f"http://127.0.0.1:{srv.server_address[1]}/x.bin")
+            assert data == b"finally" and len(hits) == 3
+        finally:
+            srv.shutdown()
+
+
+class TestAdvancedHandlerRegression:
+    """The satellite fix: only 429/5xx/connection errors burn the
+    backoff budget; other 4xx fail fast, and the fixed ms schedule now
+    gets full jitter."""
+
+    @staticmethod
+    def _serve(codes):
+        """A server answering the given status sequence, counting hits."""
+        hits = []
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                code = codes[min(len(hits), len(codes) - 1)]
+                hits.append(code)
+                length = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(length)
+                if code >= 400:
+                    self.send_error(code, "as scripted")
+                    return
+                body = b'{"ok": true}'
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, f"http://127.0.0.1:{srv.server_address[1]}/", hits
+
+    def test_404_fast_fail_single_request(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr("mmlspark_tpu.utils.resilience.time.sleep",
+                            slept.append)
+        srv, url, hits = self._serve([404])
+        try:
+            resp = advanced_handler(
+                HTTPSchema.request(url, "POST", b"{}"), 5.0,
+                [100, 500, 1000])
+            assert resp["statusLine"]["statusCode"] == 404
+            assert len(hits) == 1, "non-retryable 4xx burned the budget"
+            assert slept == [], "fast-fail must not sleep"
+        finally:
+            srv.shutdown()
+
+    def test_429_and_5xx_retry_until_success(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr("mmlspark_tpu.utils.resilience.time.sleep",
+                            slept.append)
+        srv, url, hits = self._serve([429, 503, 200])
+        try:
+            resp = advanced_handler(
+                HTTPSchema.request(url, "POST", b"{}"), 5.0,
+                [100, 500, 1000])
+            assert resp["statusLine"]["statusCode"] == 200
+            assert hits == [429, 503, 200]
+            # jitter: each gap drawn from U[0, schedule_entry_seconds]
+            assert len(slept) == 2
+            assert 0.0 <= slept[0] <= 0.1 and 0.0 <= slept[1] <= 0.5
+        finally:
+            srv.shutdown()
+
+    def test_connection_error_retries_then_reports(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr("mmlspark_tpu.utils.resilience.time.sleep",
+                            slept.append)
+        resp = advanced_handler(
+            HTTPSchema.request("http://127.0.0.1:1/none", "POST", b"{}"),
+            0.5, [10, 10])
+        assert resp["statusLine"]["statusCode"] == 0
+        assert len(slept) == 2     # whole schedule burned, then reported
+
+    def test_deadline_bounds_the_whole_call(self, monkeypatch):
+        monkeypatch.setattr("mmlspark_tpu.utils.resilience.time.sleep",
+                            lambda s: None)
+        clock = FakeClock()
+        calls = []
+
+        def fake_send(req, timeout):
+            calls.append(1)
+            clock.advance(0.4)
+            return HTTPSchema.response(503, "overloaded", None)
+
+        monkeypatch.setattr("mmlspark_tpu.io.http.send_request", fake_send)
+        resp = advanced_handler(
+            HTTPSchema.request("http://x/", "POST", b"{}"), 5.0,
+            [10, 10, 10, 10, 10],
+            deadline=Deadline(1.0, clock=clock))
+        assert resp["statusLine"]["statusCode"] == 503
+        assert len(calls) <= 3     # budget, not schedule length, ruled
+
+
+def test_no_ad_hoc_retry_loops_outside_resilience():
+    """Guard: a sleep() within a few lines of a retry/attempt loop header
+    anywhere outside utils/resilience.py is an ad-hoc retry
+    implementation — route it through RetryPolicy instead."""
+    import re
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "mmlspark_tpu")
+    loop_re = re.compile(r"^\s*(for|while)\b.*(attempt|retr|backoff)",
+                         re.IGNORECASE)
+    sleep_re = re.compile(r"\bsleep\(")
+    offenders = []
+    for dirpath, _, files in os.walk(root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            if rel == os.path.join("utils", "resilience.py"):
+                continue
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+            for i, line in enumerate(lines):
+                if loop_re.search(line):
+                    window = "".join(lines[i:i + 10])
+                    if sleep_re.search(window):
+                        offenders.append(f"{rel}:{i + 1}")
+    assert not offenders, (
+        "ad-hoc sleep-loop retry outside utils/resilience.py "
+        f"(use RetryPolicy): {offenders}")
