@@ -1,6 +1,12 @@
-"""Metric name constants (ref: src/core/metrics/src/main/scala/MetricConstants.scala:9-83)."""
+"""Metric name constants (ref: src/core/metrics/src/main/scala/MetricConstants.scala:9-83)
+plus the serving-path latency histogram.
+"""
 
 from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Sequence
 
 # regression
 MSE = "mse"
@@ -36,3 +42,126 @@ def is_classification_metric(name: str) -> bool:
 
 def is_regression_metric(name: str) -> bool:
     return name in REGRESSION_METRICS
+
+
+# ---------------------------------------------------------------------------
+# serving-path latency histograms
+# ---------------------------------------------------------------------------
+
+# log-spaced upper bounds (1-2-5 decades): resolution tracks magnitude,
+# so the same 18 buckets cover a 50 us pad and a 5 s cold compile
+_DEFAULT_BOUNDS = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
+                   100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0,
+                   math.inf)
+
+
+class LatencyHistogram:
+    """Fixed-bucket latency histogram for the serving hot path.
+
+    Lock-guarded counters only — ``observe`` is O(#buckets) with no
+    allocation, cheap enough to sit on the per-batch dispatch path.
+    Percentiles interpolate within the containing bucket (exact count,
+    approximate value — the standard Prometheus-histogram tradeoff).
+    """
+
+    def __init__(self, unit: str = "ms",
+                 bounds: Sequence[float] = _DEFAULT_BOUNDS):
+        self.unit = unit
+        self.bounds = tuple(bounds)
+        if self.bounds[-1] != math.inf:
+            self.bounds = self.bounds + (math.inf,)
+        self._counts = [0] * len(self.bounds)
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = 0
+        while self.bounds[i] < v:
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v > self._max:
+                self._max = v
+
+    def merge(self, other: "LatencyHistogram") -> "LatencyHistogram":
+        """Fold another histogram's counts into this one (fleet-wide
+        aggregation). Bucket layouts must match."""
+        if other.bounds != self.bounds:
+            raise ValueError("histogram bucket layouts differ")
+        with other._lock:
+            counts = list(other._counts)
+            count, total, mx = other._count, other._sum, other._max
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._count += count
+            self._sum += total
+            self._max = max(self._max, mx)
+        return self
+
+    @staticmethod
+    def merged(hists: Sequence["LatencyHistogram"]) -> "LatencyHistogram":
+        out = LatencyHistogram(unit=hists[0].unit if hists else "ms")
+        for h in hists:
+            out.merge(h)
+        return out
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100]) by linear
+        interpolation inside the containing bucket."""
+        with self._lock:
+            counts = list(self._counts)
+            count, mx = self._count, self._max
+        if count == 0:
+            return 0.0
+        rank = q / 100.0 * count
+        seen = 0
+        for i, c in enumerate(counts):
+            if seen + c >= rank and c > 0:
+                lo = 0.0 if i == 0 else self.bounds[i - 1]
+                hi = mx if math.isinf(self.bounds[i]) \
+                    else self.bounds[i]
+                frac = (rank - seen) / c
+                est = lo + (max(hi, lo) - lo) * min(max(frac, 0.0), 1.0)
+                return min(est, mx)   # never report above the true max
+            seen += c
+        return mx
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            count, total, mx = self._count, self._sum, self._max
+        if count == 0:
+            return {"count": 0}
+        return {
+            "count": count,
+            "mean": round(total / count, 3),
+            "p50": round(self.percentile(50), 3),
+            "p90": round(self.percentile(90), 3),
+            "p99": round(self.percentile(99), 3),
+            "max": round(mx, 3),
+        }
+
+    def snapshot(self) -> Dict[str, object]:
+        """Raw buckets for exporters: parallel bound/count lists."""
+        with self._lock:
+            counts = list(self._counts)
+        return {"unit": self.unit, "bounds": list(self.bounds),
+                "counts": counts}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self.bounds)
+            self._count = 0
+            self._sum = 0.0
+            self._max = 0.0
+
+
+def histogram_set(*names: str) -> Dict[str, LatencyHistogram]:
+    """A named family of histograms (one allocation site for the
+    serving engine / model instrumentation)."""
+    return {n: LatencyHistogram() for n in names}

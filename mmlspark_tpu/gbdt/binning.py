@@ -189,6 +189,34 @@ class BinMapper:
         return np.ascontiguousarray(
             self.transform(np.asarray(X, dtype=np.float64)).T)
 
+    def transform_fm_range(self, X: np.ndarray, j0: int,
+                           j1: int) -> np.ndarray:
+        """Bin features [j0, j1) straight into the (j1-j0, N)
+        features-major ship layout — the chunk primitive behind the
+        booster's pipelined bin+ship (one chunk bins on host while the
+        previous chunk's host->device DMA is in flight). Native fused
+        kernel (uint8) when available; numpy per-column searchsorted
+        (int32) otherwise, widened per column to f64 so results are
+        bit-identical to transform()."""
+        try:
+            from mmlspark_tpu.native import loader as native
+            if native.available():
+                out = native.apply_bins_t_u8(X, self.upper_bounds,
+                                             feature_range=(j0, j1))
+                if out is not None:
+                    return out
+        except Exception:  # noqa: BLE001 — native is only an accelerator
+            pass
+        n = X.shape[0]
+        out = np.empty((j1 - j0, n), np.int32)
+        for j in range(j0, j1):
+            col = np.asarray(X[:, j], dtype=np.float64)
+            binned = np.searchsorted(self.upper_bounds[j], col,
+                                     side="left").astype(np.int32)
+            binned[np.isnan(col)] = 0
+            out[j - j0] = binned
+        return out
+
     def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
         """The raw-value threshold for 'go left if bin <= bin_idx':
         the upper boundary of that bin. Rows with value <= this boundary

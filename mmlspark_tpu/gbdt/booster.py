@@ -705,52 +705,48 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # every split search)
     f_pad = (-f) % n_shards if feature_parallel else 0
     f_eff = f + f_pad
-    # pipelined bin+ship (single-host dense): bin one feature CHUNK on
-    # the host while the previous chunk's host->device DMA is in flight
-    # (device_put dispatch is async; only the final block waits). The
-    # two phases previously serialized — HIGGS-1M paid bin 1.7s + ship
-    # 2.0s back to back; overlapped they cost ~max of the two
-    # (ref: the reference's native path overlaps per-partition dataset
-    # construction, TrainUtils.scala:19-64). The native range kernel
-    # bins columns [j0, j1) without copying X.
+    # pipelined bin+ship (single-host): produce one feature CHUNK of the
+    # (F, N) ship layout on the host while the previous chunk's
+    # host->device DMA is in flight (device_put dispatch is async; only
+    # the final concatenate waits). The two phases previously serialized
+    # — HIGGS-1M paid bin 1.7s + ship 2.0s back to back; overlapped they
+    # cost ~max of the two (ref: the reference's native path overlaps
+    # per-partition dataset construction, TrainUtils.scala:19-64).
+    # Dense input bins each chunk via transform_fm_range (native range
+    # kernel when available, numpy fallback otherwise); pre-binned input
+    # (streaming/CSR) transposes + narrows each column block while the
+    # previous block flies. Multi-host keeps the one-shot numpy path —
+    # its global array is assembled from per-process shards below.
+    narrow = (np.uint8 if num_bins <= 256
+              else np.int16 if num_bins <= 32767 else np.int32)
+    # ~8 MB of rows per chunk amortizes per-transfer dispatch;
+    # pipelining needs >= 2 chunks to overlap anything
+    # (ship_chunk_bytes is a tuning/test knob, not a public param)
+    chunk_f = max(1, int(p.get("ship_chunk_bytes", 8 << 20))
+                  // max(n_padded, 1))
     pipelined = False
-    if bins_np is None and not (multi_host or multi_host_fp):
-        from mmlspark_tpu.native import loader as _native
-        # the bin-cap (<=256) and symbol checks live in
-        # apply_bins_t_u8 itself — a None return on the FIRST chunk
-        # falls back to the serial path with nothing lost
-        lib_ok = (_native.available()
-                  and hasattr(_native.get_lib(),
-                              "mml_apply_bins_t_u8_range")
-                  and not isinstance(X, _CSRMatrix))
-        # ~8 MB of rows per chunk amortizes per-transfer dispatch;
-        # pipelining needs >= 2 chunks to overlap anything
-        # (ship_chunk_bytes is a tuning/test knob, not a public param)
-        chunk_f = max(1, int(p.get("ship_chunk_bytes", 8 << 20))
-                      // max(n_padded, 1))
-        if lib_ok and f > chunk_f:
-            # normalize ONCE: the kernel needs contiguous input, and a
-            # per-chunk ascontiguousarray of a non-contiguous X would
-            # copy the full matrix K times
+    if not (multi_host or multi_host_fp) and f > chunk_f:
+        parts = []
+        if bins_np is None and not isinstance(X, _CSRMatrix):
+            # normalize ONCE: the native kernel needs contiguous input,
+            # and a per-chunk ascontiguousarray of a non-contiguous X
+            # would copy the full matrix K times
             X = np.ascontiguousarray(X)
-            parts = []
-            for j0 in range(0, f, chunk_f):
-                j1 = min(f, j0 + chunk_f)
-                part = _native.apply_bins_t_u8(
-                    X, mapper.upper_bounds, feature_range=(j0, j1))
-                if part is None:       # cap/symbol precondition failed
-                    parts = None
-                    break
-                if pad:
-                    part = np.pad(part, ((0, 0), (0, pad)))
-                parts.append(jnp.asarray(part))    # async H2D
-            if parts is not None:
-                if f_pad:
-                    parts.append(jnp.zeros((f_pad, n_padded), jnp.uint8))
-                _mark("bin")   # host binning (DMAs still in flight)
-                bins_dev = jnp.concatenate(parts, axis=0) \
-                    .astype(jnp.int32)
-                pipelined = True
+        for j0 in range(0, f, chunk_f):
+            j1 = min(f, j0 + chunk_f)
+            if bins_np is None:
+                part = mapper.transform_fm_range(X, j0, j1)
+            else:
+                part = np.ascontiguousarray(bins_np[:, j0:j1].T)
+            part = part.astype(narrow, copy=False)
+            if pad:
+                part = np.pad(part, ((0, 0), (0, pad)))
+            parts.append(jnp.asarray(part))    # async H2D per block
+        if f_pad:
+            parts.append(jnp.zeros((f_pad, n_padded), narrow))
+        _mark("bin")   # host binning/layout (block DMAs still in flight)
+        bins_dev = jnp.concatenate(parts, axis=0).astype(jnp.int32)
+        pipelined = True
     if not pipelined:
         if bins_np is None:
             # dense path: fused native bin+transpose+narrow straight
@@ -770,8 +766,6 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             # from per-process shards (or served via callback) below
             bins_dev = bins_t.astype(np.int32)
         else:
-            narrow = (np.uint8 if num_bins <= 256
-                      else np.int16 if num_bins <= 32767 else np.int32)
             # narrow dtype crosses the host->device link; the widen
             # runs on device (eager asarray+astype — no per-call
             # retrace). copy=False: the fused native path already

@@ -21,14 +21,20 @@ from mmlspark_tpu.core.table import DataTable
 
 
 def _batch_rows(table: DataTable, bounds: List[int]) -> DataTable:
-    """Group row ranges into list-valued columns."""
+    """Group row ranges into list-valued columns.
+
+    Numpy columns batch as numpy SLICES (views — zero copy, zero
+    per-element Python objects): this runs on the serving hot path, and
+    the previous ``[v for v in col]`` boxed every cell of every batch
+    into a Python float before the model immediately re-stacked them."""
     cols: Dict[str, List[Any]] = {n: [] for n in table.column_names}
-    for a, b in zip(bounds[:-1], bounds[1:]):
-        chunk = table.slice(a, b)
-        for n in table.column_names:
-            col = chunk[n]
-            cols[n].append(list(col) if not isinstance(col, np.ndarray)
-                           else [v for v in col])
+    pairs = list(zip(bounds[:-1], bounds[1:]))
+    for n in table.column_names:
+        col = table[n]
+        if isinstance(col, np.ndarray):
+            cols[n] = [col[a:b] for a, b in pairs]
+        else:
+            cols[n] = [list(col[a:b]) for a, b in pairs]
     schema = Schema([Field(n, LIST) for n in table.column_names])
     return DataTable(cols, schema)
 

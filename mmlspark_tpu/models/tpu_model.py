@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from mmlspark_tpu.core.metrics import histogram_set
 from mmlspark_tpu.core.params import (
     DictParam, EnumParam, HasInputCol, HasOutputCol, IntParam, PyTreeParam,
     StringParam, UDFParam,
@@ -34,6 +35,11 @@ from mmlspark_tpu.core.schema import Field, ImageSchema, Schema, TENSOR, VECTOR
 from mmlspark_tpu.core.stage import Model
 from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.parallel import mesh as mesh_lib
+
+# smallest serving shape bucket: ragged micro-batches pad UP to the next
+# power of two from here, so the compiled-executable set stays
+# log2(batchSize)-sized (see TPUModel.bucket_sizes)
+MIN_BUCKET = 8
 
 
 def _column_to_array(col, field: Field, dtype) -> np.ndarray:
@@ -80,6 +86,17 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         # device_put N transient copies of the full weight tree
         import threading
         self._init_lock = threading.Lock()
+        # one increment per jit TRACE of the forward (== one XLA compile
+        # per distinct bucket shape/dtype): the recompile-guard signal
+        # for steady-state serving. Lock-guarded — concurrent worker
+        # threads can first-trace two buckets at once, and a bare +=
+        # is a read-modify-write that could drop a count.
+        self.jit_cache_misses = 0
+        self._miss_lock = threading.Lock()
+        # serving-path breakdown: host batch assembly + padding vs the
+        # device dispatch->readback round trip (exported through
+        # ServingEngine /healthz via the duck-typed .metrics hook)
+        self._hists = histogram_set("pad_ms", "device_ms")
 
     def _on_param_change(self, name: str) -> None:
         if name == "weights":
@@ -147,23 +164,83 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
 
     def _compiled(self) -> Callable:
         """One jit wrapper per model (jax.jit handles per-shape retraces
-        internally); invalidated when modelFn changes."""
+        internally, one executable per bucket shape); invalidated when
+        modelFn changes. Every trace — i.e. every compile-cache miss —
+        bumps ``jit_cache_misses``, and the padded input buffers are
+        DONATED on accelerator backends (a serving batch is consumed
+        exactly once, so XLA may alias it for activations instead of
+        holding both live in HBM)."""
         fn = self._jitted.get("run")
         if fn is None:
             with self._init_lock:
                 fn = self._jitted.get("run")
                 if fn is None:
                     model_fn = self.get("modelFn")
+                    model = self
 
                     def run(weights, inputs: Dict[str, jnp.ndarray]):
+                        # trace-time side effect: runs once per distinct
+                        # input signature, i.e. once per XLA compile
+                        with model._miss_lock:
+                            model.jit_cache_misses += 1
                         out = model_fn(weights, inputs)
                         if not isinstance(out, dict):
                             out = {"output": out}
                         return out
 
-                    fn = jax.jit(run)
+                    # CPU's donation support is backend-version dependent
+                    # and only emits warnings there; donate where it pays
+                    donate = (1,) if jax.default_backend() not in ("cpu",) \
+                        else ()
+                    fn = jax.jit(run, donate_argnums=donate)
                     self._jitted["run"] = fn
         return fn
+
+    # -- serving shape buckets ----------------------------------------------
+
+    def bucket_sizes(self) -> List[int]:
+        """The padded batch-row sizes serving traffic compiles for:
+        powers of two from MIN_BUCKET up, capped by (and always
+        including) batchSize. Ragged micro-batches pad UP to the nearest
+        bucket, bounding the distinct compiled shapes to
+        log2(batchSize)+O(1) regardless of traffic mix."""
+        cap = int(self.get("batchSize"))
+        sizes: List[int] = []
+        b = MIN_BUCKET
+        while b < cap:
+            sizes.append(b)
+            b *= 2
+        sizes.append(cap)
+        return sizes
+
+    def warmup(self, example, sizes: Optional[List[int]] = None) -> int:
+        """Pre-compile every serving bucket so no live request ever pays
+        an XLA compile (bounded first-request latency — the explicit
+        warmup hook of the serving hot path).
+
+        ``example`` is a DataTable, or a dict of column -> array,
+        holding at least one representative row for every feed column.
+        Rows are tiled up to each bucket size and pushed through
+        ``transform``. Returns the number of compiles triggered (0 when
+        everything was already warm)."""
+        table = example if isinstance(example, DataTable) \
+            else DataTable(dict(example))
+        if len(table) == 0:
+            raise ValueError("warmup needs at least one example row")
+        before = self.jit_cache_misses
+        for b in (sizes or self.bucket_sizes()):
+            idx = np.resize(np.arange(len(table)), b)
+            self.transform(table._take_indices(idx))
+        return self.jit_cache_misses - before
+
+    def metrics(self) -> Dict[str, Any]:
+        """Serving instrumentation: pad/device latency summaries + the
+        compile-cache miss counter (duck-typed hook consumed by
+        ServingEngine's /healthz export)."""
+        out: Dict[str, Any] = {k: h.summary()
+                               for k, h in self._hists.items()}
+        out["jit_cache_misses"] = self.jit_cache_misses
+        return out
 
     # -- transform ----------------------------------------------------------
 
@@ -183,15 +260,17 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         # their ids through float compute dtypes
         int_input = bool(getattr(self.get("modelFn"), "int_input", False))
 
+        import time as _time
+
         def _bucket(rows: int) -> int:
             """Pad partial batches up to a power-of-two row count (capped
             at batchSize): the jitted forward is shape-keyed, so ragged
             batch sizes — serving micro-batches drain whatever is queued
             — would each trigger a fresh XLA compile (seconds through a
             remote backend). Buckets bound the distinct shapes to
-            log2(batchSize)+1; padded rows are sliced off by the
-            [:true_len] readback."""
-            b = 8
+            log2(batchSize)+1 (see bucket_sizes); padded rows are sliced
+            off by the [:true_len] readback."""
+            b = MIN_BUCKET
             while b < rows:
                 b *= 2
             return min(b, batch_size)
@@ -200,6 +279,7 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
             """Host batch assembly + device_put — runs on the prefetch
             thread so transfers overlap the current batch's compute
             (the host-bound loop VERDICT flagged in :168-190)."""
+            t0 = _time.perf_counter()
             stop = min(start + batch_size, n)
             rows = stop - start
             bucket = _bucket(rows)
@@ -220,37 +300,58 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                 if dtype == jnp.bfloat16 and not int_input:
                     sharded = sharded.astype(jnp.bfloat16)
                 inputs[model_in] = sharded
+            self._hists["pad_ms"].observe(
+                (_time.perf_counter() - t0) * 1e3)
             return rows, inputs
 
         def flush(item):
-            true_len, outputs = item
+            true_len, outputs, t_dispatch = item
             for out_col, model_out in fetches.items():
                 val = np.asarray(outputs[model_out].astype(jnp.float32)
                                  if outputs[model_out].dtype == jnp.bfloat16
                                  else outputs[model_out])
                 out_cols[out_col].append(val[:true_len])
+            # dispatch -> readback-complete: the device round trip as
+            # the serving path experiences it (async dispatch means the
+            # compiled call alone measures nothing)
+            self._hists["device_ms"].observe(
+                (_time.perf_counter() - t_dispatch) * 1e3)
 
-        from mmlspark_tpu.utils.prefetch import make_prefetcher
-        feed = make_prefetcher(iter(range(0, n, batch_size)), prepare,
-                               depth=2)
-        pending: List[Tuple[int, Dict[str, jnp.ndarray]]] = []
-        try:
-            for true_len, inputs in feed:
-                outputs = self._compiled()(weights, inputs)
-                for out_col, model_out in fetches.items():
-                    if model_out not in outputs:
-                        raise KeyError(
-                            f"model output {model_out!r} not in outputs "
-                            f"{list(outputs)}")
-                pending.append((true_len, outputs))
-                if len(pending) > 1:
-                    # delayed-by-one readback: batch k's D2H happens
-                    # while batch k+1 runs on device
-                    flush(pending.pop(0))
-        finally:
-            feed.close()
-        for item in pending:
-            flush(item)
+        def dispatch(inputs):
+            outputs = self._compiled()(weights, inputs)
+            for model_out in fetches.values():
+                if model_out not in outputs:
+                    raise KeyError(
+                        f"model output {model_out!r} not in outputs "
+                        f"{list(outputs)}")
+            return outputs
+
+        if 0 < n <= batch_size:
+            # serving fast path: one micro-batch — prepare, dispatch,
+            # read back inline. The prefetcher buys nothing here and
+            # costs a thread spawn + queue handshake per request batch
+            # on accelerator backends.
+            true_len, inputs = prepare(0)
+            t_dispatch = _time.perf_counter()
+            flush((true_len, dispatch(inputs), t_dispatch))
+        else:
+            from mmlspark_tpu.utils.prefetch import make_prefetcher
+            feed = make_prefetcher(iter(range(0, n, batch_size)), prepare,
+                                   depth=2)
+            pending: List[Tuple[int, Dict[str, jnp.ndarray], float]] = []
+            try:
+                for true_len, inputs in feed:
+                    t_dispatch = _time.perf_counter()
+                    pending.append((true_len, dispatch(inputs),
+                                    t_dispatch))
+                    if len(pending) > 1:
+                        # delayed-by-one readback: batch k's D2H happens
+                        # while batch k+1 runs on device
+                        flush(pending.pop(0))
+            finally:
+                feed.close()
+            for item in pending:
+                flush(item)
 
         result = table
         for out_col, parts in out_cols.items():

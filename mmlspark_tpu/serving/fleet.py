@@ -20,10 +20,13 @@ consumer per host.
 
 from __future__ import annotations
 
+import http.client
+import io
 import itertools
 import json
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from collections import deque
 from concurrent.futures import (
@@ -66,21 +69,42 @@ def json_scoring_pipeline(model, field: str = "features",
     ``{reply_field: argmax}`` per row. One implementation shared by the
     serving bench, the throughput floor test, and user deployments —
     the serving-side analog of ServingImplicits' request parsing
-    (ref: ServingImplicits.scala)."""
+    (ref: ServingImplicits.scala).
+
+    The returned stage exposes the ServingEngine two-stage split:
+    ``prepare_batch`` (JSON decode + stack — pure host work the batcher
+    thread runs while the device executes the previous batch) and
+    ``execute_prepared`` (model forward + reply build, run by a
+    worker). ``transform`` remains the single-stage fallback — the
+    per-row poison-isolation retry and non-pipelined embeddings use
+    it."""
     import numpy as np
     from mmlspark_tpu.stages.basic import Lambda
 
-    def handle(table: DataTable) -> DataTable:
-        feats = np.stack([
+    def decode(table: DataTable) -> "np.ndarray":
+        return np.stack([
             np.asarray(json.loads(r["entity"].decode())[field],
                        dtype=np.float32)
             for r in table["request"]])
+
+    def execute(table: DataTable, feats) -> DataTable:
         scored = model.transform(DataTable({field: feats}))
         preds = np.asarray(scored[model.get("outputCol")]).argmax(-1)
         return table.with_column(
             "reply", [{reply_field: int(p)} for p in preds])
 
-    return Lambda.apply(handle)
+    def handle(table: DataTable) -> DataTable:
+        return execute(table, decode(table))
+
+    lam = Lambda.apply(handle)
+    lam.prepare_batch = decode
+    lam.execute_prepared = execute
+    # pad/device hists + jit_cache_misses — TPUModel has the hook;
+    # other Model types serve fine without it
+    stage_metrics = getattr(model, "metrics", None)
+    if callable(stage_metrics):
+        lam.metrics = stage_metrics
+    return lam
 
 
 def json_row_scoring_pipeline(pipeline, reply_col: str = "prediction"):
@@ -144,7 +168,9 @@ class ServingFleet:
                  breaker_cooldown: float = 2.0,
                  hedge_percentile: Optional[float] = None,
                  hedge_min_s: float = 0.02,
-                 max_parked: Optional[int] = None):
+                 max_parked: Optional[int] = None,
+                 max_wait_ms: float = 5.0,
+                 pipeline_depth: int = 2):
         self.engines: List[ServingEngine] = []
         self.transport_errors = 0
         self.hedged_requests = 0
@@ -160,10 +186,11 @@ class ServingFleet:
                                     max_parked=max_parked)
                 port = source.port + 1      # skip whatever port-scan used
                 try:
-                    engine = ServingEngine(source, pipeline,
-                                           reply_col=reply_col,
-                                           batch_size=batch_size,
-                                           workers=workers).start()
+                    engine = ServingEngine(
+                        source, pipeline, reply_col=reply_col,
+                        batch_size=batch_size, workers=workers,
+                        max_wait_ms=max_wait_ms,
+                        pipeline_depth=pipeline_depth).start()
                 except Exception:
                     source.close()   # don't orphan the bound port
                     raise
@@ -188,16 +215,116 @@ class ServingFleet:
 
     # -- transport ---------------------------------------------------------
 
-    @staticmethod
-    def _http_post(addr: str, body: bytes,
-                   timeout: float) -> Dict[str, Any]:
+    # keep-alive connection pool: one persistent HTTPConnection per
+    # (thread, engine address). thread-local => no locking, and a
+    # connection is never shared across concurrent requests
+    _conn_pool = threading.local()
+
+    @classmethod
+    def _pooled_conn(cls, addr: str,
+                     timeout: float) -> "http.client.HTTPConnection":
+        conns = getattr(cls._conn_pool, "conns", None)
+        if conns is None:
+            conns = cls._conn_pool.conns = {}
+        conn = conns.get(addr)
+        if conn is None:
+            u = urllib.parse.urlsplit(addr)
+            conn = http.client.HTTPConnection(u.hostname, u.port,
+                                              timeout=timeout)
+            conns[addr] = conn
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        return conn
+
+    @classmethod
+    def _drop_conn(cls, addr: str) -> None:
+        conns = getattr(cls._conn_pool, "conns", {})
+        conn = conns.pop(addr, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @classmethod
+    def _http_post(cls, addr: str, body: bytes, timeout: float,
+                   replayable: bool = True,
+                   pooled: bool = True) -> Dict[str, Any]:
+        """POST over a pooled keep-alive connection (HTTP/1.1): the
+        serving hot path pays no TCP handshake and spawns no server
+        thread per request. App-level statuses surface as
+        ``urllib.error.HTTPError`` (the breaker/failover contract).
+
+        A pooled connection the server closed while idle fails either
+        on the SEND or — when the buffered write slips through before
+        the RST — as RemoteDisconnected on the response; both retry
+        once on a fresh connection, else a whole healthy fleet looks
+        down after an idle gap (every thread-local conn went stale at
+        once). The response-phase retry could re-execute a request the
+        engine processed but never answered, so it is gated on
+        ``replayable`` (post's ``idempotent`` flag). ``pooled=False``
+        uses a one-shot connection closed before return — for spawned
+        hedge threads, whose thread-local pool would otherwise leak
+        one connection per call. Other failures propagate — the
+        caller's failover policy decides."""
         import time as _time
-        req = urllib.request.Request(
-            addr, data=body, headers={"Content-Type": "application/json"})
         t0 = _time.perf_counter()
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return {"body": json.loads(r.read()),
+        headers = {"Content-Type": "application/json"}
+        for attempt in (0, 1):
+            if pooled:
+                conn = cls._pooled_conn(addr, timeout)
+            else:
+                u = urllib.parse.urlsplit(addr)
+                conn = http.client.HTTPConnection(u.hostname, u.port,
+                                                  timeout=timeout)
+                headers = dict(headers, Connection="close")
+
+            def _discard():
+                if pooled:
+                    cls._drop_conn(addr)
+                else:
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            fresh = conn.sock is None
+            try:
+                conn.request("POST", "/", body, headers)
+            except Exception:
+                _discard()
+                if fresh or attempt:
+                    raise
+                continue   # stale keep-alive socket: one fresh retry
+            try:
+                resp = conn.getresponse()
+                data = resp.read()
+                if not pooled or resp.will_close:
+                    _discard()
+            except (http.client.RemoteDisconnected,
+                    http.client.BadStatusLine):
+                _discard()
+                if fresh or attempt or not replayable:
+                    raise
+                continue   # idle-closed socket ate the send: retry
+            except Exception:
+                _discard()
+                raise
+            if resp.status >= 400:
+                if (resp.status == 503 and resp.will_close
+                        and not fresh and not attempt):
+                    # a closed source draining its old persistent
+                    # connections (shed + Connection: close): nothing
+                    # was processed — reconnect once; a fresh connect
+                    # reaches whatever now owns the port
+                    continue
+                raise urllib.error.HTTPError(
+                    addr, resp.status, resp.reason,
+                    dict(resp.getheaders()), io.BytesIO(data))
+            return {"body": json.loads(data),
                     "latency": _time.perf_counter() - t0}
+        raise RuntimeError("unreachable")   # loop always returns/raises
 
     @staticmethod
     def _submit(fn, *args) -> "Future":
@@ -262,7 +389,11 @@ class ServingFleet:
         threshold = self._hedge_threshold() if allow_hedge else None
         if threshold is None or threshold >= timeout:
             try:
-                result = self._http_post(addr, body, timeout)
+                # allow_hedge carries post()'s idempotent flag: only
+                # idempotent requests may transparently replay a
+                # response-phase stale-connection failure
+                result = self._http_post(addr, body, timeout,
+                                         replayable=allow_hedge)
             except Exception as e:
                 self._classify_and_record(breaker, e)
                 raise
@@ -270,7 +401,11 @@ class ServingFleet:
             return result
         import time as _time
         start = _time.monotonic()
-        f1 = self._submit(self._http_post, addr, body, timeout)
+        # hedge legs run on spawned one-shot threads: pooled=False, or
+        # each call would strand a keep-alive conn in a dead thread's
+        # local storage (hedging only runs for idempotent requests)
+        f1 = self._submit(self._http_post, addr, body, timeout,
+                          True, False)
         f1.add_done_callback(
             lambda f: self._classify_and_record(breaker, f.exception()))
         try:
@@ -291,7 +426,7 @@ class ServingFleet:
             self.hedged_requests += 1
         tried.add(j)   # the hedge consumed replica j for this request
         f2 = self._submit(self._http_post, self.addresses[j], body,
-                          timeout)
+                          timeout, True, False)
         f2.add_done_callback(
             lambda f: self._classify_and_record(self.breakers[j],
                                                 f.exception()))
@@ -382,16 +517,19 @@ class ServingFleet:
                      "skipped": True})
                 raise ServingUnavailable(attempts)
             try:
-                return self._probe(order[0], body, timeout, attempts)
+                return self._probe(order[0], body, timeout, attempts,
+                                   idempotent)
             finally:
                 self._probe_lock.release()
         raise ServingUnavailable(attempts)
 
     def _probe(self, i: int, body: bytes, timeout: float,
-               attempts: List[Dict[str, Any]]) -> Dict[str, Any]:
+               attempts: List[Dict[str, Any]],
+               replayable: bool = True) -> Dict[str, Any]:
         """The all-circuits-open last-resort probe of engine ``i``."""
         try:
-            result = self._http_post(self.addresses[i], body, timeout)
+            result = self._http_post(self.addresses[i], body, timeout,
+                                     replayable=replayable)
         except urllib.error.HTTPError as e:
             if e.code not in _FAILOVER_CODES:
                 # engine alive and answering: the post() contract —
@@ -440,6 +578,27 @@ class ServingFleet:
                 out.append({"reachable": False,
                             "error": f"{type(err).__name__}: {err}"})
         return out
+
+    def metrics(self) -> Dict[str, Any]:
+        """Fleet-wide latency breakdown: per-engine snapshots plus an
+        aggregate merging every engine's histograms (the bench/ops
+        view). Engine histograms merge exactly (same bucket layout);
+        the pipeline-stage metrics come from engine 0 — fleet engines
+        share one pipeline object, so its counters are already
+        fleet-wide."""
+        from mmlspark_tpu.core.metrics import LatencyHistogram
+        per_engine = [e.metrics() for e in self.engines]
+        aggregate: Dict[str, Any] = {}
+        if self.engines:
+            for key in self.engines[0].hists:
+                aggregate[key] = LatencyHistogram.merged(
+                    [e.hists[key] for e in self.engines]).summary()
+            stage = per_engine[0].get("pipeline_stage")
+            if stage is not None:
+                aggregate["pipeline_stage"] = stage
+        aggregate["batches_processed"] = sum(
+            m["batches_processed"] for m in per_engine)
+        return {"engines": per_engine, "aggregate": aggregate}
 
     def counters(self) -> Dict[str, int]:
         return {

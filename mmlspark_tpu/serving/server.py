@@ -20,11 +20,12 @@ replicated by jax, no cross-host reply routing is ever needed.
 
 from __future__ import annotations
 
+import itertools
 import json
 import queue
+import secrets
 import threading
 import time
-import uuid as uuid_lib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -36,6 +37,17 @@ from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.io.http import HTTPSchema, _jsonable as _to_jsonable
 
 log = get_logger("serving")
+
+
+def _request_id_factory():
+    """Unique request ids without a per-request os.urandom syscall
+    (uuid4 was ~2% of a loaded engine's wall): one random process
+    prefix + an atomic counter. Uniqueness holds per process, which is
+    the reply-routing scope; the prefix keeps ids unguessable and
+    distinct across engine restarts."""
+    prefix = secrets.token_hex(8)
+    counter = itertools.count()
+    return lambda: f"{prefix}-{next(counter)}"
 
 
 class SharedVariable:
@@ -79,6 +91,11 @@ class _ParkedRequest:
         self.request = request_struct
         self._event = threading.Event()
         self.response: Optional[Dict[str, Any]] = None
+        # stamped at enqueue / at leaving the queue; their difference
+        # is the queue-wait histogram sample (dequeue stamps are set by
+        # drain_parked/top_up, the two exits from the source queue)
+        self.enqueued_at: float = 0.0
+        self.dequeued_at: float = 0.0
 
     def respond(self, response: Dict[str, Any]) -> None:
         self.response = response
@@ -113,14 +130,35 @@ class HTTPSource:
         # Tail-at-Scale story). Default bound = the queue bound.
         self.max_parked = max_parked if max_parked is not None else max_queue
         self.retry_after_s = max(1, int(retry_after_s))
+        # closed sources must tell persistent (keep-alive) connections
+        # to go away: without this, a handler thread that outlives
+        # close() would keep parking requests into a dead engine until
+        # every one of them burned the full reply timeout
+        self._closed = False
         # set by ServingEngine.start(): () -> bool engine liveness; the
         # /healthz endpoint folds it into its verdict
         self.health_probe: Optional[Callable[[], bool]] = None
+        # set by ServingEngine.start(): () -> dict of latency-histogram
+        # summaries (queue-wait/pad/device/respond), exported on /healthz
+        self.metrics_probe: Optional[Callable[[], Dict[str, Any]]] = None
         self._pending: Dict[str, _ParkedRequest] = {}
         self._lock = threading.Lock()
+        self._new_rid = _request_id_factory()
         source = self
 
         class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.1 keep-alive: a load balancer (or the fleet client)
+            # reuses its connection across requests, so the per-request
+            # TCP handshake + server thread spawn disappear from the hot
+            # path — at high client counts that overhead rivaled the
+            # model itself. Every reply path below sends Content-Length,
+            # which 1.1 persistence requires.
+            protocol_version = "HTTP/1.1"
+            # idle persistent connections fold after this many seconds
+            # (also bounds how long a dead client can pin a handler
+            # thread in its blocking read)
+            timeout = 20
+
             def _send_json(self, code: int, payload: Dict[str, Any],
                            headers: Optional[Dict[str, str]] = None):
                 body = json.dumps(payload).encode("utf-8")
@@ -151,6 +189,12 @@ class HTTPSource:
                         healthy = bool(source.health_probe())
                     except Exception:  # noqa: BLE001 — probe crash = sick
                         healthy = False
+                metrics: Optional[Dict[str, Any]] = None
+                if source.metrics_probe is not None:
+                    try:  # outside source._lock — the probe takes its own
+                        metrics = source.metrics_probe()
+                    except Exception:  # noqa: BLE001 — stats stay partial
+                        metrics = {"error": "metrics probe failed"}
                 with source._lock:
                     stats = {
                         "status": "ok" if healthy else "unhealthy",
@@ -161,9 +205,23 @@ class HTTPSource:
                         "parked": len(source._pending),
                         "queue_depth": source.queue.qsize(),
                     }
+                if metrics is not None:
+                    stats["metrics"] = metrics
                 self._send_json(200 if healthy else 503, stats)
 
             def do_POST(self):  # noqa: N802 (http.server API)
+                if source._closed:
+                    # drain persistent connections of a closed source:
+                    # shed with an EXPLICIT Connection: close (so the
+                    # client's will_close fires and it reconnects —
+                    # reaching whatever replaced us) instead of parking
+                    # requests into a dead engine
+                    with source._lock:
+                        source.requests_rejected += 1
+                    self._send_json(
+                        503, {"error": "source closed", "retry_after": 1},
+                        {"Retry-After": "1", "Connection": "close"})
+                    return
                 with source._lock:
                     source.requests_seen += 1
                 path_only = self.path.split("?", 1)[0]
@@ -176,7 +234,7 @@ class HTTPSource:
                 req = HTTPSchema.request(
                     self.path, "POST", body,
                     {k: v for k, v in self.headers.items()})
-                parked = _ParkedRequest(uuid_lib.uuid4().hex, req)
+                parked = _ParkedRequest(source._new_rid(), req)
                 with source._lock:
                     if len(source._pending) >= source.max_parked:
                         shed = True
@@ -186,6 +244,7 @@ class HTTPSource:
                 if shed:
                     self._shed("parked-request table full")
                     return
+                parked.enqueued_at = time.perf_counter()
                 try:
                     source.queue.put_nowait(parked)
                     with source._lock:
@@ -198,24 +257,32 @@ class HTTPSource:
                 resp = parked.wait(reply_timeout)
                 with source._lock:
                     source._pending.pop(parked.id, None)
-                if resp is None:
-                    self.send_error(504, "serving timeout")
+                try:
+                    if resp is None:
+                        self.send_error(504, "serving timeout")
+                        return
+                    code = resp["statusLine"]["statusCode"]
+                    entity = resp.get("entity") or b""
+                    if isinstance(entity, str):
+                        entity = entity.encode("utf-8")
+                    self.send_response(code)
+                    # framing/hop-by-hop headers are computed by this
+                    # server; forwarding pipeline-supplied ones would
+                    # duplicate/conflict
+                    _framing = {"content-length", "transfer-encoding",
+                                "connection"}
+                    for k, v in (resp.get("headers") or {}).items():
+                        if k.lower() not in _framing:
+                            self.send_header(k, v)
+                    self.send_header("Content-Length", str(len(entity)))
+                    self.end_headers()
+                    self.wfile.write(entity)
+                except OSError:
+                    # client gave up (timeout/disconnect) before the
+                    # reply flushed: fold the connection quietly instead
+                    # of killing the handler thread with a stack trace
+                    self.close_connection = True
                     return
-                code = resp["statusLine"]["statusCode"]
-                entity = resp.get("entity") or b""
-                if isinstance(entity, str):
-                    entity = entity.encode("utf-8")
-                self.send_response(code)
-                # framing/hop-by-hop headers are computed by this server;
-                # forwarding pipeline-supplied ones would duplicate/conflict
-                _framing = {"content-length", "transfer-encoding",
-                            "connection"}
-                for k, v in (resp.get("headers") or {}).items():
-                    if k.lower() not in _framing:
-                        self.send_header(k, v)
-                self.send_header("Content-Length", str(len(entity)))
-                self.end_headers()
-                self.wfile.write(entity)
                 with source._lock:
                     source.requests_answered += 1
 
@@ -246,7 +313,9 @@ class HTTPSource:
     def get_batch(self, max_rows: int = 64,
                   wait_s: float = 0.05) -> Tuple[DataTable, List[str]]:
         """Drain up to max_rows parked requests into a table
-        (ref: HTTPSource.getBatch)."""
+        (ref: HTTPSource.getBatch). Fixed-window poll — kept for the
+        synchronous ``process_one_batch`` API; the engine's hot path is
+        ``get_batch_adaptive``."""
         parked: List[_ParkedRequest] = []
         deadline = time.time() + wait_s
         while len(parked) < max_rows:
@@ -264,6 +333,71 @@ class HTTPSource:
                            "request": [p.request for p in parked]}),
                 [p.id for p in parked])
 
+    def drain_parked(self, max_rows: int, max_wait_s: float,
+                     poll_s: float = 0.05) -> List[_ParkedRequest]:
+        """Adaptive micro-batch drain (Clipper-style bounded queueing
+        delay): block until the FIRST request arrives (bounded by
+        ``poll_s`` so a stopping engine stays responsive), then flush as
+        soon as EITHER ``max_rows`` rows are collected OR ``max_wait_s``
+        has elapsed since that first request was picked up. A backed-up
+        queue therefore dispatches full batches with zero added wait,
+        while a lone request waits at most ``max_wait_s`` — unlike the
+        fixed-window ``get_batch``, which charged every cycle the full
+        window."""
+        try:
+            first = self.queue.get(timeout=poll_s)
+        except queue.Empty:
+            return []
+        first.dequeued_at = time.perf_counter()
+        parked: List[_ParkedRequest] = [first]
+        deadline = first.dequeued_at + max_wait_s
+        while len(parked) < max_rows:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                break
+            try:
+                p = self.queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            p.dequeued_at = time.perf_counter()
+            parked.append(p)
+        return parked
+
+    def top_up(self, parked: List[_ParkedRequest],
+               max_rows: int) -> bool:
+        """Absorb whatever is ALREADY queued into a pending batch, up to
+        ``max_rows`` — no waiting. Called by the batcher while it is
+        blocked on a full dispatch queue: rows that arrived meanwhile
+        ride along at zero added latency instead of forming a tiny
+        trailing batch (the continuous-batching half of the adaptive
+        policy). Returns True when anything was taken."""
+        took = False
+        while len(parked) < max_rows:
+            try:
+                p = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            p.dequeued_at = time.perf_counter()
+            parked.append(p)
+            took = True
+        return took
+
+    def get_batch_adaptive(
+            self, max_rows: int, max_wait_s: float,
+            poll_s: float = 0.05,
+    ) -> Tuple[DataTable, List[str], List[float]]:
+        """``drain_parked`` packaged as (table, ids, queue-waits) for
+        embedders that want the adaptive policy without managing parked
+        requests themselves."""
+        parked = self.drain_parked(max_rows, max_wait_s, poll_s)
+        if not parked:
+            return DataTable({"id": [], "request": []}), [], []
+        return (DataTable({"id": [p.id for p in parked],
+                           "request": [p.request for p in parked]}),
+                [p.id for p in parked],
+                [max(0.0, p.dequeued_at - p.enqueued_at)
+                 for p in parked])
+
     def respond(self, rid: str, response: Dict[str, Any]) -> bool:
         """Reply through the held connection (ref:
         DistributedHTTPSource.scala:188 server.respond(batch, uuid, …))."""
@@ -275,20 +409,48 @@ class HTTPSource:
         return True
 
     def close(self) -> None:
+        self._closed = True      # persistent connections shed + fold
         self.server.shutdown()
         self.server.server_close()
 
 
 class ServingEngine:
-    """The streaming loop: source → user pipeline → sink
-    (the structured-streaming query of ref: ServingImplicits.scala:10-50
-    ``readStream.server()…writeStream.server()``)."""
+    """The streaming loop: source → adaptive micro-batcher → user
+    pipeline → sink (the structured-streaming query of ref:
+    ServingImplicits.scala:10-50
+    ``readStream.server()…writeStream.server()``).
+
+    Request→device path (the serving hot path):
+
+    1. **Adaptive micro-batcher** — one batcher thread drains the
+       source queue, flushing a batch as soon as ``batch_size`` rows
+       are collected OR ``max_wait_ms`` has elapsed since the batch's
+       first request (bounded queueing delay; Clipper, NSDI'17).
+    2. **Two-stage pipeline** — when the pipeline exposes the
+       duck-typed ``prepare_batch``/``execute_prepared`` split (see
+       ``json_scoring_pipeline``), the batcher ALSO runs the host
+       decode/pad stage before handing the batch to a worker through a
+       bounded dispatch queue, so the next batch's host work overlaps
+       the current batch's device execution even at ``workers=1``.
+    3. **Workers** — N threads pop prepared batches and drive the
+       device + reply flush; ``workers > 1`` additionally overlaps one
+       batch's device round trip with another's reply flush (jit
+       dispatch is thread-safe). CONTRACT: pipeline.transform must be
+       thread-safe under workers > 1 (TPUModel is; a Lambda closing
+       over mutable state is only if it locks).
+
+    The whole path is instrumented with latency histograms
+    (queue-wait / decode / pipeline / respond, plus the model's own
+    pad / device split) exported through ``metrics()`` and /healthz.
+    """
 
     def __init__(self, source: HTTPSource, pipeline: Transformer,
                  reply_col: str = "reply", id_col: str = "id",
                  batch_size: int = 64,
                  content_type: str = "application/json",
-                 error_col: str = "error", workers: int = 1):
+                 error_col: str = "error", workers: int = 1,
+                 max_wait_ms: float = 5.0, pipeline_depth: int = 2):
+        from mmlspark_tpu.core.metrics import histogram_set
         self.source = source
         self.pipeline = pipeline
         self.reply_col = reply_col
@@ -296,22 +458,37 @@ class ServingEngine:
         self.batch_size = batch_size
         self.content_type = content_type
         self.error_col = error_col
-        # workers > 1 drains the queue from N loop threads, so batch
-        # N+1 assembles (and its replies flush) while batch N's device
-        # round-trip is in flight — the accelerator round-trip otherwise
-        # serializes the whole engine (jit dispatch is thread-safe).
-        # CONTRACT: pipeline.transform must itself be thread-safe under
-        # workers > 1 (TPUModel is; a Lambda closing over mutable state
-        # is only if it locks)
         self.workers = max(1, int(workers))
+        # batching policy: flush on batch_size rows OR max_wait_ms
+        # elapsed since the batch's first request, whichever first
+        self.max_wait_ms = float(max_wait_ms)
+        # in-flight gating: at most workers + (pipeline_depth - 1)
+        # batches past the batcher at once — every worker busy plus a
+        # bounded run-ahead of prepared batches. While no token is
+        # free (device saturated) the batcher keeps ABSORBING queued
+        # requests into the pending batch, so occupancy rises exactly
+        # when the device is the bottleneck; without the gate, a burst
+        # dispatches as many tiny batches as there are slots and pays
+        # the fixed per-batch cost once per row instead of per batch.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight = threading.Semaphore(
+            self.workers + self.pipeline_depth - 1)
+        self._dispatch_q: "queue.Queue[Tuple]" = queue.Queue()
+        # optional two-stage split (duck-typed; absent on plain stages)
+        self._prepare = getattr(pipeline, "prepare_batch", None)
+        self._execute = getattr(pipeline, "execute_prepared", None)
         self._stop = threading.Event()
         self._killed = threading.Event()   # chaos kill: no restart
         self._threads: List[threading.Thread] = []
+        self._batcher: Optional[threading.Thread] = None
         self._threads_lock = threading.Lock()
         self._supervisor: Optional[threading.Thread] = None
         self.batches_processed = 0
         self.workers_restarted = 0
         self._stats_lock = threading.Lock()
+        self.hists = histogram_set("queue_wait_ms", "decode_ms",
+                                   "pipeline_ms", "respond_ms",
+                                   "batch_rows")
 
     def _respond_ok(self, rid: str, rep: Any) -> None:
         body = rep if isinstance(rep, (bytes, str)) \
@@ -345,17 +522,35 @@ class ServingEngine:
                     500, "row dropped by pipeline", None))
 
     def process_one_batch(self, wait_s: float = 0.05) -> int:
+        """Synchronous one-shot drain (fixed poll window) — kept for
+        embedding/tests; a started engine runs the adaptive
+        batcher/worker pipeline instead."""
         table, ids = self.source.get_batch(self.batch_size, wait_s)
         if not ids:
             return 0
+        self._execute_batch(table, ids, None)
+        return len(ids)
+
+    def _execute_batch(self, table: DataTable, ids: List[str],
+                       prepped: Any) -> None:
+        """Stage 2 of the pipeline: device execution + reply flush for
+        one micro-batch (``prepped`` carries stage 1's decode output
+        when the pipeline supports the split)."""
+        t0 = time.perf_counter()
         try:
-            out = self.pipeline.transform(table)
+            if prepped is not None and self._execute is not None:
+                out = self._execute(table, prepped)
+            else:
+                out = self.pipeline.transform(table)
         except Exception as e:  # noqa: BLE001 — isolate the poison row(s)
             log.warning("serving batch failed (%s); retrying per-row", e)
             self._process_rows_individually(table, ids)
             with self._stats_lock:
                 self.batches_processed += 1
-            return len(ids)
+            return
+        self.hists["pipeline_ms"].observe(
+            (time.perf_counter() - t0) * 1e3)
+        t1 = time.perf_counter()
         try:
             self._answer_output(out, ids)
         except Exception as e:  # noqa: BLE001 — e.g. missing reply column
@@ -363,9 +558,10 @@ class ServingEngine:
             for rid in ids:
                 self.source.respond(rid, HTTPSchema.response(
                     500, f"reply error: {e}", None))
+        self.hists["respond_ms"].observe(
+            (time.perf_counter() - t1) * 1e3)
         with self._stats_lock:
             self.batches_processed += 1
-        return len(ids)
 
     def _process_rows_individually(self, table: DataTable,
                                    ids: List[str]) -> None:
@@ -382,27 +578,120 @@ class ServingEngine:
                 self.source.respond(rid, HTTPSchema.response(
                     500, f"pipeline error: {e}", None))
 
+    def _build_item(self, parked: List[_ParkedRequest]) -> Tuple:
+        """Assemble + (optionally) decode one collected batch: the host
+        half of the two-stage pipeline, run on the batcher thread."""
+        table = DataTable({"id": [p.id for p in parked],
+                           "request": [p.request for p in parked]})
+        ids = [p.id for p in parked]
+        prepped = None
+        if self._prepare is not None and self._execute is not None:
+            t0 = time.perf_counter()
+            try:
+                prepped = self._prepare(table)
+                self.hists["decode_ms"].observe(
+                    (time.perf_counter() - t0) * 1e3)
+            except Exception:  # noqa: BLE001 — poison rows can die in
+                # decode too: hand the batch over un-prepared so the
+                # worker's per-row retry isolates the offender
+                prepped = None
+        return table, ids, prepped
+
+    def _batcher_loop(self):
+        """Stage 1 of the pipeline: adaptive collect + (optional) host
+        decode/pad, feeding the bounded dispatch queue. While a worker
+        drives the device for batch N, this thread is already
+        collecting and decoding batch N+1 — host work overlaps device
+        work instead of serializing with it. While the dispatch queue
+        is full (workers saturated), the pending batch keeps absorbing
+        newly-queued requests up to batch_size, so batches grow toward
+        full occupancy exactly when the device is the bottleneck."""
+        while not self._stop.is_set():
+            try:
+                parked = self.source.drain_parked(
+                    self.batch_size, self.max_wait_ms / 1e3)
+            except Exception as e:  # noqa: BLE001 — keep collecting
+                log.error("serving batcher error (continuing): %s", e)
+                time.sleep(0.005)
+                continue
+            if not parked:
+                continue
+            # wait for an in-flight token, topping the pending batch up
+            # from the queue meanwhile: back-pressure converts directly
+            # into batch occupancy instead of tiny trailing batches
+            granted = False
+            while not self._stop.is_set():
+                if self._inflight.acquire(timeout=0.005):
+                    granted = True
+                    break
+                if len(parked) < self.batch_size:
+                    try:
+                        self.source.top_up(parked, self.batch_size)
+                    except Exception:  # noqa: BLE001 — source closing
+                        pass
+            if not granted:          # stopping — parked requests will
+                continue             # run out their reply timeout
+            # token ownership transfers to the worker ONLY on a
+            # successful put; any other exit (assembly failure, a
+            # respond() error, a BaseException killing this thread)
+            # must give it back, or each incident would permanently
+            # shrink the engine's dispatch budget
+            handed_off = False
+            try:
+                try:
+                    item = self._build_item(parked)
+                except Exception as e:  # noqa: BLE001
+                    log.error("batch assembly failed (%s); "
+                              "dropping to 500s", e)
+                    for p in parked:
+                        self.source.respond(p.id, HTTPSchema.response(
+                            500, f"batch assembly error: {e}", None))
+                    continue
+                self._dispatch_q.put(item)   # unbounded: tokens bound it
+                handed_off = True
+            finally:
+                if not handed_off:
+                    self._inflight.release()
+            for p in parked:
+                # dequeue stamp, not dispatch time: queue_wait must not
+                # absorb the token wait or the decode stage (decode_ms
+                # measures that) — the breakdown stays additive
+                self.hists["queue_wait_ms"].observe(
+                    max(0.0, p.dequeued_at - p.enqueued_at) * 1e3)
+            self.hists["batch_rows"].observe(float(len(parked)))
+
     def _worker_loop(self):
         while not self._stop.is_set():
             try:
-                n = self.process_one_batch()
+                item = self._dispatch_q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                self._execute_batch(*item)
             except Exception as e:  # noqa: BLE001 — keep serving
                 log.error("serving loop error (continuing): %s", e)
-                n = 0
-            if n == 0:
-                time.sleep(0.005)
+            finally:
+                # token back even when the thread is dying (SystemExit
+                # passes through): a leaked token would shrink the
+                # engine's in-flight budget forever
+                self._inflight.release()
 
     def _spawn_worker(self) -> threading.Thread:
         t = threading.Thread(target=self._worker_loop, daemon=True)
         t.start()
         return t
 
+    def _spawn_batcher(self) -> threading.Thread:
+        t = threading.Thread(target=self._batcher_loop, daemon=True)
+        t.start()
+        return t
+
     def _supervise(self, interval: float = 0.1):
-        """Liveness watchdog: a worker thread that dies (a BaseException
-        like SystemExit escaping the loop's Exception guard) is detected
-        and respawned, so one crashed drainer can't silently halve — or
-        zero — the engine's throughput. Chaos kills (``kill()``) and
-        normal ``stop()`` suppress restarts."""
+        """Liveness watchdog: a worker or batcher thread that dies (a
+        BaseException like SystemExit escaping the loop's Exception
+        guard) is detected and respawned, so one crashed thread can't
+        silently halve — or zero — the engine's throughput. Chaos kills
+        (``kill()``) and normal ``stop()`` suppress restarts."""
         while not self._stop.wait(interval):
             with self._threads_lock:
                 for i, t in enumerate(self._threads):
@@ -412,23 +701,55 @@ class ServingEngine:
                     self._threads[i] = self._spawn_worker()
                     with self._stats_lock:
                         self.workers_restarted += 1
+                if (self._batcher is not None
+                        and not self._batcher.is_alive()
+                        and not self._stop.is_set()):
+                    log.error("serving batcher died; restarting")
+                    self._batcher = self._spawn_batcher()
+                    with self._stats_lock:
+                        self.workers_restarted += 1
 
     def is_alive(self) -> bool:
-        """Engine liveness for /healthz: not killed and at least one
-        drainer thread running."""
+        """Engine liveness for /healthz: not killed, batcher running
+        (when started), and at least one worker thread running."""
         if self._killed.is_set() or self._stop.is_set():
             return False
         with self._threads_lock:
-            return any(t.is_alive() for t in self._threads)
+            workers_ok = any(t.is_alive() for t in self._threads)
+            batcher_ok = (self._batcher is None
+                          or self._batcher.is_alive())
+        return workers_ok and batcher_ok
+
+    def metrics(self) -> Dict[str, Any]:
+        """Hot-path latency breakdown: engine histograms (queue wait,
+        decode, pipeline, respond, batch occupancy) plus whatever the
+        pipeline exposes through a duck-typed ``metrics`` hook
+        (TPUModel adds its pad/device split and the jit-cache-miss
+        counter). Exported on /healthz."""
+        with self._stats_lock:
+            out: Dict[str, Any] = {
+                "batches_processed": self.batches_processed,
+                "workers_restarted": self.workers_restarted,
+            }
+        out.update({k: h.summary() for k, h in self.hists.items()})
+        stage = getattr(self.pipeline, "metrics", None)
+        if callable(stage):
+            try:
+                out["pipeline_stage"] = stage()
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
+        return out
 
     def start(self) -> "ServingEngine":
         with self._threads_lock:
+            self._batcher = self._spawn_batcher()
             self._threads = [self._spawn_worker()
                              for _ in range(self.workers)]
         self._supervisor = threading.Thread(target=self._supervise,
                                             daemon=True)
         self._supervisor.start()
         self.source.health_probe = self.is_alive
+        self.source.metrics_probe = self.metrics
         return self
 
     def kill(self, close_source: bool = True) -> None:
@@ -448,6 +769,8 @@ class ServingEngine:
             self._supervisor.join(timeout=5)
         with self._threads_lock:
             threads = list(self._threads)
+            if self._batcher is not None:
+                threads.append(self._batcher)
         for t in threads:
             t.join(timeout=5)
         try:
@@ -459,12 +782,17 @@ class ServingEngine:
 def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
                 port: int = 8899, batch_size: int = 64,
                 reply_col: str = "reply",
-                workers: int = 1) -> ServingEngine:
+                workers: int = 1, max_wait_ms: float = 5.0,
+                pipeline_depth: int = 2) -> ServingEngine:
     """One-call serving: the ``.server()`` DSL analog
-    (ref: ServingImplicits.scala:10-50). ``workers`` > 1 overlaps the
-    accelerator round-trip of one micro-batch with the assembly of the
-    next; the pipeline's ``transform`` must then be thread-safe
-    (TPUModel is)."""
+    (ref: ServingImplicits.scala:10-50). Batches flush on
+    ``batch_size`` rows or ``max_wait_ms`` elapsed, whichever first;
+    the batcher thread decodes/pads the next batch while a worker
+    drives the device for the current one. ``workers`` > 1 additionally
+    overlaps device round-trips; the pipeline's ``transform`` must then
+    be thread-safe (TPUModel is)."""
     source = HTTPSource(host=host, port=port)
     return ServingEngine(source, pipeline, reply_col=reply_col,
-                         batch_size=batch_size, workers=workers).start()
+                         batch_size=batch_size, workers=workers,
+                         max_wait_ms=max_wait_ms,
+                         pipeline_depth=pipeline_depth).start()

@@ -525,3 +525,210 @@ class TestServingThroughput:
         assert n_shapes <= 6, (
             f"batch bucketing lost: {n_shapes} distinct compiled "
             f"shapes for 20 ragged batch sizes")
+
+
+class TestAdaptiveBatcher:
+    """The adaptive micro-batcher contract: flush on batch-full OR
+    deadline (whichever first), padded rows never leak into replies,
+    and the /healthz metrics export carries the latency histograms."""
+
+    def test_deadline_triggered_flush(self):
+        # a lone request must NOT wait for batch_size rows: the
+        # max_wait_ms deadline flushes a 1-row batch
+        def handle(table):
+            return table.with_column("reply", [
+                {"echo": json.loads(r["entity"].decode())["x"]}
+                for r in table["request"]])
+
+        engine = serve_model(Lambda.apply(handle), port=19200,
+                             batch_size=64, max_wait_ms=30.0)
+        try:
+            import time as _time
+            t0 = _time.perf_counter()
+            status, body = _post(engine.source.address, {"x": 7})
+            dt = _time.perf_counter() - t0
+            assert status == 200 and body == {"echo": 7}
+            # deadline (30 ms) + service, nowhere near a full-batch wait
+            assert dt < 5.0, f"deadline flush took {dt:.2f}s"
+            assert engine.batches_processed >= 1
+            assert engine.hists["batch_rows"].summary()["max"] == 1.0
+        finally:
+            engine.stop()
+
+    def test_max_batch_triggered_flush(self):
+        # batch_size concurrent requests must flush IMMEDIATELY on
+        # filling the batch, long before a (deliberately huge) deadline
+        import time as _time
+        done = threading.Event()
+
+        def handle(table):
+            return table.with_column("reply", [
+                {"echo": json.loads(r["entity"].decode())["x"]}
+                for r in table["request"]])
+
+        engine = serve_model(Lambda.apply(handle), port=19205,
+                             batch_size=4, max_wait_ms=10_000.0)
+        try:
+            results = {}
+
+            def client(i):
+                results[i] = _post(engine.source.address, {"x": i},
+                                   timeout=30)[1]["echo"]
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            t0 = _time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = _time.perf_counter() - t0
+            assert results == {i: i for i in range(4)}
+            # a deadline-only flush would have taken >= 10 s
+            assert wall < 5.0, f"max-batch flush took {wall:.1f}s"
+        finally:
+            engine.stop()
+            done.set()
+
+    def test_pad_and_mask_correctness(self):
+        # bucket padding must never leak: N concurrent requests with
+        # DISTINCT payloads each get exactly their own model output,
+        # and exactly N replies exist (padded rows are sliced off)
+        import jax
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+
+        dim = 8
+        module = build_network({"type": "mlp", "features": [16],
+                                "num_classes": 5})
+        weights = {"params": module.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, dim), np.float32))["params"]}
+        model = TPUModel(modelFn=lambda w, ins: module.apply(
+            {"params": w["params"]}, list(ins.values())[0]),
+            weights=weights, inputCol="features", outputCol="scores",
+            batchSize=64, computeDtype="float32")
+        rng = np.random.default_rng(3)
+        feats = rng.normal(size=(5, dim)).astype(np.float32)   # pads to 8
+        expected = np.asarray(module.apply(
+            {"params": weights["params"]}, feats)).argmax(-1)
+
+        engine = serve_model(json_scoring_pipeline(model), port=19210,
+                             batch_size=64, max_wait_ms=50.0)
+        try:
+            results = {}
+
+            def client(i):
+                results[i] = _post(
+                    engine.source.address,
+                    {"features": feats[i].tolist()},
+                    timeout=60)[1]["prediction"]
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(5)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert results == {i: int(expected[i]) for i in range(5)}, (
+                f"padded-batch replies wrong: {results} vs {expected}")
+            # exactly the accepted requests were answered — no padded
+            # phantom replies
+            assert engine.source.requests_answered == 5
+        finally:
+            engine.stop()
+
+    def test_healthz_exports_latency_histograms(self):
+        def handle(table):
+            return table.with_column(
+                "reply", [{"ok": 1} for _ in table["request"]])
+
+        engine = serve_model(Lambda.apply(handle), port=19215,
+                             batch_size=8, max_wait_ms=5.0)
+        try:
+            _post(engine.source.address, {"x": 1})
+            with urllib.request.urlopen(
+                    f"{engine.source.address}/healthz", timeout=5) as r:
+                body = json.loads(r.read())
+            m = body["metrics"]
+            for key in ("queue_wait_ms", "pipeline_ms", "respond_ms",
+                        "batch_rows"):
+                assert key in m, m
+            assert m["queue_wait_ms"]["count"] >= 1
+            assert m["pipeline_ms"]["count"] >= 1
+            assert m["batches_processed"] >= 1
+        finally:
+            engine.stop()
+
+    def test_split_pipeline_decode_runs_on_batcher(self):
+        # a pipeline exposing prepare_batch/execute_prepared must see
+        # its decode stage run (decode_ms histogram fills) and still
+        # answer correctly
+        calls = []
+
+        def decode(table):
+            calls.append(len(table))
+            return [json.loads(r["entity"].decode())["x"]
+                    for r in table["request"]]
+
+        def execute(table, xs):
+            return table.with_column("reply", [{"doubled": 2 * x}
+                                               for x in xs])
+
+        lam = Lambda.apply(
+            lambda table: execute(table, decode(table)))
+        lam.prepare_batch = decode
+        lam.execute_prepared = execute
+        engine = serve_model(lam, port=19220, batch_size=8,
+                             max_wait_ms=5.0)
+        try:
+            assert _post(engine.source.address, {"x": 4})[1] == \
+                {"doubled": 8}
+            assert engine.hists["decode_ms"].summary()["count"] >= 1
+            assert calls, "prepare_batch never ran"
+        finally:
+            engine.stop()
+
+    def test_get_batch_adaptive_embedder_api(self):
+        # the packaged adaptive drain for embedders running their own
+        # loop: flushes on max_rows, reports per-request queue waits,
+        # and returns empty cleanly on an idle queue
+        src = HTTPSource(port=19230)
+        try:
+            results = {}
+
+            def client(i):
+                try:
+                    results[i] = _post(
+                        f"http://127.0.0.1:{src.port}/", {"x": i},
+                        timeout=10)[1]
+                except Exception as e:  # noqa: BLE001
+                    results[i] = repr(e)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            deadline = __import__("time").time() + 5
+            got = 0
+            while got < 3 and __import__("time").time() < deadline:
+                table, ids, waits = src.get_batch_adaptive(
+                    max_rows=3, max_wait_s=0.05)
+                assert len(ids) == len(table) == len(waits)
+                assert all(w >= 0.0 for w in waits)
+                for rid in ids:
+                    src.respond(rid, HTTPSchema.response(
+                        200, "OK", b'{"ok": 1}',
+                        {"Content-Type": "application/json"}))
+                got += len(ids)
+            for t in threads:
+                t.join(timeout=10)
+            assert got == 3
+            assert results == {i: {"ok": 1} for i in range(3)}, results
+            # idle queue: clean empty drain
+            table, ids, waits = src.get_batch_adaptive(
+                max_rows=3, max_wait_s=0.01, poll_s=0.01)
+            assert ids == [] and waits == [] and len(table) == 0
+        finally:
+            src.close()

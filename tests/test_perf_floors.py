@@ -83,3 +83,73 @@ class TestGBDTWallFloor:
         # and the model it produced is real, not degenerate
         acc = ((booster.predict(X) > 0.5) == y).mean()
         assert acc > 0.9, acc
+
+
+class TestServingQPSFloor:
+    def test_serving_qps_floor(self):
+        """Serving hot-path floor (adaptive micro-batching + bucketed
+        compile cache + pipelined dispatch): guards against regressions
+        that re-serialize the request->device path — per-request
+        recompiles, lost keep-alive, a batcher that stops aggregating —
+        while riding out shared-host noise. bench.py's serving scenario
+        measures the real-chip number; this is the machinery guard."""
+        import concurrent.futures
+        import json
+
+        import jax
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+
+        dim, n_req, clients = 32, 120, 8
+        module = build_network({"type": "mlp", "features": [32],
+                                "num_classes": 4})
+        weights = {"params": module.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, dim), np.float32))["params"]}
+        model = TPUModel(modelFn=lambda w, ins: module.apply(
+            {"params": w["params"]}, list(ins.values())[0]),
+            weights=weights, inputCol="features", outputCol="scores",
+            batchSize=64, computeDtype="float32")
+        # the serving contract: warm every bucket BEFORE traffic
+        model.warmup({"features": np.zeros((1, dim), np.float32)})
+
+        fleet = ServingFleet(json_scoring_pipeline(model), n_engines=2,
+                             base_port=18860, batch_size=64, workers=2,
+                             max_wait_ms=6.0)
+        body = json.dumps({"features": [0.1] * dim}).encode()
+
+        def post(_):
+            t0 = time.perf_counter()
+            out = fleet.post(body, timeout=60)
+            assert "prediction" in out, out
+            return time.perf_counter() - t0
+
+        try:
+            for _ in range(8):
+                post(0)
+            misses_before = model.jit_cache_misses
+            lat = []
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(clients) as ex:
+                futs = [ex.submit(post, i) for i in range(n_req)]
+                for f in concurrent.futures.as_completed(futs):
+                    lat.append(f.result())
+            wall = time.perf_counter() - t0
+            recompiles = model.jit_cache_misses - misses_before
+        finally:
+            fleet.stop_all()
+        qps = n_req / wall
+        p50 = float(np.quantile(lat, 0.5))
+        # idle 1-2 core host measures 145-263 qps / p50 26-52 ms on
+        # this config across trials; floors sit well below the worst
+        # observed so shared-host noise passes, while a re-serialized
+        # hot path (per-request reconnects, lost batcher pipelining)
+        # still fails by a wide margin
+        assert qps >= 60, f"serving throughput floor: {qps:.1f} qps"
+        assert p50 <= 0.35, f"serving p50 floor: {p50 * 1e3:.0f} ms"
+        # the bucketed compile cache held: NO steady-state recompiles
+        assert recompiles == 0, (
+            f"{recompiles} recompile(s) during steady-state serving")

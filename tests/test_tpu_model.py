@@ -168,3 +168,64 @@ def test_int_token_model_inputs_stay_integer():
     model.set("computeDtype", "bfloat16")
     out2 = model.transform(DataTable({"tokens": toks.astype(np.int64)}))
     assert out2["tags"].shape == (10, 6, 3)
+
+
+class TestShapeBuckets:
+    """The serving compile-cache contract: explicit warmup compiles one
+    executable per bucket, and steady-state traffic at ANY mix of batch
+    sizes triggers ZERO further compiles (the recompile guard of the
+    serving hot path — one stray XLA compile costs seconds through a
+    real-chip tunnel)."""
+
+    def _one_device_model(self, batch_size=64, dim=12):
+        module, params, _ = None, None, None
+        m = TinyMLP()
+        params = m.init(jax.random.PRNGKey(0), jnp.ones((1, dim)))
+        model = TPUModel.from_flax(m, params, inputCol="features",
+                                   outputCol="scores",
+                                   batchSize=batch_size)
+        # 1-device mesh = the single-chip serving topology (the CI
+        # 8-device mesh pads every batch to a multiple of 8, which
+        # would mask a lost bucket)
+        model.set_mesh(mesh_lib.make_mesh(
+            {"data": 1}, devices=[jax.devices()[0]]))
+        return model, dim
+
+    def test_bucket_sizes_cover_batch_size(self):
+        model, _ = self._one_device_model(batch_size=64)
+        assert model.bucket_sizes() == [8, 16, 32, 64]
+        model.set("batchSize", 48)        # non-power-of-two cap kept
+        assert model.bucket_sizes() == [8, 16, 32, 48]
+
+    def test_warmup_compiles_each_bucket_once(self):
+        model, dim = self._one_device_model()
+        compiles = model.warmup(
+            {"features": np.zeros((1, dim), np.float32)})
+        assert compiles == len(model.bucket_sizes())
+        # warm again: everything cached
+        assert model.warmup(
+            {"features": np.zeros((1, dim), np.float32)}) == 0
+
+    def test_steady_state_zero_recompiles_across_mixed_batch_sizes(self):
+        model, dim = self._one_device_model()
+        model.warmup({"features": np.zeros((1, dim), np.float32)})
+        before = model.jit_cache_misses
+        rng = np.random.default_rng(0)
+        for rows in [1, 3, 8, 9, 17, 33, 64, 5, 50, 64, 2, 40, 31, 12]:
+            t = DataTable({"features": rng.normal(
+                size=(rows, dim)).astype(np.float32)})
+            out = model.transform(t)
+            assert len(out) == rows
+        assert model.jit_cache_misses == before, (
+            f"steady-state serving recompiled "
+            f"{model.jit_cache_misses - before} time(s) across mixed "
+            f"batch sizes — the bucket layer lost its shape cache")
+
+    def test_metrics_expose_pad_device_and_misses(self):
+        model, dim = self._one_device_model()
+        model.transform(DataTable({"features": np.zeros(
+            (4, dim), np.float32)}))
+        m = model.metrics()
+        assert m["jit_cache_misses"] >= 1
+        assert m["pad_ms"]["count"] >= 1
+        assert m["device_ms"]["count"] >= 1
