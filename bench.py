@@ -209,8 +209,12 @@ def bench_higgs_gbdt():
     """Timed HIGGS-shaped training at BOTH 63 bins (the LightGBM HIGGS
     benchmark config, headline) and 255 bins (the engine default —
     exercises the Pallas kernel's larger VMEM tiling band). Each wall
-    comes with the booster's per-phase breakdown (bin/ship/first_iter/
-    boost/fetch) so driver-side drift is attributable to a phase."""
+    comes with the booster's per-phase breakdown (bin/ship[/bin_device]/
+    first_iter/boost/fetch) plus the ingest path (bin_device vs
+    bin_host) and fused-chunk length, so driver-side drift is
+    attributable to a phase. The 63-bin config also runs once with
+    device binning forced OFF so the device-vs-host ingest saving is
+    measured, not assumed."""
     from sklearn.metrics import roc_auc_score
 
     from mmlspark_tpu.gbdt.booster import train
@@ -225,23 +229,38 @@ def bench_higgs_gbdt():
     Xtr, ytr = X[:HIGGS_N], y[:HIGGS_N]
     Xte, yte = X[HIGGS_N:], y[HIGGS_N:]
 
+    def _timed(params):
+        # one-chunk warmup at the FULL training shape isolates XLA
+        # compile from the measured train (jit caches are shape-keyed;
+        # the explicit boost_chunk=8 compiles the SAME fused-chunk
+        # program the 40-iteration measured run dispatches — a 1-iter
+        # warmup would compile the length-1 chunk instead and leave the
+        # measured wall paying the length-8 compile)
+        train({**params, "num_iterations": 8, "boost_chunk": 8},
+              Xtr, ytr)
+        t0 = time.time()
+        booster = train(params, Xtr, ytr)
+        wall = time.time() - t0
+        entry = {"wall_s": round(wall, 2),
+                 "phases": booster.train_timing,
+                 "bin_path": booster.train_info.get("bin_path"),
+                 "boost_chunk": booster.train_info.get("boost_chunk")}
+        return entry, booster
+
     out = {}
     auc = None
     for max_bin in (63, 255):
         params = {"objective": "binary", "num_iterations": 40,
                   "num_leaves": 63, "max_bin": max_bin,
                   "min_data_in_leaf": 50}
-        # one-iteration warmup at the FULL training shape isolates XLA
-        # compile from the measured train (jit caches are shape-keyed)
-        train({**params, "num_iterations": 1}, Xtr, ytr)
-        t0 = time.time()
-        booster = train(params, Xtr, ytr)
-        wall = time.time() - t0
-        out[max_bin] = {"wall_s": round(wall, 2),
-                        "phases": booster.train_timing}
+        out[max_bin], booster = _timed(params)
         if max_bin == 63:
             auc = roc_auc_score(yte, booster.predict(Xte))
             hist_method = booster.params["hist_method"]
+            # host-binning comparison point: same config, ingest forced
+            # to the host kernels (bin+ship delta = the device saving)
+            out["host_bin_63"], _ = _timed(
+                {**params, "device_binning": "off"})
     return out, auc, hist_method
 
 
@@ -393,6 +412,9 @@ def main():
             "hist_method": hist_method,
             "config": f"{HIGGS_N}x{HIGGS_F}, 63 leaves, 63 bins, 40 iters",
             "phases": higgs[63]["phases"],
+            "bin_path": higgs[63]["bin_path"],
+            "boost_chunk": higgs[63]["boost_chunk"],
+            "host_bin_63": higgs["host_bin_63"],
             "max_bin_255": higgs[255],
         },
     }

@@ -165,3 +165,27 @@ def histogram_set(*names: str) -> Dict[str, LatencyHistogram]:
     """A named family of histograms (one allocation site for the
     serving engine / model instrumentation)."""
     return {n: LatencyHistogram() for n in names}
+
+
+# ---------------------------------------------------------------------------
+# GBDT training-phase histograms
+# ---------------------------------------------------------------------------
+
+# per-phase wall milliseconds across train() calls in this process:
+# bin (host staging / host binning), ship (H2D), bin_device (on-device
+# bucketize kernel), first_iter (compile + first chunk), boost
+# (remaining chunks), boost_chunk (host dispatch-enqueue wall per fused
+# chunk AFTER the first — back-pressure shows up here, device execution
+# does not; the compile-bearing first chunk lands under first_iter),
+# fetch (forest D2H). The booster observes into these at the end of
+# every train(); exporters read them like the serving engine's latency
+# family.
+GBDT_TRAIN_PHASES = ("bin", "ship", "bin_device", "first_iter", "boost",
+                     "boost_chunk", "fetch")
+_GBDT_TRAIN_HISTS: Dict[str, LatencyHistogram] = histogram_set(
+    *GBDT_TRAIN_PHASES)
+
+
+def gbdt_train_histograms() -> Dict[str, LatencyHistogram]:
+    """The process-wide GBDT training-phase histogram family."""
+    return _GBDT_TRAIN_HISTS

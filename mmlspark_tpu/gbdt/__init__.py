@@ -2,14 +2,17 @@
 
 Re-creation of the capabilities of the reference's distributed LightGBM
 wrapper (ref: src/lightgbm/src/main/scala/*) as a TPU-first engine:
-quantile binning on host, histogram building and leaf-wise tree growth as
-jitted XLA programs (one-hot/matmul histograms on the MXU), and
-data-parallel training via shard_map + psum of histograms over the mesh —
-the ICI-collective analog of LightGBM's socket allreduce ring
+quantile binning fitted on host and applied on DEVICE when f32-safe
+(raw feature blocks + jitted searchsorted; host kernels otherwise),
+histogram building and leaf-wise tree growth as jitted XLA programs
+(one-hot/matmul histograms on the MXU) batched ``boost_chunk``
+iterations per dispatch via lax.scan, and data-parallel training via
+shard_map + psum of histograms over the mesh — the ICI-collective
+analog of LightGBM's socket allreduce ring
 (ref: TrainUtils.scala:207 LGBM_NetworkInit).
 """
 
-from mmlspark_tpu.gbdt.binning import BinMapper
+from mmlspark_tpu.gbdt.binning import BinMapper, bucketize_fm_device
 from mmlspark_tpu.gbdt.booster import Booster, train
 from mmlspark_tpu.gbdt.estimators import (
     TPUBoostClassificationModel,
@@ -19,7 +22,7 @@ from mmlspark_tpu.gbdt.estimators import (
 )
 
 __all__ = [
-    "BinMapper", "Booster", "train",
+    "BinMapper", "Booster", "bucketize_fm_device", "train",
     "TPUBoostClassifier", "TPUBoostClassificationModel",
     "TPUBoostRegressor", "TPUBoostRegressionModel",
 ]

@@ -1,4 +1,4 @@
-"""Quantile feature binning (host side).
+"""Quantile feature binning.
 
 Analog of LightGBM's BinMapper construction, which the reference drives
 through ``LGBM_DatasetCreateFromMat`` (ref: src/lightgbm/src/main/scala/
@@ -6,16 +6,80 @@ LightGBMUtils.scala:283-351): continuous features are discretized into at
 most ``max_bin`` equal-frequency bins; the binned matrix is what the
 histogram kernels consume on device.
 
-Host/numpy by design: binning is a one-time O(N·F) preprocessing pass
-(sort-based), exactly the part LightGBM also keeps on CPU. The output is a
-small int matrix that ships to HBM once.
+Boundary FITTING is host/numpy by design: it is a one-time sort-based
+pass over a bounded sample, exactly the part LightGBM also keeps on CPU.
+APPLYING the bins has two paths:
+
+- device (``bucketize_fm_device``): raw float32 feature blocks ship to
+  the accelerator and a jitted vectorized ``searchsorted`` against the
+  padded ``(F, B)`` bounds matrix assigns bins there — eligible when
+  ``f32_safe()`` certifies that float32 compares reproduce the float64
+  assignment. NaN→bin 0 and ±inf land exactly where ``transform`` puts
+  them.
+- host (``transform*``): the native OpenMP kernel when built, else ONE
+  vectorized numpy code path (``_numpy_bin_block``) shared by every
+  transform variant, parallelized over feature blocks on a thread pool
+  (numpy's searchsorted releases the GIL) so f32-unsafe / CSR /
+  streaming ingest still scales with host cores.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
+import functools
+import os
+import threading
 from typing import List, Optional
 
 import numpy as np
+
+# host-side fallback binning parallelism: engage the pool only when the
+# block is big enough that thread handoff is noise (cells = rows*features)
+_POOL_MIN_CELLS = 2_000_000
+_pool_lock = threading.Lock()
+_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+
+
+def _bin_pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _pool
+    with _pool_lock:
+        if _pool is None:
+            _pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max(1, min(8, os.cpu_count() or 1)),
+                thread_name_prefix="mml-bin")
+        return _pool
+
+
+def _reset_pool_after_fork() -> None:
+    """A forked child inherits the executor object but NOT its worker
+    threads — submit() would enqueue forever. Drop the reference so the
+    child lazily builds a fresh pool (the jax/loky at-fork pattern)."""
+    global _pool
+    _pool = None
+
+
+if hasattr(os, "register_at_fork"):   # POSIX only
+    os.register_at_fork(after_in_child=_reset_pool_after_fork)
+
+
+def _fanout_feature_blocks(run, j0: int, j1: int, n_rows: int,
+                           workers: Optional[int] = None) -> None:
+    """Fan ``run(a, b)`` (features [a, b), disjoint writes) over the
+    shared thread pool; serial when the block is too small for thread
+    handoff to pay (cells = rows * features)."""
+    span = j1 - j0
+    if workers is None:
+        workers = (min(os.cpu_count() or 1, 8, span)
+                   if n_rows * span >= _POOL_MIN_CELLS else 1)
+    workers = max(1, min(workers, span))
+    if workers > 1:
+        step = -(-span // workers)
+        futs = [_bin_pool().submit(run, a, min(a + step, j1))
+                for a in range(j0, j1, step)]
+        for fut in futs:
+            fut.result()   # propagate the first worker exception
+    else:
+        run(j0, j1)
 
 
 class BinMapper:
@@ -28,13 +92,23 @@ class BinMapper:
     """
 
     def __init__(self, upper_bounds: List[np.ndarray], max_bin: int,
-                 f32_values_safe: bool = False):
+                 f32_values_safe: bool = False,
+                 f32_cuts_exact: bool = False):
         self.upper_bounds = [np.asarray(u, dtype=np.float64)
                              for u in upper_bounds]
         self.max_bin = int(max_bin)
         # computed at fit time from TRUE data gaps (see _feature_bounds);
         # conservative False for mappers restored without the flag
         self.f32_values_safe = bool(f32_values_safe)
+        # True only when cuts were SNAPPED to f32-representable values
+        # for f32-representable input (_snap_cuts_f32): the regime where
+        # f32 binning equals f64 binning for EVERY row by construction,
+        # not just the sampled+holdout-certified ones. This is the
+        # device-binning gate; f32_values_safe alone still gates the f32
+        # inference walk (its residual unsampled-row band is accepted
+        # there, but training bins must be reproducible across ingest
+        # paths).
+        self.f32_cuts_exact = bool(f32_cuts_exact)
 
     @property
     def num_features(self) -> int:
@@ -53,6 +127,18 @@ class BinMapper:
         # matrix first — without materializing a second full-size copy
         X_full = np.asarray(X)
         n, f = X_full.shape
+        # float32 input: snap every cut DOWN to the largest
+        # f32-representable value <= the cut. Comparing an
+        # f32-representable value against such a cut gives the SAME
+        # answer in f32 and f64 AND the same answer the unsnapped f64
+        # cut gives (see _snap_cuts_f32), so binning is bit-exact in
+        # f32 BY CONSTRUCTION with no split-resolution loss — no margin
+        # heuristic needed,
+        # and the on-device f32 bucketize path stays eligible at any
+        # data scale (the gap margin rejects ~every 1M-row continuous
+        # feature: equal-frequency cuts land between samples a few ulps
+        # apart somewhere among F*B cuts).
+        f32_exact = X_full.dtype == np.float32
         sampled_idx = None
         if n > sample_cnt:
             rng = np.random.default_rng(seed)
@@ -60,10 +146,11 @@ class BinMapper:
             X = np.asarray(X_full[sampled_idx], dtype=np.float64)
         else:
             X = np.asarray(X_full, dtype=np.float64)
-        results = [_feature_bounds(X[:, j], max_bin) for j in range(f)]
+        results = [_feature_bounds(X[:, j], max_bin, f32_exact)
+                   for j in range(f)]
         bounds = [b for b, _ in results]
         safe = all(ok for _, ok in results)
-        if safe and sampled_idx is not None:
+        if safe and not f32_exact and sampled_idx is not None:
             # the gap-based safety above is certified on the SAMPLE only;
             # unsampled rows inside a cut's f32 rounding band could still
             # flip one bin on the f32 device path. Spot-check a holdout of
@@ -72,7 +159,8 @@ class BinMapper:
             hold = X_full[rest]
             safe = _holdout_f32_agrees(
                 bounds, ((j, hold[:, j]) for j in range(f)))
-        return BinMapper(bounds, max_bin, f32_values_safe=safe)
+        return BinMapper(bounds, max_bin, f32_values_safe=safe,
+                         f32_cuts_exact=f32_exact)
 
     @staticmethod
     def fit_sparse(csr, max_bin: int = 255, sample_cnt: int = 200_000,
@@ -83,11 +171,13 @@ class BinMapper:
         matrix ever exists (the LGBM_DatasetCreateFromCSR analog,
         ref: LightGBMUtils.scala:283-351).
 
-        f32 safety mirrors the dense fit: the gap check runs on the
-        sample, and when sampling occurred a holdout of UNSAMPLED rows
-        is spot-checked (f32 vs f64 binning) before the f32 inference
-        walk is allowed."""
+        f32 safety mirrors the dense fit: float32 nonzeros get
+        f32-representable cuts (bit-exact in f32 by construction);
+        otherwise the gap check runs on the sample, and when sampling
+        occurred a holdout of UNSAMPLED rows is spot-checked (f32 vs
+        f64 binning) before the f32 inference walk is allowed."""
         full = csr
+        f32_exact = np.asarray(csr.data).dtype == np.float32
         n_full = csr.shape[0]
         n = n_full
         sampled_idx = None
@@ -113,10 +203,10 @@ class BinMapper:
                     distinct = np.insert(distinct, pos, 0.0)
                     counts = np.insert(counts, pos, zeros)
             b, ok = _bounds_from_counts(np.asarray(distinct, np.float64),
-                                        counts, max_bin)
+                                        counts, max_bin, f32_exact)
             bounds.append(b)
             safe = safe and ok
-        if safe and sampled_idx is not None:
+        if safe and not f32_exact and sampled_idx is not None:
             # same unsampled-row holdout discipline as the dense fit:
             # values inside a cut's f32 rounding band flip one bin on
             # the f32 device path — verify none exist before claiming
@@ -126,47 +216,103 @@ class BinMapper:
             safe = _holdout_f32_agrees(
                 bounds, ((j, hold_vals[hold_ptr[j]:hold_ptr[j + 1]])
                          for j in range(csr.shape[1])))
-        return BinMapper(bounds, max_bin, f32_values_safe=safe)
+        return BinMapper(bounds, max_bin, f32_values_safe=safe,
+                         f32_cuts_exact=f32_exact)
 
     def transform_sparse(self, csr) -> np.ndarray:
         """CSRMatrix -> FEATURES-MAJOR (F, N) int32 bins without a dense
         float matrix: every row starts in its feature's zero bin, then
-        only the nonzeros are re-binned via searchsorted."""
+        only the nonzeros are re-binned via searchsorted. Feature
+        blocks fan out over the shared thread pool (each worker writes
+        disjoint ``out`` rows), so CSR ingest scales with host cores
+        like the dense fallback."""
         n, f = csr.shape
         out = np.empty((f, n), np.int32)
         col_ptr, rows, vals = csr.csc()
-        for j in range(f):
-            ub = self.upper_bounds[j]
-            out[j, :] = np.searchsorted(ub, 0.0, side="left")
-            lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
-            if hi > lo:
-                b = np.searchsorted(ub, vals[lo:hi], side="left"
-                                    ).astype(np.int32)
-                b[np.isnan(vals[lo:hi])] = 0
-                out[j, rows[lo:hi]] = b
+
+        def run(a: int, b_: int) -> None:
+            for j in range(a, b_):
+                ub = self.upper_bounds[j]
+                out[j, :] = np.searchsorted(ub, 0.0, side="left")
+                lo, hi = int(col_ptr[j]), int(col_ptr[j + 1])
+                if hi > lo:
+                    b = np.searchsorted(ub, vals[lo:hi], side="left"
+                                        ).astype(np.int32)
+                    b[np.isnan(vals[lo:hi])] = 0
+                    out[j, rows[lo:hi]] = b
+
+        _fanout_feature_blocks(run, 0, f, n)
+        return out
+
+    @staticmethod
+    def _native_available() -> bool:
+        try:
+            from mmlspark_tpu.native import loader as native
+            return bool(native.available())
+        except Exception:  # noqa: BLE001 — native is only an accelerator
+            return False
+
+    def _native_bins(self, X: np.ndarray,
+                     feature_range: Optional[tuple] = None,
+                     transposed: bool = True) -> Optional[np.ndarray]:
+        """The SINGLE dispatch point for the native OpenMP binning
+        kernels (mml_apply_bins / mml_apply_bins_t_u8[_range]); returns
+        None when the library or the kernel precondition is
+        unavailable so callers fall through to the shared numpy path."""
+        try:
+            from mmlspark_tpu.native import loader as native
+            if not native.available():
+                return None
+            if transposed:
+                return native.apply_bins_t_u8(X, self.upper_bounds,
+                                              feature_range=feature_range)
+            return native.apply_bins(X, self.upper_bounds)
+        except Exception:  # noqa: BLE001 — native is only an accelerator
+            return None
+
+    def _numpy_bin_block(self, X: np.ndarray, j0: int, j1: int,
+                         workers: Optional[int] = None,
+                         out: Optional[np.ndarray] = None) -> np.ndarray:
+        """THE numpy binning code path: features [j0, j1) ->
+        features-major (j1-j0, N) int32, each column widened to f64
+        before the boundary compare so results are bit-identical to the
+        historical per-variant loops this unifies. Large blocks fan out
+        over a feature-block thread pool (np.searchsorted and the f64
+        widen both release the GIL), so the host fallback — f32-unsafe
+        mappers, CSR, streaming shards — scales with host cores.
+        ``out``: optional (j1-j0, N) int target written in place — a
+        transposed view lets transform() fill its row-major output
+        without a second full-matrix copy."""
+        n = X.shape[0]
+        span = j1 - j0
+        if out is None:
+            out = np.empty((span, n), np.int32)
+
+        def run(a: int, b: int) -> None:
+            for j in range(a, b):
+                col = np.asarray(X[:, j], dtype=np.float64)
+                binned = np.searchsorted(self.upper_bounds[j], col,
+                                         side="left").astype(np.int32)
+                binned[np.isnan(col)] = 0
+                out[j - j0] = binned
+
+        _fanout_feature_blocks(run, j0, j1, n, workers)
         return out
 
     def transform(self, X: np.ndarray) -> np.ndarray:
         """Raw features -> int32 bin indices, shape (N, F).
 
-        Uses the native OpenMP binning kernel when available (the
-        LightGBM dataset-construction analog, native/mml_native.cpp
-        mml_apply_bins), falling back to vectorized numpy."""
+        Native OpenMP kernel when available (the LightGBM
+        dataset-construction analog, native/mml_native.cpp
+        mml_apply_bins); otherwise the shared threaded numpy path."""
         X = np.asarray(X, dtype=np.float64)
-        try:
-            from mmlspark_tpu.native import loader as native
-            if native.available():
-                out = native.apply_bins(X, self.upper_bounds)
-                if out is not None:
-                    return out
-        except Exception:  # noqa: BLE001 — native is only an accelerator
-            pass
-        out = np.empty(X.shape, dtype=np.int32)
-        for j, ub in enumerate(self.upper_bounds):
-            col = X[:, j]
-            binned = np.searchsorted(ub, col, side="left")
-            binned[np.isnan(col)] = 0
-            out[:, j] = binned
+        out = self._native_bins(X, transposed=False)
+        if out is not None:
+            return out
+        out = np.empty(X.shape, np.int32)
+        # the transposed view makes the shared features-major loop fill
+        # the row-major result column-by-column — no second full copy
+        self._numpy_bin_block(X, 0, self.num_features, out=out.T)
         return out
 
     def transform_fm(self, X: np.ndarray) -> np.ndarray:
@@ -174,20 +320,22 @@ class BinMapper:
         ship layout. Fast path: the fused native kernel bins f32/f64
         input straight into transposed uint8 (one pass instead of
         transform + transpose + narrow — three full sweeps at HIGGS
-        scale). Falls back to transform(X).T (int32) when the native
-        kernel or the <=256-bin precondition is unavailable. f32 input
-        widens per-value to f64 before the boundary compare, so results
-        are bit-identical to the f64 path."""
-        try:
-            from mmlspark_tpu.native import loader as native
-            if native.available():
-                out = native.apply_bins_t_u8(X, self.upper_bounds)
-                if out is not None:
-                    return out
-        except Exception:  # noqa: BLE001 — native is only an accelerator
-            pass
-        return np.ascontiguousarray(
-            self.transform(np.asarray(X, dtype=np.float64)).T)
+        scale). Falls back to the shared numpy block path (int32). f32
+        input widens per-value to f64 before the boundary compare, so
+        results are bit-identical to the f64 path."""
+        out = self._native_bins(X)
+        if out is not None:
+            return out
+        # >256-bin mappers miss the fused-u8 kernel's precondition; the
+        # row-major OpenMP kernel still beats numpy before the transpose
+        # — but the f64 copy it needs is pure waste when no native
+        # library is built, so probe availability before paying it
+        if self._native_available():
+            out = self._native_bins(np.asarray(X, dtype=np.float64),
+                                    transposed=False)
+            if out is not None:
+                return np.ascontiguousarray(out.T)
+        return self._numpy_bin_block(X, 0, self.num_features)
 
     def transform_fm_range(self, X: np.ndarray, j0: int,
                            j1: int) -> np.ndarray:
@@ -195,26 +343,25 @@ class BinMapper:
         features-major ship layout — the chunk primitive behind the
         booster's pipelined bin+ship (one chunk bins on host while the
         previous chunk's host->device DMA is in flight). Native fused
-        kernel (uint8) when available; numpy per-column searchsorted
-        (int32) otherwise, widened per column to f64 so results are
-        bit-identical to transform()."""
-        try:
-            from mmlspark_tpu.native import loader as native
-            if native.available():
-                out = native.apply_bins_t_u8(X, self.upper_bounds,
-                                             feature_range=(j0, j1))
-                if out is not None:
-                    return out
-        except Exception:  # noqa: BLE001 — native is only an accelerator
-            pass
-        n = X.shape[0]
-        out = np.empty((j1 - j0, n), np.int32)
-        for j in range(j0, j1):
-            col = np.asarray(X[:, j], dtype=np.float64)
-            binned = np.searchsorted(self.upper_bounds[j], col,
-                                     side="left").astype(np.int32)
-            binned[np.isnan(col)] = 0
-            out[j - j0] = binned
+        kernel (uint8) when available; the shared threaded numpy path
+        (int32) otherwise — either way bit-identical to transform()."""
+        out = self._native_bins(X, feature_range=(j0, j1))
+        if out is not None:
+            return out
+        return self._numpy_bin_block(X, j0, j1)
+
+    def bounds_matrix(self, dtype=np.float32) -> np.ndarray:
+        """Dense (F, B_max) ascending bounds, short features padded with
+        +inf — the device-binning lookup table. Padding keeps per-row
+        searchsorted results identical to the ragged per-feature lists:
+        every finite value inserts before the +inf tail, and +inf itself
+        inserts at the first pad slot, i.e. at len(upper_bounds[f]),
+        matching the host path."""
+        width = max([len(u) for u in self.upper_bounds] + [1])
+        out = np.full((self.num_features, width), np.inf, dtype=dtype)
+        for j, u in enumerate(self.upper_bounds):
+            if len(u):
+                out[j, :len(u)] = u.astype(dtype)
         return out
 
     def bin_threshold_value(self, feature: int, bin_idx: int) -> float:
@@ -257,13 +404,52 @@ class BinMapper:
     def to_json(self) -> dict:
         return {"max_bin": self.max_bin,
                 "f32_values_safe": self.f32_values_safe,
+                "f32_cuts_exact": self.f32_cuts_exact,
                 "upper_bounds": [u.tolist() for u in self.upper_bounds]}
 
     @staticmethod
     def from_json(d: dict) -> "BinMapper":
         return BinMapper([np.asarray(u) for u in d["upper_bounds"]],
                          d["max_bin"],
-                         f32_values_safe=d.get("f32_values_safe", False))
+                         f32_values_safe=d.get("f32_values_safe", False),
+                         f32_cuts_exact=d.get("f32_cuts_exact", False))
+
+
+# ---------------------------------------------------------------------------
+# on-device binning
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=1)
+def _bucketize_fn():
+    """Jitted vectorized searchsorted: raw (N, F) float32 features +
+    (F, B) padded bounds -> FEATURES-MAJOR (F, N) int32 bins. Built
+    lazily so importing binning never touches jax."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def bucketize(raw_nf, bounds):
+        def one(ub, col):
+            b = jnp.searchsorted(ub, col, side="left").astype(jnp.int32)
+            # NaN -> bin 0, exactly like the host transform; ±inf need
+            # no special case (the +inf pad places them at len(ub))
+            return jnp.where(jnp.isnan(col), 0, b)
+        return jax.vmap(one)(bounds, raw_nf.T)
+
+    return bucketize
+
+
+def bucketize_fm_device(raw_nf, bounds):
+    """On-device bin assignment: ``raw_nf`` is the raw (N, F) float32
+    feature matrix already on device, ``bounds`` the device copy of
+    ``BinMapper.bounds_matrix()``. Returns (F, N) int32 bins
+    bit-identical to ``BinMapper.transform(X).T`` whenever
+    ``mapper.f32_cuts_exact`` holds — f32-snapped cuts against
+    f32-representable values round nothing on either side, so the f32
+    compare equals the f64 compare for EVERY row by construction (the
+    booster's device-binning gate)."""
+    return _bucketize_fn()(raw_nf, bounds)
 
 
 def _holdout_rows(n: int, sampled_idx: np.ndarray, rng) -> np.ndarray:
@@ -308,19 +494,44 @@ def _cut_f32_ok(lo: float, hi: float) -> bool:
     return (hi - lo) / 2.0 > 8.0 * _EPS32 * max(abs(lo), abs(hi))
 
 
-def _feature_bounds(col: np.ndarray, max_bin: int):
+def _feature_bounds(col: np.ndarray, max_bin: int,
+                    f32_exact: bool = False):
     """Equal-frequency boundaries for one feature column.
     Returns (bounds, f32_ok) — f32_ok is False when any cut sits closer
-    to its neighboring data values than float32 can resolve."""
+    to its neighboring data values than float32 can resolve.
+    ``f32_exact``: the data is float32-representable, so cuts snap to
+    f32 values and f32_ok is True by construction (see _snap_cuts_f32).
+    """
     col = col[np.isfinite(col)]
     if col.size == 0:
         return np.empty(0), True
     distinct, counts = np.unique(col, return_counts=True)
-    return _bounds_from_counts(distinct, counts, max_bin)
+    return _bounds_from_counts(distinct, counts, max_bin, f32_exact)
+
+
+def _snap_cuts_f32(bounds: np.ndarray) -> np.ndarray:
+    """Snap each cut DOWN to the largest float32 value <= the f64 cut.
+
+    For a float32 data value v and the snapped cut s = floor_f32(c):
+    v <= s  <=>  v <= c  (no f32 value exists in (s, c]), so the bin
+    assignment against the snapped cuts equals the assignment against
+    the ORIGINAL f64 cuts for every f32-representable row — binning in
+    f32 (the on-device searchsorted, the jitted f32 inference walk) is
+    bit-identical to f64 binning AND no split resolution is lost.
+    Round-to-NEAREST would not give that: a midpoint cut between two
+    1-ulp-adjacent distinct values can round up onto the upper value
+    and merge two bins the f64 cut separated. Snapped cuts also stay
+    strictly increasing: a cut from the gap (v_i, v_{i+1}) lands in
+    [v_i, v_{i+1}), and successive cuts come from disjoint gaps."""
+    b64 = np.asarray(bounds, np.float64)
+    s32 = b64.astype(np.float32)
+    over = s32.astype(np.float64) > b64
+    s32 = np.where(over, np.nextafter(s32, np.float32(-np.inf)), s32)
+    return s32.astype(np.float64)
 
 
 def _bounds_from_counts(distinct: np.ndarray, counts: np.ndarray,
-                        max_bin: int):
+                        max_bin: int, f32_exact: bool = False):
     """Equal-frequency cuts from a (sorted distinct values, counts)
     histogram — shared by the dense column path and the sparse path
     (which merges the implicit-zeros count in without materializing)."""
@@ -328,9 +539,12 @@ def _bounds_from_counts(distinct: np.ndarray, counts: np.ndarray,
         return np.empty(0), True
     if len(distinct) <= max_bin:
         # one bin per distinct value; boundaries at midpoints
+        mid = (distinct[:-1] + distinct[1:]) / 2.0
+        if f32_exact:
+            return _snap_cuts_f32(mid), True
         ok = all(_cut_f32_ok(a, b)
                  for a, b in zip(distinct[:-1], distinct[1:]))
-        return (distinct[:-1] + distinct[1:]) / 2.0, ok
+        return mid, ok
     # equal-frequency: cut where the cumulative count fills a bin's
     # quota. O(max_bin·log d) — one searchsorted per CUT, not a Python
     # walk over every distinct value (same arithmetic: cum[i] is exactly
@@ -346,6 +560,9 @@ def _bounds_from_counts(distinct: np.ndarray, counts: np.ndarray,
         if i >= last:
             break
         bounds.append((distinct[i] + distinct[i + 1]) / 2.0)
-        ok = ok and _cut_f32_ok(distinct[i], distinct[i + 1])
+        ok = ok and (f32_exact
+                     or _cut_f32_ok(distinct[i], distinct[i + 1]))
         target = cum[i] + per_bin
+    if f32_exact:
+        return _snap_cuts_f32(np.asarray(bounds)), True
     return np.asarray(bounds), ok

@@ -15,6 +15,7 @@ over the mesh's data axis with psum'd histograms — the ICI equivalent of
 
 from __future__ import annotations
 
+import collections
 import functools
 import json
 from typing import Any, Dict, List, Optional, Tuple
@@ -27,10 +28,24 @@ from jax import lax
 from mmlspark_tpu.utils.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from mmlspark_tpu.gbdt import binning as binning_lib
 from mmlspark_tpu.gbdt.binning import BinMapper
 from mmlspark_tpu.gbdt.objectives import Objective, get_objective
-from mmlspark_tpu.gbdt.tree import GrowParams, Tree, grow_tree, predict_trees
+from mmlspark_tpu.gbdt.tree import (
+    GrowParams, Tree, grow_tree, predict_trees, sample_iteration_masks,
+)
 from mmlspark_tpu.parallel import mesh as mesh_lib
+
+# trace-time counters: each entry increments when XLA (re)traces the
+# named program, so `trace_counts()` deltas across repeated train()
+# calls at the same shapes are the chunk-fn-cache regression guard
+# (tests/test_perf_floors.py) — steady state must add ZERO traces.
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def trace_counts() -> Dict[str, int]:
+    """Snapshot of boosting-program trace counters (recompile guard)."""
+    return dict(TRACE_COUNTS)
 
 DEFAULTS: Dict[str, Any] = {
     # names mirror the reference's TrainParams (TrainParams.scala:9-61)
@@ -57,6 +72,16 @@ DEFAULTS: Dict[str, Any] = {
     "hist_method": "auto",  # 'auto' | 'scatter' | 'onehot' | 'pallas'
     "parallelism": "serial",  # 'serial' | 'data' | 'feature' | 'voting'
     "top_k": 20,               # voting-parallel candidates per worker
+    # iterations fused per host dispatch (lax.scan chunk); 0 = auto
+    # (8 for runs long enough to amortize the chunk compile, else 1);
+    # with early stopping every chunk is capped at esr_sync so the
+    # async loss-read contract holds
+    "boost_chunk": 0,
+    # 'auto' bins on device when the mapper's cuts are f32-exact
+    # (float32 input) and the input is dense single-host; 'off' forces
+    # host binning; 'on' asks for device binning and warns (falling
+    # back) when ineligible
+    "device_binning": "auto",
 }
 
 
@@ -76,9 +101,17 @@ class Booster:
         self.best_iteration = int(best_iteration)
         self.tree_depths = list(tree_depths or [])
         self._f64_flag: Optional[bool] = None   # _needs_f64_inference cache
+        # device-resident tree arrays, keyed by the t_limit they were
+        # built for (raw_score used to re-upload the whole forest on
+        # every call); invalidated whenever t_limit changes
+        self._dev_forest: Optional[Tuple[int, Dict[str, Any]]] = None
         # per-phase fit wall seconds (set by train(); empty for loaded
-        # models): {bin, ship, first_iter, boost, fetch}
+        # models): {bin, ship[, bin_device], first_iter, boost, fetch}
         self.train_timing: Dict[str, float] = {}
+        # non-numeric fit facts (set by train()): bin_path
+        # ('device'|'host'), boost_chunk (fused iterations per
+        # dispatch), boost_chunks (dispatch count)
+        self.train_info: Dict[str, Any] = {}
 
     # -- inference ----------------------------------------------------------
 
@@ -158,17 +191,33 @@ class Booster:
                     {k: v[:t_limit] for k, v in self.trees.items()},
                     self._max_depth(t_limit))
             else:
+                dev = self._device_trees(t_limit)
                 out = np.asarray(predict_trees(
                     jnp.asarray(np.asarray(X, dtype=np.float32)),
-                    jnp.asarray(self.trees["feature"][:t_limit]),
-                    jnp.asarray(self.trees["threshold"][:t_limit]),
-                    jnp.asarray(self.trees["left"][:t_limit]),
-                    jnp.asarray(self.trees["right"][:t_limit]),
-                    jnp.asarray(self.trees["value"][:t_limit]),
+                    dev["feature"], dev["threshold"], dev["left"],
+                    dev["right"], dev["value"],
                     max_depth=self._max_depth(t_limit)))   # (T, N)
             out = out.reshape(it, K, n).sum(axis=0)
             scores += out
         return scores[0] if K == 1 else scores
+
+    def _device_trees(self, t_limit: int) -> Dict[str, Any]:
+        """Device-resident stacked tree arrays for the jitted f32 walk.
+        Cached on the Booster (building five jnp arrays per predict()
+        call re-shipped the whole forest every time — it dominated
+        small-batch scoring); invalidated when ``t_limit`` changes
+        (num_iteration / best_iteration truncation picks new rows)."""
+        cached = self._dev_forest
+        if cached is None or cached[0] != t_limit:
+            arrs = {k: jnp.asarray(self.trees[k][:t_limit])
+                    for k in ("feature", "threshold", "left", "right",
+                              "value")}
+            cached = (int(t_limit), arrs)
+            self._dev_forest = cached
+        # return the LOCAL tuple, not a re-read of the attribute: a
+        # concurrent predict() with a different t_limit may swap the
+        # cache between the check above and this return
+        return cached[1]
 
     def predict(self, X: np.ndarray,
                 num_iteration: Optional[int] = None) -> np.ndarray:
@@ -459,9 +508,11 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     scored on the raw features).
 
     The returned Booster carries ``train_timing``: per-phase wall
-    seconds {bin, ship, first_iter (compile+exec), boost, fetch} so
-    bench drift is attributable to a phase (host binning contention vs
-    link bandwidth vs recompile vs device loop)."""
+    seconds {bin, ship[, bin_device], first_iter (compile+first chunk),
+    boost, fetch} so bench drift is attributable to a phase (host
+    binning contention vs link bandwidth vs recompile vs device loop),
+    and ``train_info``: {bin_path: 'device'|'host', boost_chunk,
+    boost_chunks}."""
     import time as _time
     _phases: Dict[str, float] = {}
     _t_phase = _time.perf_counter()
@@ -691,12 +742,13 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     n_padded = n + pad
     # features-major (F, N) layout: per-split column reads become
     # contiguous rows and the Pallas kernel consumes it directly (see
-    # tree.grow_tree docstring). Binning happens on HOST (the native
-    # OpenMP kernel; f64-exact for every feature scale) and the NARROW
-    # bin matrix ships to the device — at max_bin<=255 that is uint8,
-    # 4x fewer bytes than the f32 feature matrix, measured 2-4x faster
-    # and far less variable through the host->device link than shipping
-    # raw features for on-device binning.
+    # tree.grow_tree docstring). Binning runs ON DEVICE when the mapper
+    # is f32-safe (raw f32 blocks ship async, one jitted searchsorted
+    # assigns bins — the host binning pass disappears entirely);
+    # otherwise it happens on HOST (native OpenMP kernel or the
+    # threaded numpy path; f64-exact for every feature scale) and the
+    # NARROW bin matrix ships — at max_bin<=255 that is uint8, 4x fewer
+    # bytes than the f32 feature matrix.
     # record f32 safety on the model so inference picks the right walk
     # (warm start below ORs in the base model's flag)
     p["f32_unsafe"] = not mapper.f32_safe()
@@ -722,10 +774,74 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # ~8 MB of rows per chunk amortizes per-transfer dispatch;
     # pipelining needs >= 2 chunks to overlap anything
     # (ship_chunk_bytes is a tuning/test knob, not a public param)
-    chunk_f = max(1, int(p.get("ship_chunk_bytes", 8 << 20))
-                  // max(n_padded, 1))
+    chunk_bytes = int(p.get("ship_chunk_bytes", 8 << 20))
+    chunk_f = max(1, chunk_bytes // max(n_padded, 1))
+    # ON-DEVICE BINNING: when float32 compares provably reproduce the
+    # f64 bin assignment (mapper.f32_safe — f32-snapped cuts for f32
+    # input, gap+holdout certification otherwise), ship the RAW float32
+    # feature blocks (overlapped async device_put per block, same shape
+    # as the binned pipeline below) and bucketize on device with one
+    # jitted vectorized searchsorted against the (F, B) bounds matrix.
+    # Host binning — previously 43% of the HIGGS wall together with the
+    # binned-matrix ship — collapses to a slice/cast staging pass plus
+    # a ~100 ms device kernel. Host binning stays the fallback for
+    # f32-unsafe mappers, CSR, streaming shards, and multi-host ingest.
+    device_binning = str(p.get("device_binning", "auto"))
+    # gate on f32_cuts_exact, NOT f32_safe: only f32-snapped cuts (f32
+    # input) make the device f32 compare equal the host f64 compare for
+    # EVERY row by construction. A margin+holdout-certified f64 mapper
+    # is good enough for the f32 INFERENCE walk (residual risk on
+    # unsampled rows is accepted there) but would let training bins
+    # silently differ between device_binning='auto' and 'off'.
+    use_device_bin = (device_binning != "off"
+                      and bins_np is None
+                      and not isinstance(X, _CSRMatrix)
+                      and not (multi_host or multi_host_fp)
+                      and mapper.f32_cuts_exact)
+    if device_binning == "on" and not use_device_bin:
+        import logging
+        if multi_host or multi_host_fp:
+            _reason = "multi-host ingest assembles per-process shards"
+        elif bins_np is not None or isinstance(X, _CSRMatrix):
+            _reason = "input is pre-binned/CSR/streaming"
+        else:
+            _reason = ("cuts are not f32-exact (pass float32 features "
+                       "to enable on-device binning)")
+        logging.getLogger("mmlspark_tpu.gbdt").warning(
+            "device_binning='on' requested but ineligible (%s); binning "
+            "on host", _reason)
+    bin_path = "host"
     pipelined = False
-    if not (multi_host or multi_host_fp) and f > chunk_f:
+    if use_device_bin:
+        bin_path = "device"
+        bounds_np = mapper.bounds_matrix(np.float32)
+        # raw f32 rows are 4 bytes/cell (vs 1 for uint8 bins) — budget
+        # the block width by bytes so each DMA stays ~chunk-bytes-sized
+        chunk_f_raw = max(1, chunk_bytes // max(4 * n_padded, 1))
+        parts = []
+        for j0 in range(0, f, chunk_f_raw):
+            j1 = min(f, j0 + chunk_f_raw)
+            blk = np.ascontiguousarray(X[:, j0:j1], dtype=np.float32)
+            # bucketize EACH block as its DMA lands (async dispatch) and
+            # narrow to the bin dtype immediately: only bins stay
+            # resident — device peak is one raw block + the bin matrix,
+            # same footprint as the host-binning path (concatenating
+            # the raw blocks first would hold 2x the raw matrix in HBM)
+            parts.append(binning_lib.bucketize_fm_device(
+                jnp.asarray(blk),
+                jnp.asarray(bounds_np[j0:j1])).astype(narrow))
+        _mark("bin")    # host staging: column slice + f32 cast only
+        bins_dev = (parts[0] if len(parts) == 1
+                    else jnp.concatenate(parts, axis=0))
+        del parts
+        if pad or f_pad:
+            bins_dev = jnp.pad(bins_dev, ((0, f_pad), (0, pad)))
+        bins_dev = bins_dev.astype(jnp.int32)
+        jax.block_until_ready(bins_dev)
+        _mark("bin_device")   # raw DMA + searchsorted kernel, overlapped
+        pipelined = True      # skip the host bin+ship paths below
+    if not pipelined and not (multi_host or multi_host_fp) \
+            and f > chunk_f:
         parts = []
         if bins_np is None and not isinstance(X, _CSRMatrix):
             # normalize ONCE: the native kernel needs contiguous input,
@@ -829,16 +945,6 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         voting_k=int(p["top_k"]))
     lr = float(p["learning_rate"])
 
-    # jitted-step cache: keyed by objective config (not instance) so
-    # repeated train() calls at the same shapes reuse the compiled
-    # executable instead of re-tracing a fresh closure every time
-    step_fn = _make_step(
-        (p["objective"], K, float(p["alpha"]),
-         float(p["tweedie_variance_power"])),
-        gp, lr, K, axis_name, mesh,
-        p["parallelism"] if p["parallelism"] in ("feature", "voting")
-        else "data")
-
     scores_np = (base_scores if base_model is not None
                  else np.broadcast_to(
                      np.asarray(init_score, np.float32)[:, None],
@@ -895,8 +1001,6 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     jax.block_until_ready((bins_d, y_d, scores))
     _mark("ship")   # narrow host->device transfer + placement
 
-    rng = np.random.default_rng(p["seed"])
-
     # validation state — device-resident; the held-out set is scored
     # through the *binned* feature view (same comparisons training uses)
     # so the loop never converts a tree to host. The only per-iteration
@@ -937,7 +1041,6 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             v_scores = jnp.asarray(v_scores_np, jnp.float32)
     best_loss = np.inf
     best_iter = -1
-    pending_val: List[Tuple[int, Any]] = []
     esr_sync = max(1, min(esr, 8)) if esr > 0 else 1
     # one fixed walk length -> one predict_trees compile for the whole
     # run (leaves self-loop, extra steps are no-ops)
@@ -945,6 +1048,19 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         else int(p["num_leaves"]) - 1
 
     n_iter = int(p["num_iterations"])
+    # iteration-batching: fuse boost_chunk iterations into one jitted
+    # lax.scan dispatch (the models/learner.py run_chunk shape). Auto
+    # mode only engages for runs long enough that the extra
+    # remainder-length compile amortizes. An explicit boost_chunk is
+    # honored EXCEPT under early stopping, where every chunk is capped
+    # at esr_sync so the async loss-read cadence (and best_iteration)
+    # keeps its contract — train_info reports the effective length.
+    S_cfg = int(p.get("boost_chunk", 0) or 0)
+    if S_cfg <= 0:
+        S_cfg = 8 if n_iter >= 16 else 1
+    if use_valid:
+        S_cfg = min(S_cfg, esr_sync)
+    S_cfg = max(1, min(S_cfg, n_iter))
     M = 2 * int(p["num_leaves"]) - 1
     # power-of-two capacity bucket: the forest buffer shape feeds the
     # jitted step, so tying it exactly to num_iterations would recompile
@@ -966,6 +1082,23 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
 
     bag_active = p["bagging_fraction"] < 1.0 and p["bagging_freq"] > 0
     ff_active = p["feature_fraction"] < 1.0
+    # bagging/feature-fraction masks are derived ON DEVICE inside the
+    # chunk program (tree.sample_iteration_masks: fold_in(key, it) +
+    # threshold-compare — deterministic, resume-safe, chunking-
+    # invariant), so the host RNG + per-iteration mask upload that used
+    # to force one dispatch per iteration is gone.
+    bag_cfg = ((float(p["bagging_fraction"]), int(p["bagging_freq"]))
+               if bag_active else None)
+    ff_cfg = float(p["feature_fraction"]) if ff_active else None
+    # the mask key is a RUNTIME input to the chunk program (raw uint32
+    # PRNGKey data), so a seed sweep with bagging active reuses one
+    # compiled executable instead of recompiling the heaviest program
+    # in the engine per seed; pinned to 0 when no mask is active
+    # (is-None checks, not truthiness: ff_cfg == 0.0 is falsy but DOES
+    # sample masks, and must honor the user's seed)
+    mask_key = jax.random.PRNGKey(
+        int(p["seed"])
+        if (bag_cfg is not None or ff_cfg is not None) else 0)
     def _rows_global(w_np):
         if multi_host:
             return jax.make_array_from_process_local_data(
@@ -982,65 +1115,77 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     w_d = _rows_global(w_pad)
     fmask_base = np.zeros(f_eff, np.float32)
     fmask_base[:f] = 1.0          # padded dummy features stay masked
-    fmask = fmask_base   # numpy: replicated-safe for jit
+
+    from mmlspark_tpu.core.metrics import gbdt_train_histograms
+    boost_chunk_hist = gbdt_train_histograms().get("boost_chunk")
+    obj_key = (p["objective"], K, float(p["alpha"]),
+               float(p["tweedie_variance_power"]))
+    parallel_mode = (p["parallelism"]
+                     if p["parallelism"] in ("feature", "voting")
+                     else "data")
     trees_done = 0
-    for it in range(n_iter):
-        # bagging (ref: TrainParams baggingFraction/baggingFreq —
-        # LightGBM resamples every `freq` iters and reuses the bag between)
-        if bag_active and it % p["bagging_freq"] == 0:
-            keep = rng.random(n_padded) < p["bagging_fraction"]
-            w_d = _rows_global(w_pad * keep)
-
-        # feature subsampling per tree
-        if ff_active:
-            k = max(1, int(np.ceil(p["feature_fraction"] * f)))
-            chosen = rng.choice(f, size=k, replace=False)
-            fmask_np = np.zeros(f_eff, np.float32)
-            fmask_np[chosen] = 1.0
-            fmask = fmask_np
-
-        scores, forest = step_fn(bins_d, scores, y_d, w_d, fmask,
-                                 forest, np.int32(it * K))
-        trees_done = (it + 1) * K
-        if it == 0:
+    n_chunks = 0
+    it0 = 0
+    stop = False
+    # pending per-chunk device loss vectors, flushed at esr_sync
+    # iteration boundaries. The point is cadence, not pure asynchrony:
+    # the stop decision consumes losses at the SAME boundaries for
+    # every chunk length, which is what makes best_iteration/num_trees
+    # chunk-length-invariant (the parity suite asserts it). Chunks
+    # shorter than esr_sync stay fully async until the boundary; when
+    # S == esr_sync (the capped default) each flush blocks on the
+    # chunk dispatched just above — the cadence the per-iteration loop
+    # already paid. Worst case trains up to esr_sync-1 extra
+    # iterations past the stop point; best_iteration stays exact
+    # (extra trees are truncated at scoring time).
+    pending_val: List[Tuple[int, int, Any]] = []
+    pending_iters = 0
+    while it0 < n_iter and not stop:
+        S = min(S_cfg, n_iter - it0)
+        chunk_fn = _make_chunk_step(
+            obj_key, gp, lr, K, axis_name, mesh, parallel_mode, S,
+            bag_cfg, ff_cfg, f, f_eff)
+        t_chunk = _time.perf_counter()
+        scores, forest = chunk_fn(bins_d, scores, y_d, w_d, fmask_base,
+                                  forest, np.int32(it0), mask_key)
+        n_chunks += 1
+        trees_done = (it0 + S) * K
+        if it0 == 0:
             jax.block_until_ready(scores)
-            _mark("first_iter")   # compile (unless cached) + first tree
+            _mark("first_iter")   # compile (unless cached) + first chunk
+        elif boost_chunk_hist is not None:
+            # host dispatch wall per chunk AFTER the first: enqueue time
+            # plus any back-pressure once the dispatch queue fills — NOT
+            # device execution (blocking here would serialize the async
+            # pipeline). The compile-bearing first chunk lands under
+            # first_iter, not in this series.
+            boost_chunk_hist.observe(
+                (_time.perf_counter() - t_chunk) * 1e3)
 
         if use_valid:
-            row = np.int32(it * K)
-            for k_cls in range(K):
-                sl = lambda a: lax.dynamic_slice_in_dim(  # noqa: E731
-                    a, row + k_cls, 1, axis=0)
-                tv = predict_trees(
-                    bins_v, sl(forest.feature),
-                    sl(forest.bin_threshold).astype(jnp.float32),
-                    sl(forest.left), sl(forest.right), sl(forest.value),
-                    max_depth=valid_depth)
-                v_scores = v_scores.at[k_cls].add(lr * tv[0])
-            vs = v_scores[0] if K == 1 else v_scores
-            # ASYNC early stopping: the loss stays a device scalar and
-            # the host reads a batch of them every few iterations, so
-            # esr no longer re-serializes the loop per iteration (the
-            # reads are ~free by then — those steps finished long ago).
-            # Worst case trains esr_sync-1 extra trees past the stop
-            # point; best_iteration stays exact, so predictions are
-            # unaffected (extra trees are truncated at scoring time).
-            pending_val.append((it, objective.loss(vs, yv)))
-            if len(pending_val) >= esr_sync or it == n_iter - 1:
-                stop = False
-                for it_, dev_loss in pending_val:
-                    cur = float(dev_loss)
-                    if cur < best_loss - 1e-12:
-                        best_loss, best_iter = cur, it_ + 1
-                    elif it_ + 1 - best_iter >= esr:
-                        stop = True
+            eval_fn = _make_valid_eval(obj_key, K, lr, S, valid_depth)
+            v_scores, losses = eval_fn(forest, bins_v, yv, v_scores,
+                                       np.int32(it0 * K))
+            pending_val.append((it0, S, losses))
+            pending_iters += S
+            if pending_iters >= esr_sync or it0 + S >= n_iter:
+                for c_it0, c_len, c_losses in pending_val:
+                    arr = np.asarray(c_losses)
+                    for j in range(c_len):
+                        cur = float(arr[j])
+                        if cur < best_loss - 1e-12:
+                            best_loss, best_iter = cur, c_it0 + j + 1
+                        elif c_it0 + j + 1 - best_iter >= esr:
+                            stop = True
+                            break
+                    if stop:
                         break
                 pending_val.clear()
-                if stop:
-                    break
+                pending_iters = 0
+        it0 += S
 
     jax.block_until_ready(scores)
-    _mark("boost")   # iterations 2..n of the jitted loop
+    _mark("boost")   # chunks 2..n of the jitted loop
     if trees_done:
         # one device->host transfer for the whole forest
         host = jax.device_get(forest._asdict())
@@ -1073,6 +1218,13 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
                       tree_depths=tree_depths)
     _mark("fetch")   # forest D2H + threshold conversion
     booster.train_timing = {k: round(v, 3) for k, v in _phases.items()}
+    booster.train_info = {"bin_path": bin_path, "boost_chunk": S_cfg,
+                          "boost_chunks": n_chunks}
+    hists = gbdt_train_histograms()
+    for phase_name, secs in _phases.items():
+        h = hists.get(phase_name)
+        if h is not None:
+            h.observe(secs * 1e3)
     return booster
 
 
@@ -1153,14 +1305,23 @@ def _tree_depth(tree_host: Dict[str, np.ndarray]) -> int:
     return max(depth, 1)
 
 
-@functools.lru_cache(maxsize=64)
-def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
-               lr: float, K: int, axis_name: Optional[str],
-               mesh: Optional[Mesh], parallel_mode: str = "data"):
-    """Build the per-iteration jitted step:
-    gradients → K trees → score update. Returns
-    (new_scores, tuple_of_K_trees). lru_cached so a second train() with
-    the same config hits the XLA compile cache.
+@functools.lru_cache(maxsize=128)
+def _make_chunk_step(obj_key: Tuple[str, int, float, float],
+                     gp: GrowParams, lr: float, K: int,
+                     axis_name: Optional[str], mesh: Optional[Mesh],
+                     parallel_mode: str, chunk_len: int,
+                     bag_cfg: Optional[Tuple[float, int]],
+                     ff_cfg: Optional[float],
+                     f_valid: int, f_total: int):
+    """Build the iteration-batched jitted boosting chunk:
+    ``chunk_len`` iterations of gradients → K trees → score update
+    fused into one ``lax.scan`` device program (the same shape as
+    run_chunk in models/learner.py) — ONE host dispatch per chunk
+    instead of per iteration, with bagging / feature-fraction masks
+    derived on device per iteration (tree.sample_iteration_masks).
+    lru_cached by (config, chunk length) so repeated train() calls at
+    the same shapes reuse the compiled executable — including the
+    remainder-length chunk.
 
     ``parallel_mode`` picks the tree_learner sharding (ref:
     TrainParams.scala:26): 'data' shards rows over the mesh axis,
@@ -1170,42 +1331,95 @@ def _make_step(obj_key: Tuple[str, int, float, float], gp: GrowParams,
     objective = get_objective(name, num_class=num_class, alpha=alpha,
                               tweedie_variance_power=rho)
 
-    def step(bins, scores, y, w, fmask, forest, base):
-        """forest: Tree of (T_cap, M) buffers; the K grown trees are
-        written at rows base..base+K-1 ON DEVICE — no per-iteration
+    def chunk(bins, scores, y, w_base, fmask_base, forest, it0, key):
+        """forest: Tree of (T_cap, M) buffers; iteration it's K trees
+        are written at rows it*K..it*K+K-1 ON DEVICE — no per-iteration
         host transfer or stacking (one device_get fetches the whole
-        forest after the loop)."""
-        score_in = scores[0] if K == 1 else scores
-        grad, hess = objective.grad_hess(score_in, y)
-        if K == 1:
-            grad, hess = grad[None, :], hess[None, :]
-        new_scores = scores
-        for k in range(K):
-            tree, leaf_of_row, leaf_vals, _ = grow_tree(
-                bins, grad[k], hess[k], w, fmask, gp, axis_name,
-                parallel_mode)
-            new_scores = new_scores.at[k].add(lr * leaf_vals[leaf_of_row])
-            forest = Tree(*[
-                getattr(forest, fld).at[base + k].set(getattr(tree, fld))
-                for fld in Tree._fields])
-        return new_scores, forest
+        forest after the loop). ``key`` is the raw uint32 PRNGKey for
+        the sampling masks — a runtime input, so the executable is
+        seed-independent."""
+        TRACE_COUNTS["boost_chunk"] += 1   # trace-time side effect
+
+        def one_iter(carry, s):
+            scores, forest = carry
+            it = it0 + s
+            w, fmask = sample_iteration_masks(
+                key, it, w_base, fmask_base, bag_cfg, ff_cfg,
+                f_valid, f_total, axis_name, parallel_mode)
+            score_in = scores[0] if K == 1 else scores
+            grad, hess = objective.grad_hess(score_in, y)
+            if K == 1:
+                grad, hess = grad[None, :], hess[None, :]
+            for k in range(K):
+                tree, leaf_of_row, leaf_vals, _ = grow_tree(
+                    bins, grad[k], hess[k], w, fmask, gp, axis_name,
+                    parallel_mode)
+                scores = scores.at[k].add(lr * leaf_vals[leaf_of_row])
+                forest = Tree(*[
+                    getattr(forest, fld).at[it * K + k].set(
+                        getattr(tree, fld))
+                    for fld in Tree._fields])
+            return (scores, forest), None
+
+        (scores, forest), _ = lax.scan(
+            one_iter, (scores, forest),
+            jnp.arange(chunk_len, dtype=jnp.int32))
+        return scores, forest
 
     if axis_name is None:
-        return jax.jit(step, donate_argnums=(1, 5))
+        return jax.jit(chunk, donate_argnums=(1, 5))
 
     d = mesh_lib.DATA_AXIS
     tree_spec = Tree(*([P()] * len(Tree._fields)))
     if parallel_mode == "feature":
         # features sharded, rows replicated; tree/scores replicated
-        in_specs = (P(d, None), P(), P(), P(), P(d), tree_spec, P())
+        in_specs = (P(d, None), P(), P(), P(), P(d), tree_spec, P(),
+                    P())
         out_specs = (P(), tree_spec)
     else:
         in_specs = (P(None, d), P(None, d), P(d), P(d), P(None),
-                    tree_spec, P())
+                    tree_spec, P(), P())
         out_specs = (P(None, d), tree_spec)
     mapped = shard_map(
-        step, mesh=mesh,
+        chunk, mesh=mesh,
         in_specs=in_specs,
         out_specs=out_specs,
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(1, 5))
+
+
+@functools.lru_cache(maxsize=128)
+def _make_valid_eval(obj_key: Tuple[str, int, float, float], K: int,
+                     lr: float, chunk_len: int, valid_depth: int):
+    """One jitted dispatch scoring a whole chunk's trees on the
+    validation set: slice the chunk's S*K forest rows, walk them once
+    (predict_trees), then sequentially accumulate per-iteration scores
+    and losses with a lax.scan whose f32 add order matches the
+    per-iteration loop exactly — the (S,) loss vector stays on device
+    for the async early-stopping read."""
+    name, num_class, alpha, rho = obj_key
+    objective = get_objective(name, num_class=num_class, alpha=alpha,
+                              tweedie_variance_power=rho)
+
+    def eval_chunk(forest, bins_v, yv, v_scores, row0):
+        TRACE_COUNTS["valid_eval"] += 1   # trace-time side effect
+
+        def sl(a):
+            return lax.dynamic_slice_in_dim(a, row0, chunk_len * K,
+                                            axis=0)
+        tv = predict_trees(
+            bins_v, sl(forest.feature),
+            sl(forest.bin_threshold).astype(jnp.float32),
+            sl(forest.left), sl(forest.right), sl(forest.value),
+            max_depth=valid_depth)                  # (S*K, Nv)
+        tv = tv.reshape(chunk_len, K, -1)
+
+        def body(vs, s):
+            vs = vs + lr * tv[s]
+            return vs, objective.loss(vs[0] if K == 1 else vs, yv)
+
+        v_scores, losses = lax.scan(
+            body, v_scores, jnp.arange(chunk_len, dtype=jnp.int32))
+        return v_scores, losses
+
+    return jax.jit(eval_chunk, donate_argnums=(3,))

@@ -66,6 +66,16 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         "(ref: TrainParams.scala:26 tree_learner=data/feature/voting)",
         default="serial")
     topK = IntParam("voting-parallel candidates per worker", default=20)
+    boostChunk = IntParam(
+        "boosting iterations fused per device dispatch (lax.scan "
+        "chunk); 0 = auto (8 for long runs, per-iteration otherwise); "
+        "capped at the early-stopping sync interval when validation is "
+        "active", default=0, domain=range_domain(lo=0))
+    deviceBinning = EnumParam(
+        ["auto", "on", "off"],
+        "bin raw features on device ('auto' = when the mapper's cuts "
+        "are f32-exact, i.e. float32 input, and the input is dense "
+        "single-host; host binning is the fallback)", default="auto")
     validationData = TableParam("held-out table for early stopping",
                                 default=None)
     initModelString = StringParam(
@@ -94,6 +104,8 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
             "hist_method": self.get("histMethod"),
             "parallelism": self.get("parallelism"),
             "top_k": self.get("topK"),
+            "boost_chunk": self.get("boostChunk"),
+            "device_binning": self.get("deviceBinning"),
         }
 
     def _features_matrix(self, table: DataTable) -> np.ndarray:
@@ -102,6 +114,13 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         col = table.column(self.get_features_col())
         if isinstance(col, CSRMatrix):
             return col    # booster.train bins CSR directly, no densify
+        if isinstance(col, np.ndarray) and col.ndim == 2 \
+                and col.dtype == np.float32:
+            # keep float32 instead of the shared f64 coercion: binning
+            # widens per-compare (exact), the 2x-size f64 copy never
+            # materializes, and the f32-exact cut snapping keeps the
+            # on-device binning ingest path eligible
+            return col
         return features_matrix(table, self.get_features_col())
 
     def _fit_arrays(self, table: DataTable):

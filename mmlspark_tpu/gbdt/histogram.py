@@ -9,7 +9,11 @@ axis — riding ICI instead of ethernet sockets.
 
 The binned matrix is FEATURES-MAJOR, (F, N) int32: rows (the reduction
 dim) live in the TPU lane dimension, per-feature reads are contiguous,
-and the Pallas kernel consumes the layout without a transpose.
+and the Pallas kernel consumes the layout without a transpose. Whether
+the bins were assigned on host (BinMapper.transform*) or on device
+(binning.bucketize_fm_device — the f32-safe ingest path), the layout
+and bin semantics here are identical; these kernels never see the
+difference.
 
 Three device strategies, one contract:
   - 'pallas': VMEM-resident bin one-hot contracted on the MXU — the TPU

@@ -18,6 +18,7 @@ of LightGBM's data-parallel tree learner (ref: TrainParams.scala:26
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import NamedTuple, Optional
 
@@ -59,6 +60,76 @@ class Tree(NamedTuple):
     is_leaf: jnp.ndarray      # (M,) bool
     gain: jnp.ndarray         # (M,) f32 split gain at internal nodes
     count: jnp.ndarray        # (M,) f32 row count at node
+
+
+def _index_uniforms(key, ids):
+    """Counter-based uniforms: u[j] depends only on (key, ids[j]) —
+    fold_in per index, vmapped (batched threefry). Unlike
+    ``uniform(key, (n,))``, whose whole stream changes with n, these
+    values are invariant to padding length and shard layout, so the
+    same row/feature draws the same uniform in serial, data-parallel,
+    and feature-parallel runs."""
+    return jax.vmap(
+        lambda i: jax.random.uniform(jax.random.fold_in(key, i)))(ids)
+
+
+def sample_iteration_masks(key, it, w_base, fmask_base, bag_cfg, ff_cfg,
+                           f_valid: int, f_total: int,
+                           axis_name: Optional[str] = None,
+                           parallel_mode: str = "data"):
+    """Device-derived bagging / feature-fraction masks for boosting
+    iteration ``it`` (a traced int32). Pure function of ``(key, it)``
+    and the GLOBAL row/feature index — deterministic, resume-safe, and
+    invariant to scan chunking, padding, and shard layout, so serial /
+    data-parallel / feature-parallel runs draw identical masks and no
+    host RNG or mask upload sits on the boosting hot path.
+
+    - Bagging (``bag_cfg = (fraction, freq)``): per-row counter-based
+      uniforms from the key folded with the last resample iteration
+      (LightGBM reuses the bag between ``freq`` resamples), threshold-
+      compared; row-sharded modes (data/voting) index by their global
+      row offset, so every device agrees with the serial bag
+      bit-for-bit — including when row padding differs between modes.
+    - Feature fraction (``ff_cfg = fraction``): per-tree EXACT-k subset
+      (k = ceil(fraction * f_valid), the LightGBM featureFraction
+      semantics the host RNG used): the k features with the smallest
+      per-tree uniforms are kept — an order-statistic threshold, so the
+      count never varies tree to tree. Padded dummies stay masked;
+      feature-parallel shards slice their local window of the global
+      mask.
+    """
+    w = w_base
+    if bag_cfg is not None:
+        frac, freq = bag_cfg
+        bag_it = (it // freq) * freq
+        kb = jax.random.fold_in(jax.random.fold_in(key, bag_it), 1)
+        n_loc = w_base.shape[0]
+        row0 = (lax.axis_index(axis_name) * n_loc
+                if axis_name is not None and parallel_mode != "feature"
+                else 0)
+        u = _index_uniforms(kb, row0 + jnp.arange(n_loc))
+        w = w_base * (u < frac)
+    fmask = fmask_base
+    if ff_cfg is not None:
+        kf = jax.random.fold_in(jax.random.fold_in(key, it), 2)
+        # every device evaluates the full (tiny) global feature vector
+        # so the exact-k threshold is a global decision
+        uf = _index_uniforms(kf, jnp.arange(f_total))
+        valid = jnp.arange(f_total) < f_valid
+        uf = jnp.where(valid, uf, jnp.inf)
+        # exact-k: keep the k smallest uniforms (k static — ff_cfg and
+        # f_valid are trace constants). Padded slots hold +inf and
+        # k <= f_valid, so they can never cross the k-th threshold.
+        k = max(1, math.ceil(ff_cfg * f_valid))
+        kth = -lax.top_k(-uf, k)[0][k - 1]
+        m = ((uf <= kth) & valid).astype(fmask_base.dtype)
+        f_loc = fmask_base.shape[0]
+        if axis_name is not None and parallel_mode == "feature" \
+                and f_total != f_loc:
+            m = lax.dynamic_slice_in_dim(
+                m, lax.axis_index(axis_name) * f_loc, f_loc)
+        fmask = fmask_base * m
+    return w, fmask
 
 
 def _leaf_output(g, h, l1, l2):

@@ -84,6 +84,23 @@ class TestBinning:
         m = BinMapper.fit(tight, max_bin=8)
         assert not BinMapper.from_json(m.to_json()).f32_safe()
 
+    def test_f32_snap_preserves_ulp_adjacent_splits(self):
+        # f32 input snaps cuts DOWN to the largest f32 <= cut: two
+        # 1-ulp-adjacent distinct values must stay in different bins
+        # (round-to-nearest snapping could round the midpoint cut UP
+        # onto the upper value and merge them), and the assignment must
+        # equal what the unsnapped f64 midpoint cuts give
+        a = np.float32(1.0) + np.float32(2.0) ** -23
+        b = np.float32(1.0) + np.float32(2.0) ** -22
+        X32 = np.array([a] * 5 + [b] * 5, np.float32)[:, None]
+        m32 = BinMapper.fit(X32, max_bin=4)
+        assert m32.f32_cuts_exact
+        bins32 = m32.transform(X32)
+        assert bins32[0, 0] != bins32[5, 0], "ulp-adjacent values merged"
+        m64 = BinMapper.fit(X32.astype(np.float64), max_bin=4)
+        np.testing.assert_array_equal(
+            bins32, m64.transform(X32.astype(np.float64)))
+
     def test_legacy_model_f64_inference_heuristic(self, breast_cancer):
         # models saved before the fit-time flag fall back to threshold
         # heuristics: magnitude >= 2^24 forces f64; near-equal
@@ -779,6 +796,280 @@ class TestStreamBinFidelity:
         finally:
             lg.removeHandler(handler)
         assert any("binning drift" in r.getMessage() for r in records)
+
+
+class TestDeviceBinning:
+    """On-device bucketize (raw f32 blocks + jitted searchsorted) must
+    be a pure performance change: bit-identical bins to the host
+    BinMapper.transform whenever f32_safe() certifies the mapper, and a
+    clean fallback to host binning everywhere else."""
+
+    def _adversarial_f32(self, n=20_000, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 6)).astype(np.float32)
+        X[::7, 0] = np.nan
+        X[::11, 1] = np.inf
+        X[::13, 2] = -np.inf
+        X[:, 3] = np.round(X[:, 3])          # heavy repeats
+        X[:, 4] = 2.0                        # constant feature
+        return X
+
+    def test_device_bins_bit_identical(self):
+        from mmlspark_tpu.gbdt.binning import bucketize_fm_device
+        X = self._adversarial_f32()
+        m = BinMapper.fit(X, max_bin=63)
+        # f32 input -> f32-snapped cuts -> f32-safe by construction
+        assert m.f32_safe()
+        host = m.transform(X)
+        dev = np.asarray(bucketize_fm_device(
+            jnp.asarray(X), jnp.asarray(m.bounds_matrix())))
+        np.testing.assert_array_equal(host.T, dev)
+
+    def test_device_bins_bit_identical_at_full_bin_width(self):
+        from mmlspark_tpu.gbdt.binning import bucketize_fm_device
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(50_000, 4)).astype(np.float32)
+        m = BinMapper.fit(X, max_bin=255)
+        assert m.f32_safe()
+        dev = np.asarray(bucketize_fm_device(
+            jnp.asarray(X), jnp.asarray(m.bounds_matrix())))
+        np.testing.assert_array_equal(m.transform(X).T, dev)
+
+    def test_f64_input_stays_on_host_even_when_f32_safe(self):
+        # float64 input can be f32-safe for INFERENCE (gap margin +
+        # holdout certify the sample) yet the certification is
+        # probabilistic for unsampled rows — training must not let the
+        # ingest path change the forest, so device binning requires
+        # f32-EXACT cuts (float32 input)
+        rng = np.random.default_rng(6)
+        X = rng.normal(size=(800, 4))            # float64
+        y = (X[:, 0] > 0).astype(float)
+        m = BinMapper.fit(X, max_bin=16)
+        assert m.f32_safe() and not m.f32_cuts_exact
+        b = train({"objective": "binary", "num_iterations": 3,
+                   "hist_method": "scatter"}, X, y)
+        assert b.train_info["bin_path"] == "host"
+
+    def test_f32_unsafe_mapper_stays_on_host(self):
+        # f64 timestamp-scale cuts cannot run in f32; train must record
+        # the host ingest path and keep full split resolution
+        rng = np.random.default_rng(1)
+        ts = (1.7e9 + rng.integers(0, 600, size=2000)).astype(float)
+        y = (ts % 600 > 300).astype(float)
+        b = train({"objective": "binary", "num_iterations": 20,
+                   "min_data_in_leaf": 5}, ts[:, None], y)
+        assert b.train_info["bin_path"] == "host"
+        assert _auc(y, b.predict(ts[:, None])) > 0.99
+
+    @pytest.mark.slow   # end-to-end train x2; bin parity above is the
+    def test_device_vs_host_forest_identical(self):   # tier-1 guard
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(12_000, 9)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(float)
+        kw = {"objective": "binary", "num_iterations": 10,
+              "num_leaves": 15, "max_bin": 63, "hist_method": "scatter"}
+        bd = train(dict(kw), X, y)
+        bh = train(dict(kw, device_binning="off"), X, y)
+        assert bd.train_info["bin_path"] == "device"
+        assert bh.train_info["bin_path"] == "host"
+        for k in bd.trees:
+            np.testing.assert_array_equal(bd.trees[k], bh.trees[k])
+        np.testing.assert_array_equal(bd.predict(X), bh.predict(X))
+        # device path records its own kernel phase; host path never does
+        assert "bin_device" in bd.train_timing
+        assert "bin_device" not in bh.train_timing
+
+    def test_forced_on_falls_back_for_csr(self):
+        # CSR ingest cannot ship raw float blocks; 'on' warns + host path
+        import logging
+        from mmlspark_tpu.core.sparse import CSRMatrix
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(500, 5)).astype(np.float32)
+        X[rng.random(X.shape) < 0.6] = 0.0
+        y = (X[:, 0] > 0).astype(float)
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append
+        lg = logging.getLogger("mmlspark_tpu.gbdt")
+        lg.addHandler(handler)
+        try:
+            b = train({"objective": "binary", "num_iterations": 3,
+                       "device_binning": "on", "hist_method": "scatter"},
+                      CSRMatrix.from_dense(X), y)
+        finally:
+            lg.removeHandler(handler)
+        assert b.train_info["bin_path"] == "host"
+        assert any("device_binning" in r.getMessage() for r in records)
+
+    def test_threaded_host_binning_parity(self):
+        # the host fallback's feature-block thread pool must be
+        # invisible: identical bins at any worker count
+        X = np.asarray(self._adversarial_f32(5000), np.float64)
+        X[0, 0] = 1.7e9   # keep it f32-unsafe so host is the real path
+        X[1, 0] = 1.7e9 + 1
+        m = BinMapper.fit(X, max_bin=31)
+        one = m._numpy_bin_block(X, 0, X.shape[1], workers=1)
+        many = m._numpy_bin_block(X, 0, X.shape[1], workers=4)
+        np.testing.assert_array_equal(one, many)
+        np.testing.assert_array_equal(one, m.transform(X).T)
+        np.testing.assert_array_equal(one[2:5],
+                                      m.transform_fm_range(X, 2, 5))
+
+
+class TestChunkedBoosting:
+    """Iteration-batched boosting (boost_chunk iterations fused into one
+    lax.scan dispatch) must be a pure performance change: with a fixed
+    seed the forest is bit-identical to the per-iteration loop
+    (boost_chunk=1), including with bagging, feature_fraction, and
+    early stopping enabled."""
+
+    def _assert_same_forest(self, a, b):
+        assert set(a.trees) == set(b.trees)
+        for k in a.trees:
+            np.testing.assert_array_equal(a.trees[k], b.trees[k], err_msg=k)
+
+    @pytest.mark.slow   # the esr+sampling variant below is the tier-1
+    def test_chunked_forest_identical(self):          # parity guard
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(3000, 6)).astype(np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+        kw = {"objective": "binary", "num_iterations": 20,
+              "num_leaves": 15, "max_bin": 31, "hist_method": "scatter"}
+        b8 = train(dict(kw, boost_chunk=8), X, y)
+        b1 = train(dict(kw, boost_chunk=1), X, y)
+        assert b8.train_info["boost_chunk"] == 8
+        assert b8.train_info["boost_chunks"] == 3    # 8 + 8 + 4
+        assert b1.train_info["boost_chunks"] == 20
+        self._assert_same_forest(b8, b1)
+
+    def test_chunked_with_sampling_and_esr_identical(self):
+        # device-derived masks are a pure function of (seed, iteration),
+        # so chunking cannot change them; esr segments chunks at
+        # esr_sync boundaries so both paths stop at the same read point
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(1500, 8)).astype(np.float32)
+        y = X[:, 0] * 2 + rng.normal(scale=0.3, size=1500)
+        kw = {"objective": "regression", "num_iterations": 200,
+              "num_leaves": 7, "learning_rate": 0.3,
+              "early_stopping_round": 5, "hist_method": "scatter",
+              "min_data_in_leaf": 5, "bagging_fraction": 0.8,
+              "bagging_freq": 2, "feature_fraction": 0.7, "seed": 11}
+        valid = (X[1200:], y[1200:])
+        b8 = train(dict(kw, boost_chunk=8), X[:1200], y[:1200],
+                   valid=valid)
+        b1 = train(dict(kw, boost_chunk=1), X[:1200], y[:1200],
+                   valid=valid)
+        assert 0 < b8.best_iteration < 200   # esr actually fired
+        assert b8.best_iteration == b1.best_iteration
+        assert b8.num_trees == b1.num_trees
+        self._assert_same_forest(b8, b1)
+
+    @pytest.mark.slow   # parity extra beyond the tier-1 chunk suite
+    def test_multiclass_chunked_identical(self):
+        from sklearn.datasets import load_iris
+        X, y = load_iris(return_X_y=True)
+        kw = {"objective": "multiclass", "num_class": 3,
+              "num_iterations": 18, "min_data_in_leaf": 5,
+              "hist_method": "scatter"}
+        b8 = train(dict(kw, boost_chunk=8), X, y)
+        b1 = train(dict(kw, boost_chunk=1), X, y)
+        self._assert_same_forest(b8, b1)
+        assert (b8.predict(X).argmax(1) == y).mean() > 0.95
+
+    @pytest.mark.slow   # 8-device mesh compile dominates (~20s wall)
+    def test_dp_sampling_masks_match_serial(self, cpu_mesh_devices):
+        # data-parallel derives the SAME global bag as serial: the
+        # per-row uniforms are counter-based (key, global row id), so
+        # they are invariant to shard layout AND row padding — N is
+        # deliberately NOT divisible by the 8-device mesh, the case
+        # where a length-dependent uniform stream would diverge.
+        # Forests agree up to the psum reassociation tolerance the
+        # plain dp-vs-serial test already accepts.
+        n = 2001
+        rng = np.random.default_rng(5)
+        X = rng.normal(size=(n, 10)).astype(np.float32)
+        y = X[:, 0] * 2 + np.sin(X[:, 1]) + rng.normal(
+            scale=0.1, size=n)
+        mesh = mesh_lib.make_mesh()
+        kw = {"objective": "regression", "num_iterations": 10,
+              "num_leaves": 15, "min_data_in_leaf": 10,
+              "bagging_fraction": 0.7, "bagging_freq": 1,
+              "feature_fraction": 0.8, "seed": 5,
+              "hist_method": "scatter", "boost_chunk": 4}
+        bs = train(dict(kw), X, y)
+        bd = train(dict(kw, parallelism="data"), X, y, mesh=mesh)
+        np.testing.assert_allclose(bd.predict(X), bs.predict(X),
+                                   rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.slow   # retrace guard also enforced by the perf floor
+    def test_seed_sweep_does_not_retrace_chunks(self):
+        # the mask key is a runtime input to the chunk program: a seed
+        # sweep with bagging active (CV folds, bagged ensembles) must
+        # reuse the compiled executable, not recompile per seed
+        from mmlspark_tpu.gbdt import booster as booster_mod
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(600, 5)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(float)
+        kw = {"objective": "binary", "num_iterations": 8,
+              "num_leaves": 7, "boost_chunk": 4, "max_bin": 31,
+              "bagging_fraction": 0.8, "bagging_freq": 1,
+              "feature_fraction": 0.8, "hist_method": "scatter",
+              "min_data_in_leaf": 5}
+        b1 = train(dict(kw, seed=1), X, y)
+        before = dict(booster_mod.trace_counts())
+        b2 = train(dict(kw, seed=2), X, y)
+        delta = {k: v - before.get(k, 0)
+                 for k, v in booster_mod.trace_counts().items()
+                 if v != before.get(k, 0)}
+        assert not delta, f"seed change retraced: {delta}"
+        # and the seed still matters: different bags -> different forest
+        assert any(not np.array_equal(b1.trees[k], b2.trees[k])
+                   for k in b1.trees)
+
+    def test_ff_zero_still_honors_seed(self):
+        # feature_fraction=0.0 is falsy but DOES sample masks
+        # (max(1, ceil(0*F)) = 1 feature per tree): the mask key must
+        # still come from the user's seed, not the pinned no-mask key
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(400, 8)).astype(np.float32)
+        y = X[:, 0] - X[:, 3] + 0.1 * rng.normal(size=400)
+        kw = {"objective": "regression", "num_iterations": 6,
+              "num_leaves": 7, "max_bin": 31, "hist_method": "scatter",
+              "min_data_in_leaf": 5, "feature_fraction": 0.0}
+        b1 = train(dict(kw, seed=1), X, y)
+        b2 = train(dict(kw, seed=2), X, y)
+        assert any(not np.array_equal(b1.trees[k], b2.trees[k])
+                   for k in b1.trees)
+
+    def test_estimator_boost_chunk_passthrough(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(400, 4)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        t = DataTable({"features": X, "label": y})
+        m = TPUBoostClassifier(numIterations=16, boostChunk=4,
+                               histMethod="scatter").fit(t)
+        b = m.get_booster()
+        assert b.params["boost_chunk"] == 4
+        out = m.transform(t)
+        assert (out["prediction"] == y).mean() > 0.9
+
+
+class TestDeviceForestCache:
+    def test_predict_reuses_device_trees(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 6}, X, y)
+        if b._needs_f64_inference():
+            pytest.skip("f64 host inference path — no device cache")
+        p1 = b.predict(X)
+        cache = b._dev_forest
+        assert cache is not None
+        p2 = b.predict(X)
+        assert b._dev_forest is cache        # same upload reused
+        np.testing.assert_array_equal(p1, p2)
+        # t_limit change invalidates (num_iteration truncation)
+        b.predict(X, num_iteration=2)
+        assert b._dev_forest is not cache
+        assert b._dev_forest[0] == 2 * b.num_class
 
 
 class TestAsyncEarlyStopping:

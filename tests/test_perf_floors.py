@@ -84,6 +84,64 @@ class TestGBDTWallFloor:
         acc = ((booster.predict(X) > 0.5) == y).mean()
         assert acc > 0.9, acc
 
+    def test_gbdt_higgs_shaped_device_bin_and_recompile_guard(self):
+        """HIGGS-shaped (scaled) train must take the device-binning
+        ingest path and fused boosting chunks, within a wall budget —
+        and a second train() at the SAME shapes must add ZERO program
+        traces (the chunk-fn cache guard, the GBDT analog of serving's
+        steady_state_recompiles == 0; wired into the bench JSON as
+        bin_path / boost_chunk)."""
+        from mmlspark_tpu.gbdt import booster as booster_mod
+        from mmlspark_tpu.gbdt.booster import train as gbdt_train
+        rng = np.random.default_rng(2)
+        N, F = 60_000, 28
+        X = rng.normal(size=(N, F)).astype(np.float32)
+        y = (X[:, 0] * 1.5 + X[:, 1] * X[:, 2]
+             + 0.3 * rng.normal(size=N) > 0).astype(float)
+        # 12 iterations with an explicit 8-chunk: exercises BOTH the
+        # full-length and the remainder-length (4) compiled chunk fns,
+        # so the second train proves the by-length cache held
+        params = {"objective": "binary", "num_iterations": 12,
+                  "num_leaves": 31, "max_bin": 63,
+                  "min_data_in_leaf": 50, "boost_chunk": 8}
+        t0 = time.perf_counter()
+        b1 = gbdt_train(params, X, y)
+        wall1 = time.perf_counter() - t0
+        assert b1.train_info["bin_path"] == "device", b1.train_info
+        assert b1.train_info["boost_chunk"] == 8, b1.train_info
+        assert b1.train_info["boost_chunks"] == 2, b1.train_info
+        assert "bin_device" in b1.train_timing, b1.train_timing
+        # ingest must be transfer-bound, not host-compute-bound: the
+        # staging+kernel phases stay well under the old host-bin wall
+        phases = b1.train_timing
+        assert (phases["bin"] + phases["ship"]
+                + phases.get("bin_device", 0.0)) <= 8, phases
+        traces_after_first = dict(booster_mod.trace_counts())
+        t0 = time.perf_counter()
+        b2 = gbdt_train(params, X, y)
+        wall2 = time.perf_counter() - t0
+        recompiles = {
+            k: v - traces_after_first.get(k, 0)
+            for k, v in booster_mod.trace_counts().items()
+            if v != traces_after_first.get(k, 0)}
+        assert not recompiles, (
+            f"steady-state train() retraced boosting programs: "
+            f"{recompiles}")
+        # warm run skips compile entirely (first run pays two chunk
+        # compiles); the zero-trace assert above is the hard guard —
+        # this wall comparison only flags a GROSSLY slower warm run
+        # (lost executable cache), with slack for shared-host noise
+        assert wall2 <= wall1 * 1.5, (wall1, wall2)
+        # machinery floor, not a chip number: the calibration host runs
+        # this warm train in ~10s and heavily-throttled 1-core
+        # containers in ~150s; the budget sits above both so only a
+        # many-fold machinery regression (retrace-per-call, serialized
+        # ingest) fails
+        assert wall2 <= 300, (
+            f"HIGGS-shaped warm train blew its budget: {wall2:.1f}s "
+            f"(phases {b2.train_timing})")
+        del b1, b2
+
 
 class TestServingQPSFloor:
     def test_serving_qps_floor(self):
