@@ -9,14 +9,24 @@ as-is, and everything is assembled into a single dense ``features``
 column (FastVectorAssembler analog — the assembled matrix is exactly the
 (N, D) array device stages consume, so assembly is one np.concatenate,
 no metadata walk; ref: src/core/spark/.../FastVectorAssembler.scala:23).
+
+Every per-column kernel is COLUMNAR: token hashing runs through the
+vectorized distinct-token kernels in ``stages/text`` (each distinct
+token hashes once, counts scatter in one key sort), string
+index/one-hot map through a unique-value LUT instead of a per-row dict
+probe, and fit's level scan uses np.unique. The pre-vectorization
+per-row loops survive as ``_build_parts_rowloop`` — the bit-parity
+oracle the tests and ``bench.py``'s automl scenario measure against.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mmlspark_tpu.core import metrics as MC
 from mmlspark_tpu.core.params import (
     BoolParam, ColParam, IntParam, ListParam, DictParam, StageParam,
 )
@@ -25,9 +35,37 @@ from mmlspark_tpu.core.schema import (
 )
 from mmlspark_tpu.core.stage import Estimator, Model
 from mmlspark_tpu.core.table import DataTable
-from mmlspark_tpu.stages.text import HashingTF, _stable_hash
+from mmlspark_tpu.stages.text import (
+    HashingTF, _hash_counts, _stable_hash, hash_counts_csr,
+    hash_counts_dense, string_codes as _string_codes,
+)
 
 _NUMERIC_TAGS = {F32, F64, I8, I16, I32, I64, BOOL}
+
+
+def _distinct_levels(col) -> List[Any]:
+    """Non-None distinct values of a string column, sorted when
+    comparable — the vectorized fit-side level scan. String columns with
+    no Nones take the C-speed np.unique path; anything else falls back
+    to the original first-seen dict + try-sorted discipline (identical
+    output: sorted distinct when sortable, first-seen order when not)."""
+    vals = col if isinstance(col, list) else list(col)
+    try:
+        arr = np.asarray(vals)
+    except Exception:  # noqa: BLE001 — fall through to the dict scan
+        arr = None
+    if arr is not None and arr.dtype.kind in ("U", "S"):
+        return list(np.unique(arr).tolist())
+    seen: Dict[Any, None] = {}
+    for v in vals:
+        if v is not None:
+            seen.setdefault(v, None)
+    levels = list(seen.keys())
+    try:
+        levels = sorted(levels)
+    except TypeError:
+        pass
+    return levels
 
 
 class Featurize(Estimator):
@@ -59,6 +97,7 @@ class Featurize(Estimator):
         return self.get("numberOfFeatures")
 
     def fit(self, table: DataTable) -> "FeaturizeModel":
+        t0 = time.perf_counter()
         cols = self.get_or_none("featureColumns")
         if cols is None:
             cols = [c for c in table.column_names
@@ -78,12 +117,7 @@ class Featurize(Estimator):
                     specs.append({"col": c, "kind": "numeric",
                                   "fill": mean})
             elif f.tag == STRING:
-                levels = [v for v in table.distinct_values(c)
-                          if v is not None]
-                try:
-                    levels = sorted(levels)
-                except TypeError:
-                    pass
+                levels = _distinct_levels(table[c])
                 if self.get("oneHotEncodeCategoricals"):
                     specs.append({"col": c, "kind": "string_onehot",
                                   "levels": levels})
@@ -98,8 +132,155 @@ class Featurize(Estimator):
                 specs.append({"col": c, "kind": "vector"})
             # other tags (struct/bytes/object) are skipped, like the
             # reference drops unsupported columns
+        MC.automl_histograms()["featurize_fit"].observe(
+            (time.perf_counter() - t0) * 1e3)
         return FeaturizeModel(specs=specs,
                               outputCol=self.get("outputCol"))
+
+
+def _spec_width(spec: Dict[str, Any], table: DataTable) -> int:
+    """Output width of one spec's block in the assembled matrix."""
+    kind = spec["kind"]
+    if kind in ("numeric", "string_index"):
+        return 1
+    if kind in ("onehot", "hash"):
+        return spec["size"]
+    if kind == "string_onehot":
+        return len(spec["levels"])
+    if kind == "vector":
+        col = table[spec["col"]]
+        if isinstance(col, np.ndarray) and col.ndim == 2:
+            return col.shape[1]
+        return int(np.asarray(col[0], dtype=np.float32).shape[0]) \
+            if len(col) else 0
+    raise ValueError(f"unknown featurize spec kind {kind!r}")
+
+
+def _fill_part(spec: Dict[str, Any], table: DataTable,
+               view: np.ndarray) -> None:
+    """One spec -> its (N, w) float32 slice of the assembled matrix,
+    written IN PLACE (``view`` is a column slice of the final array, so
+    dense assembly needs no per-part temporaries and no concat copy)."""
+    c = spec["col"]
+    kind = spec["kind"]
+    n = len(table)
+    if kind == "numeric":
+        col = np.asarray(table[c], dtype=np.float32)
+        view[:, 0] = np.where(np.isfinite(col), col,
+                              np.float32(spec["fill"]))
+    elif kind == "onehot":
+        col = np.asarray(table[c], dtype=np.int64)
+        size = spec["size"]
+        view[:] = 0.0
+        ok = (col >= 0) & (col < size)
+        view[np.arange(n)[ok], col[ok]] = 1.0
+    elif kind == "string_index":
+        codes = _string_codes(table[c], spec["levels"])
+        view[:, 0] = codes.astype(np.float32)
+    elif kind == "string_onehot":
+        codes = _string_codes(table[c], spec["levels"])
+        view[:] = 0.0
+        ok = codes >= 0
+        view[np.nonzero(ok)[0], codes[ok]] = 1.0
+    elif kind == "hash":
+        # float32 counts: TF counts are small integers, exact in f32
+        hash_counts_dense(table[c], spec["size"], binary=False, out=view)
+    elif kind == "vector":
+        col = table[c]
+        if isinstance(col, np.ndarray) and col.ndim == 2:
+            view[:] = col
+        elif len(col):
+            view[:] = np.stack(
+                [np.asarray(v, dtype=np.float32) for v in col])
+    else:
+        raise ValueError(f"unknown featurize spec kind {kind!r}")
+
+
+def _build_part(spec: Dict[str, Any], table: DataTable):
+    """One spec -> one standalone columnar block (the mixed
+    sparse/dense assembly path; dense-only assembly fills slices of
+    the final matrix directly instead)."""
+    if spec["kind"] == "hash" and spec.get("sparse"):
+        # reference behavior: 262144-wide hashed text stays a
+        # SparseVector end to end (Featurize.scala:13-19) — here a
+        # CSR block that never densifies
+        return hash_counts_csr(table[spec["col"]], spec["size"],
+                               binary=False)
+    out = np.empty((len(table), _spec_width(spec, table)), np.float32)
+    _fill_part(spec, table, out)
+    return out
+
+
+def _build_parts_rowloop(specs, table: DataTable) -> List[Any]:
+    """The pre-vectorization per-row loops, verbatim — the bit-parity
+    ORACLE for the columnar kernels (pinned by tests) and the baseline
+    ``bench.py``'s automl scenario measures the speedup against. Not on
+    any hot path."""
+    parts: List[Any] = []
+    n = len(table)
+    for spec in specs or []:
+        c = spec["col"]
+        kind = spec["kind"]
+        if kind == "numeric":
+            col = np.asarray(table[c], dtype=np.float32)
+            col = np.where(np.isfinite(col), col, np.float32(spec["fill"]))
+            parts.append(col[:, None])
+        elif kind == "onehot":
+            col = np.asarray(table[c], dtype=np.int64)
+            size = spec["size"]
+            oh = np.zeros((n, size), dtype=np.float32)
+            ok = (col >= 0) & (col < size)
+            oh[np.arange(n)[ok], col[ok]] = 1.0
+            parts.append(oh)
+        elif kind == "string_index":
+            index = {v: i for i, v in enumerate(spec["levels"])}
+            col = np.asarray([index.get(v, -1) for v in table[c]],
+                             dtype=np.float32)
+            parts.append(col[:, None])
+        elif kind == "string_onehot":
+            index = {v: i for i, v in enumerate(spec["levels"])}
+            size = len(spec["levels"])
+            oh = np.zeros((n, size), dtype=np.float32)
+            for i, v in enumerate(table[c]):
+                j = index.get(v)
+                if j is not None:
+                    oh[i, j] = 1.0
+            parts.append(oh)
+        elif kind == "hash":
+            m = spec["size"]
+            if spec.get("sparse"):
+                from mmlspark_tpu.core.sparse import CSRMatrix
+                parts.append(CSRMatrix.from_rows(
+                    (_hash_counts(toks, m, False)
+                     for toks in table[c]), num_cols=m))
+                continue
+            mat = np.zeros((n, m), dtype=np.float32)
+            for i, toks in enumerate(table[c]):
+                for t in toks or []:
+                    mat[i, _stable_hash(str(t)) % m] += 1.0
+            parts.append(mat)
+        elif kind == "vector":
+            col = table[c]
+            if isinstance(col, np.ndarray) and col.ndim == 2:
+                parts.append(np.asarray(col, dtype=np.float32))
+            else:
+                parts.append(np.stack(
+                    [np.asarray(v, dtype=np.float32) for v in col]))
+    return parts
+
+
+def _assemble(parts: List[Any], output_col: str, table: DataTable
+              ) -> DataTable:
+    if not parts:
+        raise ValueError("no featurizable columns found")
+    from mmlspark_tpu.core.sparse import CSRMatrix as _CSR, hstack
+    if any(isinstance(p, _CSR) for p in parts):
+        feats: Any = hstack(parts)
+        field = Field(output_col, VECTOR, {"sparse": True})
+    else:
+        feats = np.concatenate(parts, axis=1)
+        field = Field(output_col, VECTOR)
+    return table.with_column(output_col, feats, field)
 
 
 class FeaturizeModel(Model):
@@ -110,72 +291,58 @@ class FeaturizeModel(Model):
         # all parts float32: device stages consume f32/bf16 anyway, and a
         # single float64 part would upcast the whole concatenate (doubling
         # the wide hashed block's footprint)
-        parts: List[np.ndarray] = []
-        n = len(table)
-        for spec in self.get("specs") or []:
-            c = spec["col"]
-            kind = spec["kind"]
-            if kind == "numeric":
-                col = np.asarray(table[c], dtype=np.float32)
-                col = np.where(np.isfinite(col), col, np.float32(spec["fill"]))
-                parts.append(col[:, None])
-            elif kind == "onehot":
-                col = np.asarray(table[c], dtype=np.int64)
-                size = spec["size"]
-                oh = np.zeros((n, size), dtype=np.float32)
-                ok = (col >= 0) & (col < size)
-                oh[np.arange(n)[ok], col[ok]] = 1.0
-                parts.append(oh)
-            elif kind == "string_index":
-                index = {v: i for i, v in enumerate(spec["levels"])}
-                col = np.asarray([index.get(v, -1) for v in table[c]],
-                                 dtype=np.float32)
-                parts.append(col[:, None])
-            elif kind == "string_onehot":
-                index = {v: i for i, v in enumerate(spec["levels"])}
-                size = len(spec["levels"])
-                oh = np.zeros((n, size), dtype=np.float32)
-                for i, v in enumerate(table[c]):
-                    j = index.get(v)
-                    if j is not None:
-                        oh[i, j] = 1.0
-                parts.append(oh)
-            elif kind == "hash":
-                m = spec["size"]
-                if spec.get("sparse"):
-                    # reference behavior: 262144-wide hashed text stays a
-                    # SparseVector end to end (Featurize.scala:13-19) —
-                    # here a CSR block that never densifies
-                    from mmlspark_tpu.core.sparse import CSRMatrix
-                    from mmlspark_tpu.stages.text import _hash_counts
-                    parts.append(CSRMatrix.from_rows(
-                        (_hash_counts(toks, m, False)
-                         for toks in table[c]), num_cols=m))
-                    continue
-                # float32 halves the dense-materialization footprint; TF
-                # counts are small integers so no precision is lost
-                mat = np.zeros((n, m), dtype=np.float32)
-                for i, toks in enumerate(table[c]):
-                    for t in toks or []:
-                        mat[i, _stable_hash(str(t)) % m] += 1.0
-                parts.append(mat)
-            elif kind == "vector":
-                col = table[c]
-                if isinstance(col, np.ndarray) and col.ndim == 2:
-                    parts.append(np.asarray(col, dtype=np.float32))
-                else:
-                    parts.append(np.stack(
-                        [np.asarray(v, dtype=np.float32) for v in col]))
-        if not parts:
-            raise ValueError("no featurizable columns found")
-        from mmlspark_tpu.core.sparse import CSRMatrix as _CSR, hstack
-        if any(isinstance(p, _CSR) for p in parts):
-            feats: Any = hstack(parts)
-            field = Field(self.get("outputCol"), VECTOR, {"sparse": True})
+        t0 = time.perf_counter()
+        specs = self.get("specs") or []
+        if any(s["kind"] == "hash" and s.get("sparse") for s in specs):
+            parts = [_build_part(spec, table) for spec in specs]
+            out = _assemble(parts, self.get("outputCol"), table)
         else:
-            feats = np.concatenate(parts, axis=1)
-            field = Field(self.get("outputCol"), VECTOR)
-        return table.with_column(self.get("outputCol"), feats, field)
+            # all-dense: preallocate the final (N, D) matrix once and
+            # let every kernel write its column slice in place — no
+            # per-part temporaries, no concatenate copy. WIDE blocks
+            # fill first (their bulk writes absorb the first-touch page
+            # faults at sequential speed); consecutive NARROW specs
+            # batch through one compact temp so the matrix sees one
+            # strided pass instead of a cache-hostile 4-bytes-per-row
+            # pass per column.
+            if not specs:
+                raise ValueError("no featurizable columns found")
+            widths = [_spec_width(s, table) for s in specs]
+            offs = np.concatenate([[0], np.cumsum(widths)])
+            feats = np.empty((len(table), int(offs[-1])), np.float32)
+            narrow = 8
+            for i, spec in enumerate(specs):
+                if widths[i] > narrow:
+                    _fill_part(spec, table,
+                               feats[:, offs[i]:offs[i + 1]])
+            i = 0
+            while i < len(specs):
+                if widths[i] > narrow:
+                    i += 1
+                    continue
+                j = i
+                while j < len(specs) and widths[j] <= narrow:
+                    j += 1
+                tmp = np.empty((len(table), int(offs[j] - offs[i])),
+                               np.float32)
+                for k in range(i, j):
+                    a = int(offs[k] - offs[i])
+                    _fill_part(specs[k], table,
+                               tmp[:, a:a + widths[k]])
+                feats[:, offs[i]:offs[j]] = tmp
+                i = j
+            out_col = self.get("outputCol")
+            out = table.with_column(out_col, feats,
+                                    Field(out_col, VECTOR))
+        MC.automl_histograms()["featurize_transform"].observe(
+            (time.perf_counter() - t0) * 1e3)
+        return out
+
+    def transform_rowloop(self, table: DataTable) -> DataTable:
+        """Transform via the retained per-row reference loops — the
+        parity/bench baseline; see ``_build_parts_rowloop``."""
+        parts = _build_parts_rowloop(self.get("specs"), table)
+        return _assemble(parts, self.get("outputCol"), table)
 
     def transform_schema(self, schema: Schema) -> Schema:
         sparse = any(s.get("sparse") and s.get("kind") == "hash"

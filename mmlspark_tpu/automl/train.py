@@ -100,9 +100,8 @@ class TrainedClassifierModel(Model):
         out = out.drop(_FEATURES_COL)
         levels = self.get_or_none("levels")
         if levels:
-            preds = out["prediction"]
-            orig = [levels[int(v)] if 0 <= int(v) < len(levels) else None
-                    for v in preds]
+            from mmlspark_tpu.stages.dataprep import unindex_codes
+            orig = unindex_codes(out["prediction"], levels)
             out = out.with_column("scored_labels", orig)
         else:
             out = out.with_column("scored_labels", out["prediction"])

@@ -8,11 +8,23 @@ EvaluationUtils.scala:13): randomized/grid search over typed param
 spaces with k-fold CV, candidates evaluated in parallel (thread pool —
 the reference uses scala Futures; each fit releases the GIL into XLA),
 and FindBestModel evaluating fitted models on a validation table.
+
+The CV sweep is fold-cached and device-batched: the k (train, val)
+fold pairs are assembled ONCE and shared by every candidate (the old
+path rebuilt the train table with DataTable.concat inside every
+candidate x fold evaluation — k x C full-dataset copies), each fold's
+dense (N, D) feature matrix is extracted once, and when every candidate
+is the same vmappable linear-model family with numeric-only
+hyperparameter deltas the whole C x k sweep stacks into one jitted
+vmap program per (fold, static-config group) — a handful of dispatches
+instead of C x k serial fits. The serial thread-pool path stays as the
+general fallback (any estimator, sparse features, structural params).
 """
 
 from __future__ import annotations
 
 import itertools
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -20,11 +32,14 @@ import numpy as np
 
 from mmlspark_tpu.automl.statistics import ComputeModelStatistics
 from mmlspark_tpu.core import metrics as MC
+from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.params import (
     BoolParam, EnumParam, IntParam, ListParam, StageParam, StringParam,
 )
 from mmlspark_tpu.core.stage import Estimator, Model, Transformer
 from mmlspark_tpu.core.table import DataTable
+
+_LOG = get_logger("automl.tuning")
 
 # metric -> larger-is-better? (ref: EvaluationUtils.getMetricWithOperator)
 _METRIC_ASCENDING = {
@@ -125,8 +140,7 @@ class RandomSpace:
             yield {n: d.sample(rng) for n, d in self.space.items()}
 
 
-def _evaluate(model: Model, table: DataTable, metric: str) -> float:
-    scored = model.transform(table)
+def _evaluate_scored(scored: DataTable, metric: str) -> float:
     mode = ("regression" if metric in MC.REGRESSION_METRICS
             else "classification" if metric in MC.CLASSIFICATION_METRICS
             else "auto")
@@ -137,9 +151,138 @@ def _evaluate(model: Model, table: DataTable, metric: str) -> float:
     return float(row[metric])
 
 
+def _evaluate(model: Model, table: DataTable, metric: str) -> float:
+    return _evaluate_scored(model.transform(table), metric)
+
+
+# ---------------------------------------------------------------------------
+# device-batched trials
+# ---------------------------------------------------------------------------
+
+# the only hyperparameters the vmap trial path may sweep: stepSize and
+# regParam enter the jitted fit as traced scalars (vmappable), maxIter
+# is a static loop bound (candidates group by it — one dispatch per
+# distinct value per fold)
+_SWEEPABLE = {"stepSize", "regParam", "maxIter"}
+
+
+def _batched_trials(candidates: List[Tuple[Estimator, Dict[str, Any]]],
+                    fold_pairs: List[Tuple[DataTable, DataTable]],
+                    metric: str, info: Dict[str, Any]
+                    ) -> Optional[List[float]]:
+    """The device-batched CV sweep. Returns per-candidate mean scores
+    ordered like ``candidates``, or None when the sweep is not
+    vmappable (mixed estimator families, structural params, sparse
+    features) — the caller then runs the serial thread-pool path.
+
+    Per fold: ONE feature-matrix extraction + standardization shared by
+    all C candidates, then one jitted vmap dispatch per distinct
+    maxIter group fitting every candidate in that group at once.
+    Candidate weights come back stacked; scoring reuses the fold's
+    cached validation matrix (``transform_from_matrix``), and selection
+    runs the exact serial-path code on the scores."""
+    from mmlspark_tpu.core.sparse import CSRMatrix
+    from mmlspark_tpu.models.linear import (
+        TPULinearRegression, TPULogisticRegression,
+        TPULinearRegressionModel, TPULogisticRegressionModel,
+        _Standardizer, _fit_linear_batch, _fit_logistic_batch,
+        _features_matrix,
+    )
+    import jax.numpy as jnp
+
+    if not candidates:
+        return None
+    ests = [e for e, _ in candidates]
+    cls = type(ests[0])
+    if cls not in (TPULogisticRegression, TPULinearRegression):
+        return None
+    if any(type(e) is not cls for e in ests):
+        return None
+    if any(set(pm) - _SWEEPABLE for _, pm in candidates):
+        return None
+    fcol = ests[0].get_features_col()
+    lcol = ests[0].get_label_col()
+    pcol = ests[0].get_prediction_col()
+    if any(e.get_features_col() != fcol or e.get_label_col() != lcol
+           or e.get_prediction_col() != pcol for e in ests):
+        return None
+    try:
+        if any(isinstance(t.column(fcol), CSRMatrix)
+               or isinstance(v.column(fcol), CSRMatrix)
+               for t, v in fold_pairs):
+            return None   # the sparse gather fit has per-fold
+            #               data-dependent shapes; serial path keeps it
+    except KeyError:
+        return None
+
+    logistic = cls is TPULogisticRegression
+    # effective (stepSize, regParam, maxIter) per candidate: estimator
+    # value overridden by the swept param map — exactly what the serial
+    # path's est.copy()+set() produces
+    configs = []
+    for est, pm in candidates:
+        cfg = {n: est.get(n) for n in ("stepSize", "regParam", "maxIter")}
+        cfg.update(pm)
+        configs.append(cfg)
+    groups: Dict[int, List[int]] = {}
+    for i, cfg in enumerate(configs):
+        groups.setdefault(int(cfg["maxIter"]), []).append(i)
+
+    scores = np.empty((len(candidates), len(fold_pairs)), np.float64)
+    dispatches = 0
+    for fi, (train_t, val_t) in enumerate(fold_pairs):
+        # fold-cached matrices: ONE extraction + standardization per
+        # fold, shared by every candidate's fit AND scoring
+        X = _features_matrix(train_t, fcol)
+        y = np.asarray(train_t[lcol], dtype=np.float64)
+        mu, sd = _Standardizer.compute(X)
+        Xs = (X - mu) / sd
+        Xval = _features_matrix(val_t, fcol)
+        Xd = jnp.asarray(Xs, jnp.float32)
+        yd = jnp.asarray(y, jnp.float32)
+        if logistic:
+            num_class = int(y.max()) + 1 if len(y) else 2
+            num_class = max(num_class, 2)
+        else:
+            y_mu, y_sd = float(y.mean()), float(y.std() or 1.0)
+            ysd = jnp.asarray((y - y_mu) / y_sd, jnp.float32)
+        for n_steps, idxs in groups.items():
+            lrs = jnp.asarray([configs[i]["stepSize"] for i in idxs],
+                              jnp.float32)
+            l2s = jnp.asarray([configs[i]["regParam"] for i in idxs],
+                              jnp.float32)
+            if logistic:
+                params = _fit_logistic_batch(Xd, yd, lrs, l2s, n_steps,
+                                             num_class)
+            else:
+                params = _fit_linear_batch(Xd, ysd, lrs, l2s, n_steps)
+            dispatches += 1
+            stacked = {k2: np.asarray(v) for k2, v in params.items()}
+            for j, ci in enumerate(idxs):
+                if logistic:
+                    weights = {"W": stacked["W"][j], "b": stacked["b"][j],
+                               "mu": mu, "sd": sd}
+                    mdl: Model = TPULogisticRegressionModel(
+                        weights=weights)
+                else:
+                    weights = {"w": stacked["w"][j], "b": stacked["b"][j],
+                               "mu": mu, "sd": sd,
+                               "y_mu": y_mu, "y_sd": y_sd}
+                    mdl = TPULinearRegressionModel(weights=weights)
+                mdl.set("featuresCol", fcol)
+                mdl.set("predictionCol", pcol)
+                scored = mdl.transform_from_matrix(val_t, Xval)
+                scores[ci, fi] = _evaluate_scored(scored, metric)
+    info.update(path="vmap", dispatches=dispatches,
+                groups=len(groups))
+    return [float(np.mean(scores[c])) for c in range(len(candidates))]
+
+
 class TuneHyperparameters(Estimator):
     """Randomized/grid search with k-fold CV over one or more estimators
-    (ref: TuneHyperparameters.scala:112-188)."""
+    (ref: TuneHyperparameters.scala:112-188). Fold pairs are assembled
+    once and shared across candidates; homogeneous linear-model sweeps
+    with numeric-only deltas run device-batched (see ``batchTrials``)."""
 
     models = ListParam("candidate estimators", default=None)
     paramSpace = StageParam("GridSpace or RandomSpace (or list of spaces "
@@ -150,15 +293,33 @@ class TuneHyperparameters(Estimator):
                        default=10)
     parallelism = IntParam("concurrent evaluations", default=4)
     seed = IntParam("shuffle seed", default=0)
+    batchTrials = EnumParam(
+        ["auto", "on", "off"],
+        "device-batched CV trials: stack all candidates of a vmappable "
+        "linear-model sweep into one jitted vmap program per fold "
+        "('auto' = when eligible, 'on' = warn + serial fallback when "
+        "not, 'off' = always the serial thread pool)", default="auto")
 
     def fit(self, table: DataTable) -> "TuneHyperparametersModel":
+        hists = MC.automl_histograms()
         models: List[Estimator] = self.get("models")
         space = self.get("paramSpace")
         metric = self.get("evaluationMetric")
         ascending = _METRIC_ASCENDING.get(metric, True)
         k = self.get("numFolds")
+
+        # fold pairs built ONCE, outside the candidate loop: the old
+        # path re-ran this concat inside every candidate evaluation —
+        # k x C full-dataset copies before any model trained
+        t0 = time.perf_counter()
         shuffled = table.shuffle(self.get("seed"))
         folds = shuffled.shards(k)
+        fold_pairs: List[Tuple[DataTable, DataTable]] = [
+            (DataTable.concat([f for j, f in enumerate(folds) if j != i]),
+             folds[i])
+            for i in range(k)]
+        hists["tune_fold_build"].observe(
+            (time.perf_counter() - t0) * 1e3)
 
         candidates: List[Tuple[Estimator, Dict[str, Any]]] = []
         for est in models:
@@ -170,22 +331,36 @@ class TuneHyperparameters(Estimator):
                           if _has_param(est, n)}
                 candidates.append((est, usable))
 
-        def eval_candidate(args):
-            est, pm = args
-            scores = []
-            for i in range(k):
-                train_t = DataTable.concat(
-                    [f for j, f in enumerate(folds) if j != i])
-                val_t = folds[i]
-                e = est.copy()
-                for n, v in pm.items():
-                    e.set(n, v)
-                model = e.fit(train_t)
-                scores.append(_evaluate(model, val_t, metric))
-            return float(np.mean(scores))
+        info: Dict[str, Any] = {"path": "serial", "dispatches": 0,
+                                "candidates": len(candidates),
+                                "folds": k}
+        t0 = time.perf_counter()
+        results: Optional[List[float]] = None
+        batch_mode = self.get("batchTrials")
+        if batch_mode != "off":
+            results = _batched_trials(candidates, fold_pairs, metric,
+                                      info)
+            if results is None and batch_mode == "on":
+                _LOG.warning(
+                    "batchTrials='on' but the sweep is not vmappable "
+                    "(mixed estimator families, non-numeric params, or "
+                    "sparse features); falling back to serial trials")
 
-        with ThreadPoolExecutor(self.get("parallelism")) as pool:
-            results = list(pool.map(eval_candidate, candidates))
+        if results is None:
+            def eval_candidate(args):
+                est, pm = args
+                scores = []
+                for train_t, val_t in fold_pairs:
+                    e = est.copy()
+                    for n, v in pm.items():
+                        e.set(n, v)
+                    model = e.fit(train_t)
+                    scores.append(_evaluate(model, val_t, metric))
+                return float(np.mean(scores))
+
+            with ThreadPoolExecutor(self.get("parallelism")) as pool:
+                results = list(pool.map(eval_candidate, candidates))
+        hists["tune_trials"].observe((time.perf_counter() - t0) * 1e3)
 
         best_i = int(np.argmax(results) if ascending
                      else np.argmin(results))
@@ -193,13 +368,17 @@ class TuneHyperparameters(Estimator):
         final = best_est.copy()
         for n, v in best_pm.items():
             final.set(n, v)
+        t0 = time.perf_counter()
         best_model = final.fit(table)
+        hists["tune_refit"].observe((time.perf_counter() - t0) * 1e3)
         history = [{"model": type(e).__name__, "params": pm,
                     "metric": r}
                    for (e, pm), r in zip(candidates, results)]
-        return TuneHyperparametersModel(
+        tuned = TuneHyperparametersModel(
             bestModel=best_model, bestMetric=results[best_i],
             bestParams=best_pm, history=history)
+        tuned.search_info = info
+        return tuned
 
 
 def _has_param(stage, name: str) -> bool:
@@ -216,6 +395,11 @@ class TuneHyperparametersModel(Model):
     bestMetric = _FP("winning CV metric", default=0.0)
     bestParams = _DP("winning param map", default=None)
     history = ListParam("all (model, params, metric) records", default=None)
+
+    def _post_init(self):
+        # how the sweep ran (path: 'vmap'|'serial', dispatches, groups)
+        # — runtime diagnostics, not a persisted param
+        self.search_info: Dict[str, Any] = {}
 
     def transform(self, table: DataTable) -> DataTable:
         return self.get("bestModel").transform(table)

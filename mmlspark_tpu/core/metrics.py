@@ -189,3 +189,27 @@ _GBDT_TRAIN_HISTS: Dict[str, LatencyHistogram] = histogram_set(
 def gbdt_train_histograms() -> Dict[str, LatencyHistogram]:
     """The process-wide GBDT training-phase histogram family."""
     return _GBDT_TRAIN_HISTS
+
+
+# ---------------------------------------------------------------------------
+# AutoML-phase histograms
+# ---------------------------------------------------------------------------
+
+# per-phase wall milliseconds across the convenience-layer hot paths:
+# featurize_fit (per-column stats scan), featurize_transform (columnar
+# kernel build + assembly), tune_fold_build (the ONE k-fold pair
+# assembly all candidates share), tune_trials (the whole C x k trial
+# sweep — device-batched vmap dispatches or the serial thread pool),
+# tune_refit (winning config refit on the full table), image_resize
+# (ImageFeaturizer host decode/resize/pad per batch, on the prefetch
+# thread), image_forward (device dispatch -> readback per batch).
+# Exporters read them like the GBDT training family above.
+AUTOML_PHASES = ("featurize_fit", "featurize_transform",
+                 "tune_fold_build", "tune_trials", "tune_refit",
+                 "image_resize", "image_forward")
+_AUTOML_HISTS: Dict[str, LatencyHistogram] = histogram_set(*AUTOML_PHASES)
+
+
+def automl_histograms() -> Dict[str, LatencyHistogram]:
+    """The process-wide AutoML-phase histogram family."""
+    return _AUTOML_HISTS

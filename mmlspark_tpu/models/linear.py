@@ -93,6 +93,65 @@ def _sparse_logits(csr, W: np.ndarray, b: np.ndarray) -> np.ndarray:
     return logits + b
 
 
+# ---------------------------------------------------------------------------
+# device-batched trials (TuneHyperparameters' vmap CV path)
+# ---------------------------------------------------------------------------
+
+# one increment per jit TRACE of a batched-trial program — the
+# zero-retrace guard for repeated CV sweeps at the same shapes
+# (the linear-model analog of gbdt.booster.trace_counts)
+_TRIAL_TRACES: dict = {"logistic_batch": 0, "linear_batch": 0}
+
+
+def trial_trace_counts() -> dict:
+    """Snapshot of batched-trial trace counters (tests/bench)."""
+    return dict(_TRIAL_TRACES)
+
+
+@partial(jax.jit, static_argnames=("n_steps", "num_class"))
+def _fit_logistic_batch(X, y, lrs, l2s, n_steps: int, num_class: int):
+    """C logistic trials on ONE (train-fold) matrix in one dispatch:
+    vmap over the (lr, l2) candidate vectors, sharing X/y/onehot. The
+    per-candidate program is exactly ``_fit_logistic``'s (same loss,
+    same momentum loop), so a candidate's weights match its serial fit
+    up to XLA's batched-op scheduling."""
+    _TRIAL_TRACES["logistic_batch"] += 1   # trace-time side effect
+    n, d = X.shape
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+
+    def fit_one(lr, l2):
+        def loss_fn(params):
+            logits = X @ params["W"] + params["b"]
+            logp = jax.nn.log_softmax(logits)
+            return (-jnp.mean(jnp.sum(onehot * logp, axis=1))
+                    + l2 * jnp.sum(params["W"] ** 2))
+
+        return _momentum_fit(
+            loss_fn, {"W": jnp.zeros((d, num_class)),
+                      "b": jnp.zeros(num_class)}, lr, n_steps)
+
+    return jax.vmap(fit_one)(lrs, l2s)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _fit_linear_batch(X, y, lrs, l2s, n_steps: int):
+    """C linear-regression trials in one dispatch (see
+    ``_fit_logistic_batch``)."""
+    _TRIAL_TRACES["linear_batch"] += 1   # trace-time side effect
+    n, d = X.shape
+
+    def fit_one(lr, l2):
+        def loss_fn(p):
+            pred = X @ p["w"] + p["b"]
+            return jnp.mean((pred - y) ** 2) + l2 * jnp.sum(p["w"] ** 2)
+
+        return _momentum_fit(
+            loss_fn, {"w": jnp.zeros(d), "b": jnp.asarray(0.0)},
+            lr, n_steps)
+
+    return jax.vmap(fit_one)(lrs, l2s)
+
+
 @partial(jax.jit, static_argnames=("n_steps",))
 def _fit_linear(X, y, lr, l2, n_steps: int):
     n, d = X.shape
@@ -180,11 +239,22 @@ class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
         if isinstance(feats, CSRMatrix) and "mu" not in w:
             logits = _sparse_logits(feats, np.asarray(w["W"]),
                                     np.asarray(w["b"]))
-        else:
-            X = _features_matrix(table, self.get_features_col())
-            if "mu" in w:
-                X = (X - w["mu"]) / w["sd"]
-            logits = X @ w["W"] + w["b"]
+            return self._attach_scores(table, logits)
+        return self.transform_from_matrix(
+            table, _features_matrix(table, self.get_features_col()))
+
+    def transform_from_matrix(self, table: DataTable,
+                              X: np.ndarray) -> DataTable:
+        """``transform`` with the dense (N, D) extraction hoisted by the
+        caller — the CV hot path scores every candidate against ONE
+        cached fold matrix instead of re-extracting it per candidate."""
+        w = self.get("weights")
+        if "mu" in w:
+            X = (X - w["mu"]) / w["sd"]
+        return self._attach_scores(table, X @ w["W"] + w["b"])
+
+    def _attach_scores(self, table: DataTable,
+                       logits: np.ndarray) -> DataTable:
         e = np.exp(logits - logits.max(axis=1, keepdims=True))
         prob = e / e.sum(axis=1, keepdims=True)
         pred = prob.argmax(axis=1).astype(np.float64)
@@ -237,8 +307,14 @@ class TPULinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("w/b/mu/sd arrays", default=None)
 
     def transform(self, table: DataTable) -> DataTable:
+        return self.transform_from_matrix(
+            table, _features_matrix(table, self.get_features_col()))
+
+    def transform_from_matrix(self, table: DataTable,
+                              X: np.ndarray) -> DataTable:
+        """``transform`` with the (N, D) extraction hoisted by the
+        caller (see TPULogisticRegressionModel.transform_from_matrix)."""
         w = self.get("weights")
-        X = _features_matrix(table, self.get_features_col())
         Xs = (X - w["mu"]) / w["sd"]
         pred = (Xs @ w["w"] + w["b"]) * w["y_sd"] + w["y_mu"]
         return table.with_column(self.get_prediction_col(),

@@ -27,6 +27,7 @@ from mmlspark_tpu.core.schema import (
 )
 from mmlspark_tpu.core.stage import Estimator, Model, Transformer
 from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.stages.text import string_codes
 
 
 class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
@@ -54,11 +55,12 @@ class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
 
     def transform(self, table: DataTable) -> DataTable:
         levels = self.get("levels") or []
-        index = {v: i for i, v in enumerate(levels)}
         col = table[self.get_input_col()]
-        out = np.asarray([
-            index.get(v.item() if hasattr(v, "item") else v, -1)
-            for v in col], dtype=np.float64)
+        # columnar: one dict probe per DISTINCT value (arrow dictionary
+        # encode for strings; the np/dict fallbacks keep exact parity —
+        # np scalars hash/compare equal to their .item() values, so the
+        # old per-row .item() normalization is preserved)
+        out = string_codes(col, levels).astype(np.float64)
         f = Field(self.get_output_col(), F64,
                   {"categorical": True, "levels": levels})
         return table.with_column(self.get_output_col(), out, f)
@@ -75,9 +77,19 @@ class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
         levels = self.get("levels") or []
         col = col or self.get_output_col()
         out_col = out_col or self.get_input_col()
-        vals = [levels[int(v)] if 0 <= int(v) < len(levels) else None
-                for v in table[col]]
+        vals = unindex_codes(table[col], levels)
         return table.with_column(out_col, vals)
+
+
+def unindex_codes(codes, levels: List[Any]) -> List[Any]:
+    """Vectorized codes -> original level values (out-of-range/-1 ->
+    None), one levels-table gather instead of a per-row lookup."""
+    arr = np.asarray(codes).astype(np.int64)
+    lut = np.empty(len(levels) + 1, dtype=object)
+    lut[:len(levels)] = levels
+    lut[len(levels)] = None
+    ok = (arr >= 0) & (arr < len(levels))
+    return lut[np.where(ok, arr, len(levels))].tolist()
 
 
 class CleanMissingData(Estimator):

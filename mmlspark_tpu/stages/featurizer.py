@@ -7,17 +7,31 @@ with ``cutOutputLayers`` removing the head layers. Here the zoo network is
 a flax module whose ``feature_layers()`` names its capture points; cutting
 N output layers means capturing at ``feature_layers()[-N]`` and running
 one jitted forward per minibatch, batch sharded over the mesh data axis.
+
+The transform is pipelined: host decode/resize fans over a thread pool
+and runs on a prefetch thread (``utils/prefetch``) so batch k+1's resize
+overlaps batch k's device forward; every batch pads up to ``batchSize``
+with masked rows (sliced off at readback) so the jitted forward compiles
+exactly ONCE per configuration — the final partial batch no longer
+triggers a fresh XLA compile — and the weights pytree is device_put once
+and reused, not re-shipped per call. ``jit_cache_misses`` counts forward
+traces (the recompile guard, TPUModel's discipline).
 """
 
 from __future__ import annotations
 
+import os
+import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from mmlspark_tpu.core import metrics as MC
 from mmlspark_tpu.core.params import (
     BoolParam, DictParam, HasInputCol, HasOutputCol, IntParam, PyTreeParam,
     StringParam,
@@ -28,6 +42,25 @@ from mmlspark_tpu.core.table import DataTable
 from mmlspark_tpu.models.networks import build_network
 from mmlspark_tpu.ops import image_ops
 from mmlspark_tpu.parallel import mesh as mesh_lib
+
+# One process-wide host decode/resize pool shared by every
+# ImageFeaturizer — instances come and go (model reloads, per-request
+# pipelines) and must not each pin a thread set for the process
+# lifetime. Daemon-threaded executor, reaped at interpreter exit.
+_RESIZE_POOL = None
+_RESIZE_POOL_LOCK = threading.Lock()
+
+
+def _shared_resize_pool():
+    global _RESIZE_POOL
+    if _RESIZE_POOL is None:
+        with _RESIZE_POOL_LOCK:
+            if _RESIZE_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _RESIZE_POOL = ThreadPoolExecutor(
+                    max_workers=min(8, os.cpu_count() or 1),
+                    thread_name_prefix="img-resize")
+    return _RESIZE_POOL
 
 
 class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
@@ -57,11 +90,20 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         self._module = None
         self._jitted = None
         self._mesh = None
+        self._device_weights = None
+        # one increment per jit TRACE of the forward (== one XLA
+        # compile per distinct batch shape/dtype): with bucket padding
+        # this stays at 1 per configuration — the recompile guard,
+        # same contract as TPUModel.jit_cache_misses
+        self.jit_cache_misses = 0
+        self._miss_lock = threading.Lock()
 
     def _on_param_change(self, name: str) -> None:
         if name in ("networkSpec", "cutOutputLayers"):
             self._module = None
             self._jitted = None
+        if name == "weights":
+            self._device_weights = None
 
     # -- construction from the model zoo ------------------------------------
 
@@ -82,6 +124,7 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
 
     def set_mesh(self, mesh) -> "ImageFeaturizer":
         self._mesh = mesh
+        self._device_weights = None
         return self
 
     # -- forward ------------------------------------------------------------
@@ -109,40 +152,103 @@ class ImageFeaturizer(Transformer, HasInputCol, HasOutputCol):
         if self._jitted is None:
             module = self._get_module()
             capture = self._capture_layer()
+            model = self
 
             def run(variables, x):
+                # trace-time side effect: runs once per distinct input
+                # signature, i.e. once per XLA compile
+                with model._miss_lock:
+                    model.jit_cache_misses += 1
                 out = module.apply(variables, x, capture=capture)
                 return out.reshape((x.shape[0], -1)).astype(jnp.float32)
 
             self._jitted = jax.jit(run)
         return self._jitted
 
+    def _weights_on_device(self, mesh):
+        """Replicate the weights pytree across the mesh ONCE — the old
+        path handed host numpy leaves to the jitted call every
+        transform, re-shipping the full tree per dispatch."""
+        if self._device_weights is None:
+            variables = self.get("weights")
+            if not (isinstance(variables, dict)
+                    and ("params" in variables or not variables)):
+                variables = {"params": variables}
+            repl = NamedSharding(mesh, P())
+            self._device_weights = jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a), repl), variables)
+        return self._device_weights
+
+    def _get_resize_pool(self):
+        return _shared_resize_pool()
+
     def transform(self, table: DataTable) -> DataTable:
         h, w = self.get("inputHeight"), self.get("inputWidth")
         rows = table[self.get_input_col()]
-        variables = self.get("weights")
-        if not (isinstance(variables, dict)
-                and ("params" in variables or not variables)):
-            variables = {"params": variables}
         mesh = self._mesh or mesh_lib.make_mesh()
         fwd = self._forward()
+        variables = self._weights_on_device(mesh)
         bs = self.get("batchSize")
         scale = 1.0 / 255.0 if self.get("scaleImage") else 1.0
+        hists = MC.automl_histograms()
+        n = len(rows)
+        pool = self._get_resize_pool()
 
-        imgs = []
-        for r in rows:
+        def load_one(r):
             img = np.asarray(r[ImageSchema.DATA], dtype=np.float32)
             if img.ndim == 2:
                 img = img[:, :, None]
             if img.shape[:2] != (h, w):
                 img = image_ops.resize_host(img, h, w)
-            imgs.append(img * scale)
+            return img * scale
+
+        def prepare(start):
+            """Decode + resize (thread-pool fan-out) + pad + device_put
+            — runs on the prefetch thread, overlapping the previous
+            batch's device forward."""
+            t0 = time.perf_counter()
+            chunk = rows[start:min(start + bs, n)]
+            imgs = list(pool.map(load_one, chunk))
+            true_len = len(imgs)
+            if true_len < bs:
+                # pad to the bucket size with masked rows (copies of
+                # the last valid image — valid inputs, no NaN paths),
+                # sliced off at readback: the partial batch keeps the
+                # SAME compiled shape as every full batch
+                imgs.extend([imgs[-1]] * (bs - true_len))
+            batch = np.stack(imgs)
+            sharded, _ = mesh_lib.shard_batch(mesh, batch)
+            hists["image_resize"].observe(
+                (time.perf_counter() - t0) * 1e3)
+            return true_len, sharded
+
         feats: List[np.ndarray] = []
-        for start in range(0, len(imgs), bs):
-            batch = np.stack(imgs[start:start + bs])
-            sharded, true_len = mesh_lib.shard_batch(mesh, batch)
-            out = np.asarray(fwd(variables, sharded))[:true_len]
-            feats.append(out)
+
+        def flush(item):
+            true_len, out, t_dispatch = item
+            feats.append(np.asarray(out)[:true_len])
+            # dispatch -> readback-complete: the device round trip as
+            # the pipeline experiences it (dispatch alone is async)
+            hists["image_forward"].observe(
+                (time.perf_counter() - t_dispatch) * 1e3)
+
+        if n > 0:
+            from mmlspark_tpu.utils.prefetch import make_prefetcher
+            feed = make_prefetcher(range(0, n, bs), prepare, depth=2)
+            pending: List[Any] = []
+            try:
+                for true_len, sharded in feed:
+                    t_dispatch = time.perf_counter()
+                    pending.append((true_len, fwd(variables, sharded),
+                                    t_dispatch))
+                    if len(pending) > 1:
+                        # delayed-by-one readback: batch k's D2H
+                        # overlaps batch k+1's device execution
+                        flush(pending.pop(0))
+            finally:
+                feed.close()
+            for item in pending:
+                flush(item)
         merged = (np.concatenate(feats, axis=0) if feats
                   else np.empty((0, 0), np.float32))
         return table.with_column(self.get_output_col(), merged,

@@ -61,6 +61,325 @@ class TestFeaturize:
         assert model.transform(t)["features"].shape == (3, 4)
 
 
+class TestVectorizedFeaturizeParity:
+    """The columnar kernels must be BIT-identical to the retained
+    per-row reference loops (``FeaturizeModel.transform_rowloop``) on
+    every spec kind, including the adversarial cases the row loops
+    handled implicitly."""
+
+    def _adversarial_table(self, n=400, seed=3):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=n)
+        x[rng.random(n) < 0.1] = np.nan
+        x[rng.random(n) < 0.02] = np.inf
+        x[rng.random(n) < 0.02] = -np.inf
+        levels = ["alpha", "beta", "gamma", "delta"]
+        color = [levels[i] if i < len(levels) else None
+                 for i in rng.integers(0, 6, n)]   # None rows included
+        words = [f"tok{i:02d}" for i in range(40)]
+        toks = []
+        for ln in rng.integers(0, 7, n):
+            row = [words[j] for j in rng.integers(0, 40, ln)]
+            row += row[:2]   # repeated tokens within a row
+            toks.append(row if ln else [])
+        toks[0] = None       # None list row
+        toks[1] = [1, 2, 1]  # non-string tokens (stringified by both)
+        return DataTable({"x": x, "color": color, "toks": toks})
+
+    def _assert_parity(self, model, table):
+        out = model.transform(table)["features"]
+        ref = model.transform_rowloop(table)["features"]
+        from mmlspark_tpu.core.sparse import CSRMatrix
+        if isinstance(out, CSRMatrix):
+            assert isinstance(ref, CSRMatrix)
+            assert out.shape == ref.shape
+            assert np.array_equal(out.data, ref.data)
+            assert np.array_equal(out.indices, ref.indices)
+            assert np.array_equal(out.indptr, ref.indptr)
+        else:
+            assert out.dtype == ref.dtype
+            assert np.array_equal(out, ref)   # bit-identical, NaN-free
+
+    def test_dense_parity_mixed_adversarial(self):
+        t = self._adversarial_table()
+        model = Featurize(featureColumns=["x", "color", "toks"],
+                          numberOfFeatures=32).fit(t)
+        self._assert_parity(model, t)
+
+    def test_dense_parity_one_hot(self):
+        t = self._adversarial_table(seed=4)
+        model = Featurize(featureColumns=["x", "color", "toks"],
+                          numberOfFeatures=32,
+                          oneHotEncodeCategoricals=True).fit(t)
+        self._assert_parity(model, t)
+
+    def test_parity_unseen_levels_at_transform(self):
+        # fit on a slice that misses some levels; transform the full
+        # table -> unseen strings hit the -1/skip path in both kernels
+        t = self._adversarial_table(seed=5)
+        fit_t = DataTable({c: t[c][:50] for c in t.column_names})
+        for onehot in (False, True):
+            model = Featurize(featureColumns=["x", "color", "toks"],
+                              numberOfFeatures=16,
+                              oneHotEncodeCategoricals=onehot).fit(fit_t)
+            self._assert_parity(model, t)
+
+    def test_csr_parity(self):
+        t = self._adversarial_table(seed=6)
+        model = Featurize(featureColumns=["toks"], numberOfFeatures=64,
+                          sparse=True).fit(t)
+        self._assert_parity(model, t)
+
+    def test_fit_levels_match_distinct_values(self):
+        # vectorized fit-side level scan == the old sorted-distinct
+        t = self._adversarial_table(seed=7)
+        model = Featurize(featureColumns=["color"]).fit(t)
+        spec = model.get("specs")[0]
+        expected = sorted(v for v in set(t["color"]) if v is not None)
+        assert spec["levels"] == expected
+
+
+class TestVectorizedHashingTF:
+    def _tokens(self, n=120, seed=2):
+        rng = np.random.default_rng(seed)
+        words = [f"w{i}" for i in range(30)]
+        rows = [[words[j] for j in rng.integers(0, 30, ln)]
+                for ln in rng.integers(0, 9, n)]
+        rows[0] = []
+        return rows
+
+    def test_dense_matches_rowloop_reference(self):
+        from mmlspark_tpu.stages.text import (
+            _hash_counts, hash_counts_dense)
+        toks = self._tokens()
+        m = 32
+        got = hash_counts_dense(toks, m)
+        ref = np.zeros((len(toks), m), np.float32)
+        for i, row in enumerate(toks):
+            for idx, cnt in _hash_counts(row, m, False).items():
+                ref[i, idx] = cnt
+        assert np.array_equal(got, ref)
+
+    def test_binary_mode(self):
+        from mmlspark_tpu.stages.text import hash_counts_dense
+        toks = [["a", "a", "b"], ["b"]]
+        got = hash_counts_dense(toks, 8, binary=True)
+        assert set(np.unique(got)) <= {0.0, 1.0}
+        assert got[0].sum() == 2.0   # two distinct buckets, not 3 counts
+
+    def test_csr_matches_from_rows(self):
+        from mmlspark_tpu.core.sparse import CSRMatrix
+        from mmlspark_tpu.stages.text import (
+            _hash_counts, hash_counts_csr)
+        toks = self._tokens(seed=8)
+        m = 64
+        got = hash_counts_csr(toks, m)
+        ref = CSRMatrix.from_rows(
+            (_hash_counts(row, m, False) for row in toks), num_cols=m)
+        assert got.shape == ref.shape
+        assert np.array_equal(got.data, ref.data)
+        assert np.array_equal(got.indices, ref.indices)
+        assert np.array_equal(got.indptr, ref.indptr)
+
+    def test_vectorized_byte_fnv_matches_scalar(self):
+        # the large-vocabulary kernel: FNV-1a over arrow utf-8 buffers
+        # must equal the scalar hash for ANY content, multibyte included
+        pa = pytest.importorskip("pyarrow")
+        from mmlspark_tpu.stages.text import (
+            _fnv_string_array, _stable_hash)
+        toks = ([f"tok{i}" for i in range(300)]
+                + ["", "héllo", "日本語",
+                   "a" * 40, "mixedß1"])
+        got = _fnv_string_array(pa.array(toks, type=pa.string()))
+        assert [int(x) for x in got] == [_stable_hash(t) for t in toks]
+
+    def test_large_vocab_kernel_parity(self, monkeypatch):
+        # force the vectorized-FNV branch (vocab > threshold)
+        import mmlspark_tpu.stages.text as T
+        monkeypatch.setattr(T, "_VECTOR_HASH_MIN_VOCAB", 8)
+        toks = self._tokens(n=200, seed=10)
+        got = T.hash_counts_dense(toks, 32)
+        ref = np.zeros((len(toks), 32), np.float32)
+        for i, row in enumerate(toks):
+            for idx, cnt in T._hash_counts(row, 32, False).items():
+                ref[i, idx] = cnt
+        assert np.array_equal(got, ref)
+
+    def test_pipelined_ingest_parity(self, monkeypatch):
+        # shrink the pipeline threshold so the 2-stage chunked path
+        # runs at test size; parity must hold across chunk boundaries
+        import mmlspark_tpu.stages.text as T
+        monkeypatch.setattr(T, "_PIPELINE_ROWS", 16)
+        toks = self._tokens(n=150, seed=11)
+        out = np.empty((150, 32), np.float32)
+        got = T.hash_counts_dense(toks, 32, out=out)
+        monkeypatch.setattr(T, "_PIPELINE_ROWS", 1 << 17)
+        ref = T.hash_counts_dense(toks, 32)
+        assert got is out
+        assert np.array_equal(got, ref)
+
+    def test_pipelined_ingest_falls_back_mid_stream(self, monkeypatch):
+        # a non-string token in a LATE chunk aborts the pipeline; the
+        # single-shot fallback must still produce the oracle output
+        import mmlspark_tpu.stages.text as T
+        monkeypatch.setattr(T, "_PIPELINE_ROWS", 16)
+        toks = self._tokens(n=100, seed=12)
+        toks[90] = [1, 2, 1]   # stringified by the fallback
+        got = T.hash_counts_dense(toks, 32)
+        ref = np.zeros((100, 32), np.float32)
+        for i, row in enumerate(toks):
+            for idx, cnt in T._hash_counts(row, 32, False).items():
+                ref[i, idx] = cnt
+        assert np.array_equal(got, ref)
+
+    def test_hash_memo_consistency(self):
+        # memoized distinct-token hashing == direct _stable_hash
+        from mmlspark_tpu.stages.text import _hash_distinct, _stable_hash
+        words = [f"memo_tok_{i}" for i in range(50)]
+        first = _hash_distinct(words)
+        again = _hash_distinct(words)   # served from the memo
+        assert np.array_equal(first, again)
+        assert all(first[i] == _stable_hash(w)
+                   for i, w in enumerate(words))
+
+    def test_transformer_dense_and_sparse(self):
+        from mmlspark_tpu.stages.text import HashingTF
+        toks = self._tokens(seed=9)
+        t = DataTable({"toks": toks})
+        dense = HashingTF(inputCol="toks", outputCol="tf",
+                          numFeatures=32).transform(t)["tf"]
+        sparse = HashingTF(inputCol="toks", outputCol="tf",
+                           numFeatures=32, sparse=True).transform(t)["tf"]
+        assert np.array_equal(dense, sparse.toarray())
+
+
+class TestBatchedTrials:
+    """The device-batched (vmap) CV sweep must select the SAME model as
+    the serial thread-pool path, in <= k+1 dispatches for a
+    single-maxIter sweep, and fall back to serial whenever the sweep is
+    not vmappable."""
+
+    def _class_table(self, n=600, seed=0):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(n, 8)).astype(np.float32)
+        y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+        return DataTable({"features": X, "label": y})
+
+    def _space(self):
+        return (HyperparamBuilder()
+                .add_hyperparam("stepSize",
+                                RangeHyperParam(0.05, 1.0, log=True))
+                .add_hyperparam("regParam",
+                                RangeHyperParam(1e-5, 1e-2, log=True))
+                .build())
+
+    def _tuner(self, mode, models=None, space=None, runs=8, folds=3):
+        return TuneHyperparameters(
+            models=models or [TPULogisticRegression(maxIter=30)],
+            paramSpace=RandomSpace(space or self._space(), seed=0),
+            evaluationMetric=MC.ACCURACY, numFolds=folds, numRuns=runs,
+            seed=0, batchTrials=mode)
+
+    def test_vmap_matches_serial_selection(self):
+        t = self._class_table()
+        tv = self._tuner("auto").fit(t)
+        ts = self._tuner("off").fit(t)
+        assert tv.search_info["path"] == "vmap"
+        assert ts.search_info["path"] == "serial"
+        # 8 candidates x 3 folds, one maxIter group: k dispatches
+        # (acceptance bound is k+1)
+        assert tv.search_info["dispatches"] <= 4
+        assert tv.get("bestParams") == ts.get("bestParams")
+        assert tv.get("bestMetric") == ts.get("bestMetric")
+
+    def test_vmap_per_candidate_scores_match_serial(self):
+        t = self._class_table(seed=1)
+        hv = self._tuner("auto").fit(t).get("history")
+        hs = self._tuner("off").fit(t).get("history")
+        assert [h["params"] for h in hv] == [h["params"] for h in hs]
+        np.testing.assert_allclose([h["metric"] for h in hv],
+                                   [h["metric"] for h in hs],
+                                   rtol=0, atol=1e-12)
+
+    def test_vmap_linear_regression_family(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(500, 5)).astype(np.float32)
+        y = (X @ np.asarray([1.0, -2.0, 0.5, 0.0, 3.0],
+                            np.float32)).astype(np.float64)
+        t = DataTable({"features": X, "label": y})
+        mk = lambda mode: TuneHyperparameters(
+            models=[TPULinearRegression(maxIter=40)],
+            paramSpace=RandomSpace(self._space(), seed=0),
+            evaluationMetric=MC.RMSE, numFolds=3, numRuns=6, seed=0,
+            batchTrials=mode)
+        tv, ts = mk("auto").fit(t), mk("off").fit(t)
+        assert tv.search_info["path"] == "vmap"
+        assert tv.get("bestParams") == ts.get("bestParams")
+        np.testing.assert_allclose(tv.get("bestMetric"),
+                                   ts.get("bestMetric"), rtol=1e-5)
+
+    def test_maxiter_groups_one_dispatch_each(self):
+        space = dict(self._space())
+        space["maxIter"] = DiscreteHyperParam([10, 20])
+        t = self._class_table(seed=3)
+        tv = self._tuner("auto", space=space).fit(t)
+        ts = self._tuner("off", space=space).fit(t)
+        assert tv.search_info["path"] == "vmap"
+        assert tv.search_info["groups"] == 2
+        # one dispatch per (fold, maxIter group)
+        assert tv.search_info["dispatches"] <= 3 * 2
+        assert tv.get("bestParams") == ts.get("bestParams")
+
+    def test_sparse_features_fall_back_to_serial(self):
+        rng = np.random.default_rng(4)
+        toks = [[f"w{j}" for j in rng.integers(0, 20, 5)]
+                for _ in range(240)]
+        y = np.asarray([float(len(set(r)) > 4) for r in toks])
+        raw = DataTable({"toks": toks, "label": y})
+        feat = Featurize(featureColumns=["toks"], numberOfFeatures=64,
+                         sparse=True).fit(raw)
+        t = feat.transform(raw)
+        tuned = self._tuner("auto", runs=3).fit(t)
+        assert tuned.search_info["path"] == "serial"
+
+    def test_mixed_families_fall_back_with_warning(self):
+        import logging
+        t = self._class_table(seed=5)
+        space = (HyperparamBuilder()
+                 .add_hyperparam("numIterations",
+                                 DiscreteHyperParam([5, 10]))
+                 .build())
+        tuner = TuneHyperparameters(
+            models=[TPUBoostClassifier(minDataInLeaf=5)],
+            paramSpace=GridSpace(space), evaluationMetric=MC.ACCURACY,
+            numFolds=2, seed=0, batchTrials="on")
+        records = []
+        handler = logging.Handler()
+        handler.emit = records.append   # package logger: propagate=False
+        log = logging.getLogger("mmlspark_tpu.automl.tuning")
+        log.addHandler(handler)
+        try:
+            tuned = tuner.fit(t)
+        finally:
+            log.removeHandler(handler)
+        assert tuned.search_info["path"] == "serial"
+        assert any("not vmappable" in r.getMessage() for r in records)
+
+    def test_batch_trials_off_never_batches(self):
+        t = self._class_table(seed=6)
+        tuned = self._tuner("off", runs=2).fit(t)
+        assert tuned.search_info["path"] == "serial"
+        assert tuned.search_info["dispatches"] == 0
+
+    def test_zero_retrace_on_repeated_sweeps(self):
+        from mmlspark_tpu.models.linear import trial_trace_counts
+        t = self._class_table(seed=7)
+        self._tuner("auto", runs=4).fit(t)          # warm
+        before = trial_trace_counts()
+        self._tuner("auto", runs=4).fit(t)          # same shapes
+        assert trial_trace_counts() == before
+
+
 class TestTrainClassifier:
     def test_string_labels_roundtrip(self, mixed_table):
         t, y = mixed_table

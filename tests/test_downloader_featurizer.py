@@ -137,3 +137,54 @@ class TestImageFeaturizer:
                                                  cutOutputLayers=99)
         with pytest.raises(ValueError, match="feature layers"):
             feat.transform(_image_table(2))
+
+
+class TestImageFeaturizerPipeline:
+    """The pipelined transform: partial batches pad to ``batchSize``
+    (one compiled shape, ever) and the prefetch/readback overlap must
+    not reorder or corrupt rows."""
+
+    def test_mixed_table_sizes_zero_steady_state_recompiles(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(
+            schema, dl, cutOutputLayers=1, batchSize=4)
+        feat.transform(_image_table(6))   # warm: the ONE compile
+        assert feat.jit_cache_misses == 1
+        for n in (3, 7, 4, 1, 9):         # partial + exact + multi-batch
+            out = feat.transform(_image_table(n, seed=n))
+            assert out["features"].shape[0] == n
+        assert feat.jit_cache_misses == 1, (
+            "partial/mixed batch sizes must reuse the padded-bucket "
+            "compile, not trigger fresh XLA compiles")
+
+    def test_partial_batch_matches_single_batch(self, zoo):
+        # 6 rows at batchSize=4 (padded partial last batch) must equal
+        # the same rows at batchSize=8 (one full-table batch): padding
+        # rows are sliced off and never leak into valid outputs
+        _, dl, schema = zoo
+        t = _image_table(6, seed=11)
+        f_split = ImageFeaturizer.from_model_schema(
+            schema, dl, cutOutputLayers=1, batchSize=4).transform(t)
+        f_whole = ImageFeaturizer.from_model_schema(
+            schema, dl, cutOutputLayers=1, batchSize=8).transform(t)
+        np.testing.assert_allclose(f_split["features"],
+                                   f_whole["features"], atol=1e-5)
+
+    def test_weights_shipped_once(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(
+            schema, dl, cutOutputLayers=1, batchSize=4)
+        feat.transform(_image_table(4))
+        dev = feat._device_weights
+        assert dev is not None
+        feat.transform(_image_table(4, seed=1))
+        assert feat._device_weights is dev   # reused, not re-put
+        feat.set("weights", feat.get("weights"))
+        assert feat._device_weights is None  # param change invalidates
+
+    def test_empty_table(self, zoo):
+        _, dl, schema = zoo
+        feat = ImageFeaturizer.from_model_schema(
+            schema, dl, cutOutputLayers=1, batchSize=4)
+        out = feat.transform(_image_table(0))
+        assert out["features"].shape[0] == 0

@@ -211,3 +211,92 @@ class TestServingQPSFloor:
         # the bucketed compile cache held: NO steady-state recompiles
         assert recompiles == 0, (
             f"{recompiles} recompile(s) during steady-state serving")
+
+
+class TestAutoMLFloor:
+    def test_featurize_vectorization_floor(self):
+        """The columnar Featurize kernels vs the retained row-loop
+        reference on a 200k-row mixed table: the speedup RATIO is
+        host-noise-robust (both sides measured back to back on the same
+        data), so a regression that reintroduces per-row Python — a
+        dict probe per row, a per-token hash call — fails by an order
+        of magnitude. bench.py's automl scenario measures the full
+        1M-row number (acceptance: >= 10x there)."""
+        from mmlspark_tpu.automl.featurize import Featurize
+
+        rng = np.random.default_rng(0)
+        n = 200_000
+        x = rng.normal(size=n)
+        x[rng.random(n) < 0.01] = np.nan
+        color = [f"c{i}" for i in rng.integers(0, 12, n)]
+        words = [f"token{i:04d}" for i in range(2000)]
+        lens = rng.integers(5, 13, n)
+        ids = rng.integers(0, len(words), int(lens.sum()))
+        toks, pos = [], 0
+        for ln in lens:
+            toks.append([words[j] for j in ids[pos:pos + ln]])
+            pos += int(ln)
+        t = DataTable({"x": x, "color": color, "toks": toks})
+        model = Featurize(featureColumns=["x", "color", "toks"],
+                          numberOfFeatures=64).fit(t)
+        # warm both kernels on a small slice: pyarrow lazily initializes
+        # its conversion machinery on first use (~1.5s, data-independent)
+        # and the floor measures the kernels, not library init
+        warm = DataTable({c: t[c][:2048] for c in t.column_names})
+        model.transform(warm)
+        model.transform_rowloop(warm)
+        t0 = time.perf_counter()
+        out = model.transform(t)
+        vec_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ref = model.transform_rowloop(t)
+        rowloop_s = time.perf_counter() - t0
+        assert np.array_equal(out["features"], ref["features"]), (
+            "vectorized featurization diverged from the row-loop oracle")
+        speedup = rowloop_s / vec_s
+        # idle-host measurement ~30-60x on this shape; 8x rides out
+        # shared-host noise while any reintroduced per-row loop
+        # (the thing this PR removed) lands near 1x
+        assert speedup >= 8, (
+            f"featurize vectorization floor: {speedup:.1f}x "
+            f"(columnar {vec_s:.2f}s vs rowloop {rowloop_s:.2f}s)")
+
+    def test_tune_vmap_dispatch_and_retrace_floor(self):
+        """The device-batched CV sweep must stay a handful of
+        dispatches (<= k+1 for a single-maxIter sweep — acceptance
+        criterion) and must NOT retrace on a repeated same-shape sweep
+        (lru'd jit programs, the GBDT chunk-fn discipline)."""
+        from mmlspark_tpu.automl.tuning import (
+            HyperparamBuilder, RandomSpace, RangeHyperParam,
+            TuneHyperparameters,
+        )
+        from mmlspark_tpu.models.linear import (
+            TPULogisticRegression, trial_trace_counts,
+        )
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(2000, 16)).astype(np.float32)
+        y = (X[:, 0] - X[:, 3] > 0).astype(np.float64)
+        t = DataTable({"features": X, "label": y})
+        space = (HyperparamBuilder()
+                 .add_hyperparam("stepSize",
+                                 RangeHyperParam(0.05, 1.0, log=True))
+                 .add_hyperparam("regParam",
+                                 RangeHyperParam(1e-5, 1e-2, log=True))
+                 .build())
+
+        def sweep():
+            return TuneHyperparameters(
+                models=[TPULogisticRegression(maxIter=40)],
+                paramSpace=RandomSpace(space, seed=0),
+                evaluationMetric="accuracy", numFolds=3, numRuns=8,
+                seed=0).fit(t)
+
+        tuned = sweep()
+        info = tuned.search_info
+        assert info["path"] == "vmap", info
+        assert info["dispatches"] <= info["folds"] + 1, info
+        before = trial_trace_counts()
+        tuned2 = sweep()   # identical shapes: must hit the jit cache
+        assert trial_trace_counts() == before, "vmap CV sweep retraced"
+        assert tuned2.get("bestParams") == tuned.get("bestParams")
