@@ -478,7 +478,170 @@ def bench_serving() -> dict:
     }
 
 
+SWAP_REQUESTS = 600
+SWAP_CLIENTS = 12
+
+
+def bench_swap() -> dict:
+    """Zero-downtime model lifecycle under steady load: a 2-engine
+    fleet serving an MLP scorer takes one ROLLING SWAP to a refreshed
+    model mid-run (warmup-before-cutover, canary, drain — see
+    serving/lifecycle.py). Reports availability across the run, p99
+    both overall and DURING the swap window, and the recompile count
+    outside the two models' warmups (the zero-steady-state-recompiles
+    contract must hold straight through a swap)."""
+    import concurrent.futures
+    import threading
+
+    from mmlspark_tpu.models.networks import build_network
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    from mmlspark_tpu.serving.fleet import ServingFleet, json_scoring_pipeline
+    from mmlspark_tpu.serving.lifecycle import CanaryPolicy
+
+    import jax
+
+    module = build_network({"type": "mlp", "features": [256, 128],
+                            "num_classes": 10})
+    rng = np.random.default_rng(0)
+    x0 = np.zeros((1, SERVING_FEATURE_DIM), np.float32)
+
+    def make_model(seed):
+        weights = {"params": module.init(
+            jax.random.PRNGKey(seed), x0)["params"]}
+        return TPUModel(modelFn=lambda w, ins: module.apply(
+            {"params": w["params"]}, list(ins.values())[0]),
+            weights=weights, inputCol="features", outputCol="scores",
+            batchSize=256, computeDtype="float32")
+
+    m1, m2 = make_model(0), make_model(1)
+    m1.warmup({"features": x0})     # v1 pre-compiled before traffic
+    fleet = ServingFleet(json_scoring_pipeline(m1), n_engines=2,
+                         base_port=18900, batch_size=256, workers=2,
+                         max_wait_ms=SERVING_MAX_WAIT_MS)
+    payload = json.dumps(
+        {"features": rng.normal(size=SERVING_FEATURE_DIM).tolist()}
+    ).encode()
+    swap_window = {}
+    failures = [0]
+    fail_lock = threading.Lock()
+
+    def post(_i):
+        t0 = time.perf_counter()
+        try:
+            body = fleet.post(payload, timeout=60)
+            assert "prediction" in body, body
+        except Exception:  # noqa: BLE001 — availability metric
+            with fail_lock:
+                failures[0] += 1
+            return None
+        return (t0, (time.perf_counter() - t0) * 1e3)
+
+    try:
+        for _ in fleet.addresses:
+            post(0)
+        failures[0] = 0   # priming posts don't count against the
+        #                   measured window's availability
+        misses_before = m1.jit_cache_misses + m2.jit_cache_misses
+        lat = []
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(SWAP_CLIENTS) as ex:
+            futs = [ex.submit(post, i) for i in range(SWAP_REQUESTS)]
+            time.sleep(0.3)          # steady load established
+            swap_t0 = time.perf_counter()
+            report = fleet.rolling_swap(
+                json_scoring_pipeline(m2), "v2",
+                warmup_example={"features": x0},
+                policy=CanaryPolicy(fraction=0.25, min_batches=4,
+                                    decision_timeout_s=30))
+            swap_t1 = time.perf_counter()
+            for f in concurrent.futures.as_completed(futs):
+                if f.result() is not None:
+                    lat.append(f.result())
+        wall = time.perf_counter() - t0
+        # m2's warmup compiles are part of the SWAP (off the hot path);
+        # subtract them via the model's own warmup-time counter delta
+        recompiles = (m1.jit_cache_misses + m2.jit_cache_misses
+                      - misses_before)
+        warm_compiles = len(m2.bucket_sizes())
+        swap_window.update(report)
+    finally:
+        fleet.stop_all()
+    all_ms = np.asarray([ms for _, ms in lat])
+    during = np.asarray([ms for t, ms in lat
+                         if swap_t0 <= t <= swap_t1]) \
+        if len(lat) else np.asarray([])
+    total = SWAP_REQUESTS
+    return {
+        "metric": "serving_rolling_swap",
+        "availability": round((total - failures[0]) / total, 4),
+        "qps": round(total / wall, 1),
+        "p99_ms": round(float(np.percentile(all_ms, 99)), 1)
+        if len(all_ms) else None,
+        "p99_during_swap_ms": round(float(np.percentile(during, 99)), 1)
+        if len(during) else None,
+        "swap_wall_s": round(swap_t1 - swap_t0, 2),
+        "swap_report": {"ok": swap_window.get("ok"),
+                        "completed": swap_window.get("completed"),
+                        "rolled_back": swap_window.get("rolled_back")},
+        "recompiles_total": recompiles,
+        "recompiles_beyond_new_model_warmup": recompiles - warm_compiles,
+        "config": (f"{SWAP_REQUESTS} reqs, {SWAP_CLIENTS} clients, "
+                   f"2 engines, rolling swap mid-run, canary 25% / "
+                   f"4 batches, MLP-{SERVING_FEATURE_DIM}"),
+    }
+
+
+# scenario registry for --scenarios (cheap subsets of the full bench:
+# the serving/lifecycle numbers are measurable on any backend, the
+# training-throughput scenarios only mean anything on the TPU chip)
+SCENARIOS = {
+    "cifar": lambda: ("secondary_cifar", bench_cifar()),
+    "resnet": lambda: ("secondary_resnet", bench_resnet()),
+    "lm": lambda: ("secondary_lm", bench_lm()),
+    "serving": lambda: ("secondary_serving", bench_serving()),
+    "swap": lambda: ("secondary_swap", bench_swap()),
+    "automl": lambda: ("secondary_automl", bench_automl()),
+}
+
+
 def main():
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--scenarios", default="all",
+        help="comma list from {cifar,resnet,lm,higgs,serving,swap,"
+             "automl} or 'all' (the full flagship bench)")
+    args = ap.parse_args()
+    if args.scenarios != "all":
+        _enable_compile_cache()
+        import jax
+        out = {"backend": jax.default_backend(),
+               "scenarios_run": sorted(args.scenarios.split(","))}
+        for name in args.scenarios.split(","):
+            name = name.strip()
+            if name == "higgs":
+                higgs, auc, hist_method = bench_higgs_gbdt()
+                out["secondary"] = {
+                    "metric": "higgs1m_gbdt_train_wall_clock",
+                    "value": higgs[63]["wall_s"], "unit": "s",
+                    "hist_method": hist_method,
+                    "synthetic_holdout_auc": round(auc, 4),
+                    "phases": higgs[63]["phases"],
+                    "bin_path": higgs[63]["bin_path"],
+                    "host_bin_63": higgs["host_bin_63"],
+                    "max_bin_255": higgs[255],
+                }
+                continue
+            if name not in SCENARIOS:
+                raise SystemExit(f"unknown scenario {name!r}")
+            key, result = SCENARIOS[name]()
+            out[key] = result
+        print(json.dumps(out))
+        return
+    _run_full()
+
+
+def _run_full():
     _enable_compile_cache()
     measured = _measured_baselines()
     cifar = bench_cifar()

@@ -1,5 +1,5 @@
 """Metric name constants (ref: src/core/metrics/src/main/scala/MetricConstants.scala:9-83)
-plus the serving-path latency histogram.
+plus the serving-path latency histogram and feature-drift counters.
 """
 
 from __future__ import annotations
@@ -213,3 +213,133 @@ _AUTOML_HISTS: Dict[str, LatencyHistogram] = histogram_set(*AUTOML_PHASES)
 def automl_histograms() -> Dict[str, LatencyHistogram]:
     """The process-wide AutoML-phase histogram family."""
     return _AUTOML_HISTS
+
+
+# ---------------------------------------------------------------------------
+# feature-drift counters (serving-time vs fit-time statistics)
+# ---------------------------------------------------------------------------
+
+
+class DriftMonitor:
+    """Running per-feature statistics of served traffic vs fit-time stats.
+
+    Holds the fit-time reference (per-feature mean/var) and accumulates
+    a running count/mean/M2 (Chan et al. parallel-Welford merge, one
+    vectorized update per batch) plus per-feature null (NaN/inf) counts
+    over everything ``observe``d. ``summary()`` reports the deltas the
+    lifecycle layer watches: max |mean shift| in reference-sigma units,
+    max var ratio, and the null rate — the serving-side analog of the
+    reference's verifyResult data-validation gate, exported through
+    ``engine.metrics()``/``/healthz`` so a canary that *works* but sees
+    a shifted feature distribution is visible before it breaches.
+
+    Thread-safe: serving batcher threads observe concurrently.
+    """
+
+    def __init__(self, ref_mean, ref_var, feature_names=None):
+        import numpy as np
+        self.ref_mean = np.asarray(ref_mean, dtype=np.float64).ravel()
+        # (near-)constant fit-time features get unit variance for the
+        # delta denominators (the _Standardizer discipline): a true
+        # sigma of ~0 would turn float32 round-trip noise into a
+        # million-sigma "drift" and pin worst_feature forever
+        ref_var = np.asarray(ref_var, dtype=np.float64).ravel()
+        self.ref_var = np.where(ref_var < 1e-24, 1.0, ref_var)
+        if self.ref_mean.shape != self.ref_var.shape:
+            raise ValueError("ref_mean and ref_var shapes differ")
+        self.feature_names = list(feature_names) if feature_names else None
+        d = self.ref_mean.shape[0]
+        self._n = 0                      # finite observations per feature
+        self._mean = np.zeros(d)
+        self._m2 = np.zeros(d)
+        self._nulls = np.zeros(d, dtype=np.int64)
+        self._rows = 0
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_matrix(cls, X, feature_names=None) -> "DriftMonitor":
+        """Reference stats from the fit-time feature matrix."""
+        import numpy as np
+        X = np.asarray(X, dtype=np.float64)
+        finite = np.isfinite(X)
+        n = np.maximum(finite.sum(axis=0), 1)
+        mean = np.where(finite, X, 0.0).sum(axis=0) / n
+        var = np.where(finite, (X - mean) ** 2, 0.0).sum(axis=0) / n
+        return cls(mean, var, feature_names=feature_names)
+
+    def observe(self, X) -> None:
+        """Fold one (N, D) served batch into the running statistics."""
+        import numpy as np
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.shape[0] == 0:
+            return
+        finite = np.isfinite(X)
+        nb = finite.sum(axis=0)
+        safe = np.maximum(nb, 1)
+        mean_b = np.where(finite, X, 0.0).sum(axis=0) / safe
+        m2_b = np.where(finite, (X - mean_b) ** 2, 0.0).sum(axis=0)
+        with self._lock:
+            self._rows += X.shape[0]
+            self._nulls += (X.shape[0] - nb)
+            # parallel-Welford merge of (nb, mean_b, m2_b) into the
+            # running (n, mean, m2) — per-feature counts stay scalar
+            # here because observe() masks non-finite values per column
+            n_new = self._n + nb
+            delta = mean_b - self._mean
+            safe_new = np.maximum(n_new, 1)
+            self._mean = self._mean + delta * (nb / safe_new)
+            self._m2 = (self._m2 + m2_b
+                        + delta ** 2 * (self._n * nb / safe_new))
+            self._n = n_new
+
+    def summary(self) -> Dict[str, object]:
+        """Compact drift verdict: aggregates over features (the wide
+        per-feature arrays stay behind ``snapshot()``)."""
+        import numpy as np
+        with self._lock:
+            n, mean, m2 = np.asarray(self._n), self._mean.copy(), \
+                self._m2.copy()
+            nulls, rows = self._nulls.copy(), self._rows
+        if rows == 0:
+            return {"rows": 0}
+        seen = np.asarray(n) > 0
+        sigma = np.sqrt(self.ref_var)
+        mean_delta = np.where(seen, (mean - self.ref_mean) / sigma, 0.0)
+        var = np.where(np.asarray(n) > 1, m2 / np.maximum(n, 1), 0.0)
+        var_ratio = np.where(np.asarray(n) > 1, var / self.ref_var, 1.0)
+        null_rate = float(nulls.sum()) / (rows * len(self.ref_mean))
+        worst = int(np.abs(mean_delta).argmax())
+        out: Dict[str, object] = {
+            "rows": int(rows),
+            "max_abs_mean_delta_sigma": round(
+                float(np.abs(mean_delta).max()), 4),
+            "max_var_ratio": round(float(var_ratio.max()), 4),
+            "null_rate": round(null_rate, 6),
+            "worst_feature": (self.feature_names[worst]
+                              if self.feature_names else worst),
+        }
+        return out
+
+    def snapshot(self) -> Dict[str, object]:
+        """Full per-feature arrays for exporters/tests."""
+        import numpy as np
+        with self._lock:
+            n = np.asarray(self._n).copy()
+            mean, m2 = self._mean.copy(), self._m2.copy()
+            nulls, rows = self._nulls.copy(), self._rows
+        var = np.where(n > 1, m2 / np.maximum(n, 1), 0.0)
+        return {"rows": int(rows), "count": n, "mean": mean, "var": var,
+                "nulls": nulls, "ref_mean": self.ref_mean.copy(),
+                "ref_var": self.ref_var.copy()}
+
+    def reset(self) -> None:
+        import numpy as np
+        with self._lock:
+            d = self.ref_mean.shape[0]
+            self._n = 0
+            self._mean = np.zeros(d)
+            self._m2 = np.zeros(d)
+            self._nulls = np.zeros(d, dtype=np.int64)
+            self._rows = 0

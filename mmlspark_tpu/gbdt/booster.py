@@ -82,6 +82,13 @@ DEFAULTS: Dict[str, Any] = {
     # host binning; 'on' asks for device binning and warns (falling
     # back) when ineligible
     "device_binning": "auto",
+    # keep the device-resident training state (binned matrix, running
+    # scores, forest buffer) on the returned Booster so
+    # boost_more(data=None) continues boosting EXACTLY where train()
+    # stopped — bit-identical to having trained longer in one call.
+    # Costs the binned matrix's HBM for the Booster's lifetime;
+    # single-host, early-stopping-off runs only.
+    "keep_training_data": False,
 }
 
 
@@ -112,6 +119,12 @@ class Booster:
         # ('device'|'host'), boost_chunk (fused iterations per
         # dispatch), boost_chunks (dispatch count)
         self.train_info: Dict[str, Any] = {}
+        # incremental-refresh state (set by train(); both in-memory
+        # only — a Booster rebuilt from a model string has neither):
+        # the frozen BinMapper for boost_more on fresh data, and the
+        # retained device training state for exact continuation
+        self.bin_mapper = None
+        self._resume: Optional[Dict[str, Any]] = None
 
     # -- inference ----------------------------------------------------------
 
@@ -253,6 +266,151 @@ class Booster:
         else:
             raise ValueError(f"importance_type {importance_type!r}")
         return out
+
+    # -- incremental refresh (continued boosting) ---------------------------
+
+    def boost_more(self, num_iterations: int, X=None,
+                   y: Optional[np.ndarray] = None,
+                   sample_weight: Optional[np.ndarray] = None,
+                   valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                   mesh: Optional[Mesh] = None) -> "Booster":
+        """Append ``num_iterations`` boosting rounds and return the
+        grown forest as a NEW Booster (this one is untouched apart from
+        its retained device state being consumed — see below). The
+        online-refresh path of the model-lifecycle story: keep serving
+        the old forest while the new one trains, then hot-swap.
+
+        Two modes:
+
+        - ``X is None`` — EXACT continuation on the retained training
+          state (requires ``train(..., {'keep_training_data': True})``).
+          The device-resident binned matrix, running scores, and forest
+          buffer pick up exactly where train() stopped, so the result
+          is bit-identical to having trained ``it + num_iterations``
+          rounds in one call (chunk-length invariance is pinned by the
+          PR 3 parity suite; continuation just adds chunks). The
+          retained state is single-use: the jitted chunk donates its
+          score/forest buffers, so after this call the state moves to
+          the RETURNED booster and this one's is marked consumed.
+
+        - ``X, y`` given — continued boosting on FRESH data against the
+          FROZEN ``bin_mapper``: new data bins with the original cuts
+          (identical split semantics to the base forest; drifted values
+          clamp into the original bin range), the base forest scores
+          the new rows once, and new trees append. Deterministic for
+          fixed inputs; per-iteration sampling masks continue at the
+          base forest's iteration index, so a bagged continuation
+          doesn't replay the base run's bags."""
+        if num_iterations <= 0:
+            raise ValueError(
+                f"num_iterations must be positive: {num_iterations}")
+        if X is None:
+            if y is not None or sample_weight is not None \
+                    or valid is not None:
+                raise ValueError(
+                    "boost_more(data=None) continues on the retained "
+                    "training state; y/sample_weight/valid only apply "
+                    "with fresh X")
+            return self._boost_more_retained(int(num_iterations))
+        if self.bin_mapper is None:
+            raise ValueError(
+                "this Booster carries no BinMapper (rebuilt from a "
+                "model string?); boost_more on fresh data needs the "
+                "frozen fit-time binning — keep the trained Booster "
+                "object, or refit")
+        params = {k: v for k, v in self.params.items() if k in DEFAULTS}
+        params["num_iterations"] = int(num_iterations)
+        # the fresh-data path rides the init_model warm start, which
+        # cannot retain continuation state by design — carrying the
+        # flag through would only trigger train()'s ineligibility
+        # warning on every refresh cycle
+        params.pop("keep_training_data", None)
+        if valid is None:
+            params["early_stopping_round"] = 0
+        return train(params, X, y, sample_weight=sample_weight,
+                     valid=valid, feature_names=self.feature_names,
+                     mesh=mesh, init_model=self,
+                     bin_mapper=self.bin_mapper)
+
+    def _boost_more_retained(self, extra: int) -> "Booster":
+        st = self._resume
+        if st is None:
+            raise ValueError(
+                "no retained training state: pass "
+                "{'keep_training_data': True} to train() (single-host, "
+                "no init_model, no early stopping) to enable "
+                "boost_more(data=None)")
+        if st["consumed"]:
+            raise ValueError(
+                "retained training state already consumed: the jitted "
+                "chunk donates its buffers, so continuation chains "
+                "through the NEWEST booster returned by boost_more")
+        import time as _time
+        t_start = _time.perf_counter()
+        K, it0 = st["K"], st["it_done"]
+        total = it0 + extra
+        forest, t_cap = st["forest"], st["t_cap"]
+        need = total * K
+        new_cap = t_cap
+        while new_cap < need:
+            new_cap *= 2    # keep the pow-2 capacity-bucket discipline
+        if new_cap != t_cap:
+            grow = new_cap - t_cap
+            # grown rows are written before they are ever read, so the
+            # pad values are inert (left/right 0 self-reference included)
+            forest = Tree(*[jnp.pad(getattr(forest, fld),
+                                    ((0, grow), (0, 0)))
+                            for fld in Tree._fields])
+        scores = st["scores"]
+        S_cfg = int(self.params.get("boost_chunk", 0) or 0)
+        if S_cfg <= 0:
+            S_cfg = 8 if extra >= 16 else 1
+        S_cfg = max(1, min(S_cfg, extra))
+        # consumed BEFORE the first dispatch: the chunk donates the
+        # score/forest buffers, so a mid-loop failure (compile error,
+        # OOM on a grown buffer, interrupt) must not leave a state that
+        # passes the guard while pointing at deleted device arrays
+        st["consumed"] = True
+        it = it0
+        n_chunks = 0
+        while it < total:
+            S = min(S_cfg, total - it)
+            chunk_fn = _make_chunk_step(
+                st["obj_key"], st["gp"], st["lr"], K, st["axis_name"],
+                st["mesh"], st["parallel_mode"], S, st["bag_cfg"],
+                st["ff_cfg"], st["f"], st["f_eff"])
+            scores, forest = chunk_fn(
+                st["bins_d"], scores, st["y_d"], st["w_d"],
+                st["fmask_base"], forest, np.int32(it), st["mask_key"])
+            n_chunks += 1
+            it += S
+        jax.block_until_ready(scores)
+        trees_done = total * K
+        host = jax.device_get(forest._asdict())
+        stacked = {name: arr[:trees_done] for name, arr in host.items()}
+        mapper = st["mapper"]
+        thr_lut = mapper.threshold_matrix(st["num_bins"])
+        thr = thr_lut[stacked["feature"], stacked["bin_threshold"]]
+        stacked["threshold"] = np.where(stacked["is_leaf"], 0.0, thr)
+        stacked["value"] = stacked["value"] * st["lr"]
+        tree_depths = [
+            _tree_depth({k: v[t] for k, v in stacked.items()})
+            for t in range(trees_done)]
+        p2 = dict(self.params)
+        p2["num_iterations"] = total
+        booster = Booster(self.objective, stacked, st["init_score"], K,
+                          st["feature_names"], p2, best_iteration=-1,
+                          tree_depths=tree_depths)
+        booster.bin_mapper = mapper
+        booster._resume = {**st, "scores": scores, "forest": forest,
+                           "it_done": total, "t_cap": new_cap,
+                           "consumed": False}
+        booster.train_timing = {
+            "boost": round(_time.perf_counter() - t_start, 3)}
+        booster.train_info = {"bin_path": "retained",
+                              "boost_chunk": S_cfg,
+                              "boost_chunks": n_chunks}
+        return booster
 
     # -- serialization ------------------------------------------------------
 
@@ -491,7 +649,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
           feature_names: Optional[List[str]] = None,
           mesh: Optional[Mesh] = None,
-          init_model: Optional["Booster | str"] = None) -> Booster:
+          init_model: Optional["Booster | str"] = None,
+          bin_mapper: Optional[BinMapper] = None) -> Booster:
     """Train a Booster. ``parallelism='data'`` shards rows over ``mesh``'s
     data axis and psums histograms (LightGBM data-parallel tree learner
     analog, ref: TrainParams.scala:26).
@@ -506,6 +665,12 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     Booster carries old + new trees (ref: TrainUtils.scala:74-77
     modelString warm start). Requires dense ``X`` (the base forest is
     scored on the raw features).
+
+    ``bin_mapper`` overrides the bin-boundary fit with a FROZEN mapper
+    (single-host only): the incremental-refresh path —
+    ``Booster.boost_more(fresh_data)`` — bins new data against the
+    original training distribution's cuts, so appended trees split in
+    the same bin space as the base forest.
 
     The returned Booster carries ``train_timing``: per-phase wall
     seconds {bin, ship[, bin_device], first_iter (compile+first chunk),
@@ -601,6 +766,12 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     forced_mapper = (_multihost_mapper(
         X, streaming, p["max_bin"], p["seed"], proc_info.process_count)
         if multi_host else None)
+    if bin_mapper is not None:
+        if multi_host or multi_host_fp:
+            raise ValueError(
+                "bin_mapper override is single-host only (multi-host "
+                "ingest agrees boundaries across processes itself)")
+        forced_mapper = bin_mapper
 
     if streaming:
         if sample_weight is not None:
@@ -652,6 +823,10 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             bins_np = None   # dense path bins on device (below)
     if feature_names is None:
         feature_names = [f"Column_{i}" for i in range(f)]
+    if bin_mapper is not None and len(mapper.num_bins) != f:
+        raise ValueError(
+            f"frozen bin_mapper covers {len(mapper.num_bins)} features, "
+            f"X has {f}")
     num_bins = int(mapper.num_bins.max())
     if multi_host_fp:
         # every host fit its mapper on its own copy of the (supposedly
@@ -1220,6 +1395,36 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     booster.train_timing = {k: round(v, 3) for k, v in _phases.items()}
     booster.train_info = {"bin_path": bin_path, "boost_chunk": S_cfg,
                           "boost_chunks": n_chunks}
+    # the frozen mapper rides on the booster (in-memory only): the
+    # continued-boosting path bins FRESH data against the original cuts
+    booster.bin_mapper = mapper
+    if (p.get("keep_training_data")
+            and not (multi_host or multi_host_fp)
+            and base_model is None and not use_valid):
+        # exact-continuation state: everything the chunk loop consumes,
+        # still device-resident. Restricted to the cases where
+        # continuation is provably bit-identical to one longer run —
+        # no warm-start base (its forest lives outside this buffer)
+        # and no early stopping (a stopped run's scores include the
+        # overshoot chunks).
+        booster._resume = {
+            "bins_d": bins_d, "y_d": y_d, "w_d": w_d,
+            "scores": scores, "forest": forest,
+            "fmask_base": fmask_base, "mask_key": mask_key,
+            "it_done": it0, "t_cap": t_cap, "gp": gp, "lr": lr,
+            "obj_key": obj_key, "parallel_mode": parallel_mode,
+            "axis_name": axis_name, "mesh": mesh, "K": K,
+            "f": f, "f_eff": f_eff, "num_bins": num_bins,
+            "bag_cfg": bag_cfg, "ff_cfg": ff_cfg,
+            "mapper": mapper, "init_score": init_score,
+            "feature_names": feature_names, "consumed": False,
+        }
+    elif p.get("keep_training_data"):
+        import logging
+        logging.getLogger("mmlspark_tpu.gbdt").warning(
+            "keep_training_data requested but continuation state is "
+            "only retained for single-host runs without init_model or "
+            "early stopping; boost_more(data=None) will be unavailable")
     hists = gbdt_train_histograms()
     for phase_name, secs in _phases.items():
         h = hists.get(phase_name)

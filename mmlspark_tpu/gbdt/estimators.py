@@ -82,9 +82,17 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         "serialized booster to warm-start from "
         "(ref: TrainParams modelString, TrainUtils.scala:74-77)",
         default="")
+    keepTrainingData = BoolParam(
+        "retain the device-resident training state on the fitted "
+        "booster so Booster.boost_more(data=None) continues boosting "
+        "exactly where fit() stopped (bit-identical to one longer run; "
+        "costs the binned matrix's device memory for the model's "
+        "lifetime; single-host, no warm start, no early stopping)",
+        default=False)
 
     def _train_params(self) -> Dict[str, Any]:
         return {
+            "keep_training_data": self.get("keepTrainingData"),
             "num_iterations": self.get("numIterations"),
             "learning_rate": self.get("learningRate"),
             "num_leaves": self.get("numLeaves"),
@@ -167,6 +175,10 @@ class TPUBoostClassifier(Estimator, _BoostParams):
         model = TPUBoostClassificationModel(
             modelString=booster.model_to_string(),
             numClasses=num_class)
+        # seed the cache with the LIVE booster: the frozen BinMapper and
+        # (with keepTrainingData) the retained device state ride along
+        # for boost_more; a reloaded model parses the string instead
+        model._booster = booster
         for name in ("featuresCol", "predictionCol", "probabilityCol",
                      "rawPredictionCol"):
             model.set(name, self.get(name))
@@ -258,6 +270,8 @@ class TPUBoostRegressor(Estimator, _BoostParams):
         booster = train(params, X, y, sample_weight=w, valid=valid,
                         init_model=self.get("initModelString") or None)
         model = TPUBoostRegressionModel(modelString=booster.model_to_string())
+        model._booster = booster   # live booster: bin_mapper + retained
+        #                            state available for boost_more
         for name in ("featuresCol", "predictionCol"):
             model.set(name, self.get(name))
         return model

@@ -426,29 +426,55 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                            out_shardings=(state_sharding, None),
                            donate_argnums=(0,))
 
-        # checkpoint/resume
+        # checkpoint/resume. A corrupt/truncated checkpoint (a crash
+        # mid-save, a filesystem hiccup) must not kill the whole fit:
+        # fall back newest -> oldest across the retained checkpoints,
+        # then to fresh init — resume is a best-effort accelerator, not
+        # a correctness gate (losing a few hundred steps beats losing
+        # the run).
         ckpt_dir = self.get("checkpointDir")
         start_step = 0
         if ckpt_dir and self.get("resume"):
-            latest = _latest_checkpoint(ckpt_dir)
-            if latest is not None:
+            candidates = _checkpoint_candidates(ckpt_dir)
+            for candidate in candidates:
                 try:
-                    loaded = _load_checkpoint_pytree(latest)
-                except Exception as e:
-                    raise RuntimeError(
-                        f"failed to load checkpoint {latest!r}: {e}. "
-                        f"Delete it (or set resume=False) to retrain "
-                        f"from scratch.") from e
-                # namedtuple containers (optax states) serialize as plain
-                # tuples; rebuild them against the freshly-built treedef
-                host_state = jax.tree_util.tree_unflatten(
-                    jax.tree_util.tree_structure(state),
-                    jax.tree_util.tree_leaves(loaded))
-                start_step = int(host_state["step"])
-                state = jax.tree_util.tree_map(
-                    lambda a, s: jax.device_put(jnp.asarray(a), s),
-                    host_state, state_sharding)
-                logger.info("resumed from %s (step %d)", latest, start_step)
+                    loaded = _load_checkpoint_pytree(candidate)
+                    # namedtuple containers (optax states) serialize as
+                    # plain tuples; rebuild them against the
+                    # freshly-built treedef. Unflatten/step parsing can
+                    # fail on a truncated file too — same fallback.
+                    host_state = jax.tree_util.tree_unflatten(
+                        jax.tree_util.tree_structure(state),
+                        jax.tree_util.tree_leaves(loaded))
+                    cand_step = int(host_state["step"])
+                    cand_state = jax.tree_util.tree_map(
+                        lambda a, s: jax.device_put(jnp.asarray(a), s),
+                        host_state, state_sharding)
+                except OSError:
+                    # transient I/O (network timeout, 5xx via the
+                    # remote filesystems' IOError surface, connection
+                    # reset) is NOT corruption: falling back here
+                    # would silently restart a run from fresh init
+                    # during a store outage — fail loudly instead (the
+                    # filesystem layer already retried)
+                    raise
+                except Exception as e:  # noqa: BLE001 — corrupt ckpt
+                    # parse-class failures (truncated npz, bad json,
+                    # mismatched tree): genuinely a bad FILE
+                    logger.warning(
+                        "failed to load checkpoint %s (%s); falling "
+                        "back to the previous one", candidate, e)
+                    continue
+                state = cand_state
+                start_step = cand_step
+                logger.info("resumed from %s (step %d)", candidate,
+                            start_step)
+                break
+            else:
+                if candidates:
+                    logger.warning(
+                        "no loadable checkpoint in %s; training from "
+                        "fresh init", ckpt_dir)
         if proc_count > 1 and ckpt_dir and self.get("resume"):
             # hosts must resume from the SAME step — a host that found
             # no checkpoint (non-shared filesystem) would replay steps
@@ -949,14 +975,26 @@ def _save_checkpoint(ckpt_dir: str, step: int, state) -> None:
         shutil.rmtree(os.path.join(ckpt_dir, stale), ignore_errors=True)
 
 
-def _latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+def _checkpoint_candidates(ckpt_dir: str) -> List[str]:
+    """All retained checkpoint paths, NEWEST first — the corrupt-
+    checkpoint fallback order (resume tries each until one loads). A
+    remote LISTING failure propagates (the filesystem layer already
+    retries): an unreachable store must fail loudly, not silently
+    restart training from scratch — only corrupt checkpoint FILES get
+    the fallback treatment."""
     if _is_remote(ckpt_dir):
         steps = _remote_steps(ckpt_dir)
-        return f"{ckpt_dir.rstrip('/')}/{steps[-1]}" if steps else None
+        return [f"{ckpt_dir.rstrip('/')}/{s}" for s in reversed(steps)]
     if not os.path.isdir(ckpt_dir):
-        return None
-    ckpts = sorted(d for d in os.listdir(ckpt_dir) if d.startswith("step_"))
-    return os.path.join(ckpt_dir, ckpts[-1]) if ckpts else None
+        return []
+    ckpts = sorted((d for d in os.listdir(ckpt_dir)
+                    if d.startswith("step_")), reverse=True)
+    return [os.path.join(ckpt_dir, d) for d in ckpts]
+
+
+def _latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    candidates = _checkpoint_candidates(ckpt_dir)
+    return candidates[0] if candidates else None
 
 
 def _load_checkpoint_pytree(path: str):

@@ -44,20 +44,14 @@ def _momentum_fit(loss_fn, init_params, lr, n_steps: int):
     return params
 
 
-@partial(jax.jit, static_argnames=("n_steps", "num_class"))
 def _fit_logistic(X, y, lr, l2, n_steps: int, num_class: int):
-    n, d = X.shape
-    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
-
-    def loss_fn(params):
-        logits = X @ params["W"] + params["b"]
-        logp = jax.nn.log_softmax(logits)
-        return (-jnp.mean(jnp.sum(onehot * logp, axis=1))
-                + l2 * jnp.sum(params["W"] ** 2))
-
-    return _momentum_fit(
-        loss_fn, {"W": jnp.zeros((d, num_class)),
-                  "b": jnp.zeros(num_class)}, lr, n_steps)
+    """Cold-start fit = the warm-start kernel from zero inits (ONE
+    definition of the loss/momentum loop, so fit and partial_fit can
+    never silently diverge)."""
+    d = X.shape[1]
+    return _fit_logistic_warm(
+        X, y, jnp.zeros((d, num_class)), jnp.zeros(num_class),
+        lr, l2, n_steps, num_class)
 
 
 @partial(jax.jit, static_argnames=("n_steps", "num_class", "d"))
@@ -152,16 +146,45 @@ def _fit_linear_batch(X, y, lrs, l2s, n_steps: int):
     return jax.vmap(fit_one)(lrs, l2s)
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
 def _fit_linear(X, y, lr, l2, n_steps: int):
-    n, d = X.shape
+    """Cold-start fit = the warm-start kernel from zero inits (see
+    ``_fit_logistic``)."""
+    return _fit_linear_warm(X, y, jnp.zeros(X.shape[1]),
+                            jnp.asarray(0.0), lr, l2, n_steps)
 
+
+# ---------------------------------------------------------------------------
+# warm-started incremental updates (partial_fit — the online-refresh path)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_steps", "num_class"))
+def _fit_logistic_warm(X, y, W0, b0, lr, l2, n_steps: int,
+                       num_class: int):
+    """``_fit_logistic`` initialized from existing weights instead of
+    zeros: the SAME loss and momentum loop (velocity restarts at zero —
+    the standard warm-start contract), so an incremental update is one
+    jitted dispatch and a partial_fit stream stays deterministic."""
+    onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+
+    def loss_fn(params):
+        logits = X @ params["W"] + params["b"]
+        logp = jax.nn.log_softmax(logits)
+        return (-jnp.mean(jnp.sum(onehot * logp, axis=1))
+                + l2 * jnp.sum(params["W"] ** 2))
+
+    return _momentum_fit(loss_fn, {"W": W0, "b": b0}, lr, n_steps)
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def _fit_linear_warm(X, y, w0, b0, lr, l2, n_steps: int):
+    """``_fit_linear`` warm-started from existing weights (see
+    ``_fit_logistic_warm``)."""
     def loss_fn(p):
         pred = X @ p["w"] + p["b"]
         return jnp.mean((pred - y) ** 2) + l2 * jnp.sum(p["w"] ** 2)
 
-    return _momentum_fit(
-        loss_fn, {"w": jnp.zeros(d), "b": jnp.asarray(0.0)}, lr, n_steps)
+    return _momentum_fit(loss_fn, {"w": w0, "b": b0}, lr, n_steps)
 
 
 class _Standardizer:
@@ -228,9 +251,76 @@ class TPULogisticRegression(Estimator, HasFeaturesCol, HasLabelCol,
         model.set("predictionCol", self.get_prediction_col())
         return model
 
+    def partial_fit(self, table: DataTable,
+                    model: Optional["TPULogisticRegressionModel"] = None,
+                    ) -> "TPULogisticRegressionModel":
+        """Incremental refresh: warm-start from ``model``'s weights and
+        run ``maxIter`` momentum steps on this batch only — one jitted
+        dispatch, no refit over history. ``model=None`` degenerates to
+        ``fit``.
+
+        The fit-time feature standardization (mu/sd) is FROZEN at the
+        first fit: new batches standardize with the original stats, so
+        the weight space stays consistent across updates (feature drift
+        is surfaced by ``core.metrics.DriftMonitor``, not silently
+        absorbed into shifting normalization). Deterministic: the same
+        (model, batch) always produces the same new model, and the
+        class count is pinned by the warm-started weight shape — labels
+        outside it are an error, not a silent resize."""
+        if model is None:
+            return self.fit(table)
+        from mmlspark_tpu.core.sparse import CSRMatrix
+        w = model.get("weights")
+        if "mu" not in w:
+            raise ValueError(
+                "partial_fit warm start requires a dense-featured model "
+                "(sparse models carry no frozen standardization stats)")
+        feats = table.column(self.get_features_col())
+        if isinstance(feats, CSRMatrix):
+            raise ValueError(
+                "partial_fit requires dense features (the warm-started "
+                "kernel standardizes against the frozen fit-time stats)")
+        y = np.asarray(table[self.get_label_col()], dtype=np.float64)
+        if len(y) == 0:
+            # an empty refresh window is a no-op, not an update: zero
+            # rows would mean() to NaN and silently corrupt the weights
+            return model
+        num_class = int(np.asarray(w["W"]).shape[1])
+        if int(y.max()) + 1 > num_class:
+            raise ValueError(
+                f"label {int(y.max())} outside the warm-started model's "
+                f"{num_class} classes; refit from scratch to add classes")
+        X = _features_matrix(table, self.get_features_col())
+        Xs = (X - w["mu"]) / w["sd"]
+        params = _fit_logistic_warm(
+            jnp.asarray(Xs, jnp.float32), jnp.asarray(y, jnp.float32),
+            jnp.asarray(w["W"], jnp.float32),
+            jnp.asarray(w["b"], jnp.float32),
+            self.get("stepSize"), self.get("regParam"),
+            self.get("maxIter"), num_class)
+        out = TPULogisticRegressionModel(
+            weights={"W": np.asarray(params["W"]),
+                     "b": np.asarray(params["b"]),
+                     "mu": w["mu"], "sd": w["sd"]})
+        out.set("featuresCol", self.get_features_col())
+        out.set("predictionCol", self.get_prediction_col())
+        return out
+
 
 class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("W/b/mu/sd arrays", default=None)
+
+    def drift_monitor(self):
+        """A ``core.metrics.DriftMonitor`` seeded with this model's
+        FIT-TIME feature statistics (mu/sd) — hand it to
+        ``json_scoring_pipeline`` so served traffic's per-feature
+        mean/var/null drift vs training shows up on /healthz."""
+        from mmlspark_tpu.core.metrics import DriftMonitor
+        w = self.get("weights")
+        if "mu" not in w:
+            raise ValueError("sparse-featured models carry no fit-time "
+                             "standardization stats to drift against")
+        return DriftMonitor(w["mu"], np.asarray(w["sd"]) ** 2)
 
     def transform(self, table: DataTable) -> DataTable:
         from mmlspark_tpu.core.sparse import CSRMatrix
@@ -302,9 +392,47 @@ class TPULinearRegression(Estimator, HasFeaturesCol, HasLabelCol,
         model.set("predictionCol", self.get_prediction_col())
         return model
 
+    def partial_fit(self, table: DataTable,
+                    model: Optional["TPULinearRegressionModel"] = None,
+                    ) -> "TPULinearRegressionModel":
+        """Warm-started incremental update (see
+        ``TPULogisticRegression.partial_fit``): feature AND label
+        standardization stats are frozen at the first fit, the momentum
+        loop restarts from the fitted weights on this batch only."""
+        if model is None:
+            return self.fit(table)
+        w = model.get("weights")
+        y = np.asarray(table[self.get_label_col()], dtype=np.float64)
+        if len(y) == 0:
+            return model   # empty refresh window: no-op (NaN guard)
+        X = _features_matrix(table, self.get_features_col())
+        Xs = (X - w["mu"]) / w["sd"]
+        ys = (y - w["y_mu"]) / w["y_sd"]
+        params = _fit_linear_warm(
+            jnp.asarray(Xs, jnp.float32), jnp.asarray(ys, jnp.float32),
+            jnp.asarray(w["w"], jnp.float32),
+            jnp.asarray(w["b"], jnp.float32),
+            self.get("stepSize"), self.get("regParam"),
+            self.get("maxIter"))
+        out = TPULinearRegressionModel(
+            weights={"w": np.asarray(params["w"]),
+                     "b": np.asarray(params["b"]),
+                     "mu": w["mu"], "sd": w["sd"],
+                     "y_mu": w["y_mu"], "y_sd": w["y_sd"]})
+        out.set("featuresCol", self.get_features_col())
+        out.set("predictionCol", self.get_prediction_col())
+        return out
+
 
 class TPULinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("w/b/mu/sd arrays", default=None)
+
+    def drift_monitor(self):
+        """Fit-time feature-stat DriftMonitor (see
+        ``TPULogisticRegressionModel.drift_monitor``)."""
+        from mmlspark_tpu.core.metrics import DriftMonitor
+        w = self.get("weights")
+        return DriftMonitor(w["mu"], np.asarray(w["sd"]) ** 2)
 
     def transform(self, table: DataTable) -> DataTable:
         return self.transform_from_matrix(

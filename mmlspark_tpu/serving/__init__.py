@@ -9,11 +9,17 @@ from mmlspark_tpu.serving.fleet import (
     PartitionConsolidator, ServingFleet, ServingUnavailable,
     json_row_scoring_pipeline, json_scoring_pipeline,
 )
+from mmlspark_tpu.serving.lifecycle import (
+    CanaryPolicy, ModelRegistry, SwapEvent, SwapInProgress, SwapResult,
+)
 from mmlspark_tpu.serving.server import (
-    HTTPSource, ServingEngine, SharedSingleton, SharedVariable, serve_model,
+    HTTPSource, PipelineHandle, ServingEngine, SharedSingleton,
+    SharedVariable, serve_model,
 )
 
-__all__ = ["HTTPSource", "PartitionConsolidator", "ServingEngine",
+__all__ = ["CanaryPolicy", "HTTPSource", "ModelRegistry",
+           "PartitionConsolidator", "PipelineHandle", "ServingEngine",
            "ServingFleet", "ServingUnavailable", "SharedSingleton",
-           "SharedVariable", "json_row_scoring_pipeline",
-           "json_scoring_pipeline", "serve_model"]
+           "SharedVariable", "SwapEvent", "SwapInProgress", "SwapResult",
+           "json_row_scoring_pipeline", "json_scoring_pipeline",
+           "serve_model"]

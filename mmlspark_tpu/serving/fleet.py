@@ -25,6 +25,7 @@ import io
 import itertools
 import json
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -62,7 +63,8 @@ class ServingUnavailable(RuntimeError):
 
 
 def json_scoring_pipeline(model, field: str = "features",
-                          reply_field: str = "prediction"):
+                          reply_field: str = "prediction",
+                          drift_monitor=None):
     """The standard model-behind-HTTP pipeline: decode JSON request
     bodies ``{field: [floats]}``, score the micro-batch through
     ``model`` (a TPUModel whose inputCol is ``field``), reply
@@ -77,7 +79,14 @@ def json_scoring_pipeline(model, field: str = "features",
     ``execute_prepared`` (model forward + reply build, run by a
     worker). ``transform`` remains the single-stage fallback — the
     per-row poison-isolation retry and non-pipelined embeddings use
-    it."""
+    it.
+
+    ``drift_monitor`` (a ``core.metrics.DriftMonitor``) makes the stage
+    observe every decoded feature batch, so per-feature mean/var/null
+    drift vs the fit-time statistics rides along in ``metrics()`` and
+    /healthz. The stage also forwards the model's ``warmup`` hook so
+    the lifecycle swap protocol can pre-compile every serving bucket
+    off the hot path."""
     import numpy as np
     from mmlspark_tpu.stages.basic import Lambda
 
@@ -89,6 +98,13 @@ def json_scoring_pipeline(model, field: str = "features",
 
     def execute(table: DataTable, feats) -> DataTable:
         scored = model.transform(DataTable({field: feats}))
+        # drift counts SERVED batches, observed exactly once AFTER a
+        # successful score: a failed batch re-runs through the per-row
+        # retry / canary-rescue paths (which call transform -> execute
+        # again), so observing in decode would double-count precisely
+        # when the system is under the stress the monitor watches for
+        if drift_monitor is not None:
+            drift_monitor.observe(feats)
         preds = np.asarray(scored[model.get("outputCol")]).argmax(-1)
         return table.with_column(
             "reply", [{reply_field: int(p)} for p in preds])
@@ -102,8 +118,18 @@ def json_scoring_pipeline(model, field: str = "features",
     # pad/device hists + jit_cache_misses — TPUModel has the hook;
     # other Model types serve fine without it
     stage_metrics = getattr(model, "metrics", None)
-    if callable(stage_metrics):
-        lam.metrics = stage_metrics
+    if callable(stage_metrics) or drift_monitor is not None:
+        def metrics_hook():
+            out = dict(stage_metrics()) if callable(stage_metrics) else {}
+            if drift_monitor is not None:
+                out["drift"] = drift_monitor.summary()
+            return out
+        lam.metrics = metrics_hook
+    # warmup forwards to the model (TPUModel compiles every bucket);
+    # the swap protocol calls it before cutover
+    model_warmup = getattr(model, "warmup", None)
+    if callable(model_warmup):
+        lam.warmup = model_warmup
     return lam
 
 
@@ -170,7 +196,8 @@ class ServingFleet:
                  hedge_min_s: float = 0.02,
                  max_parked: Optional[int] = None,
                  max_wait_ms: float = 5.0,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 version: str = "v0"):
         self.engines: List[ServingEngine] = []
         self.transport_errors = 0
         self.hedged_requests = 0
@@ -190,7 +217,8 @@ class ServingFleet:
                         source, pipeline, reply_col=reply_col,
                         batch_size=batch_size, workers=workers,
                         max_wait_ms=max_wait_ms,
-                        pipeline_depth=pipeline_depth).start()
+                        pipeline_depth=pipeline_depth,
+                        version=version).start()
                 except Exception:
                     source.close()   # don't orphan the bound port
                     raise
@@ -598,6 +626,16 @@ class ServingFleet:
                 aggregate["pipeline_stage"] = stage
         aggregate["batches_processed"] = sum(
             m["batches_processed"] for m in per_engine)
+        # lifecycle rollup: per-engine versions/states plus the fleet
+        # swap counters (the ops view of a rolling upgrade in flight)
+        aggregate["model_versions"] = [
+            m.get("model_version") for m in per_engine]
+        aggregate["swap_states"] = [
+            m.get("swap_state") for m in per_engine]
+        aggregate["swaps_completed"] = sum(
+            m.get("swaps_completed", 0) for m in per_engine)
+        aggregate["swaps_rolled_back"] = sum(
+            m.get("swaps_rolled_back", 0) for m in per_engine)
         return {"engines": per_engine, "aggregate": aggregate}
 
     def counters(self) -> Dict[str, int]:
@@ -613,7 +651,82 @@ class ServingFleet:
             "hedged": self.hedged_requests,
             "workers_restarted": sum(e.workers_restarted
                                      for e in self.engines),
+            "swaps_completed": sum(e.swaps_completed
+                                   for e in self.engines),
+            "swaps_rolled_back": sum(e.swaps_rolled_back
+                                     for e in self.engines),
         }
+
+    # -- model lifecycle ---------------------------------------------------
+
+    def _failover_pressure(self) -> bool:
+        """True while the fleet looks stressed: any ALIVE engine's
+        circuit is open (dead engines' circuits stay open by design and
+        must not stall a rolling upgrade forever)."""
+        for e, b in zip(self.engines, self.breakers):
+            if e.is_alive() and b.state == CircuitBreaker.OPEN:
+                return True
+        return False
+
+    def rolling_swap(self, pipeline, version: str,
+                     warmup_example=None, policy=None,
+                     pressure_timeout_s: float = 30.0,
+                     ) -> Dict[str, Any]:
+        """Upgrade the fleet to ``pipeline``@``version`` one engine at a
+        time (zero downtime: each engine keeps serving through its own
+        warmup/canary/cutover — see serving/lifecycle.py).
+
+        Between engines the rollout PAUSES while the fleet shows
+        failover pressure (an alive engine's circuit open), bounded by
+        ``pressure_timeout_s`` per engine. Dead engines are skipped. A
+        rollback anywhere STOPS the rollout — a version that breached
+        one engine's canary must not march across the rest. Returns a
+        per-engine outcome report plus the aggregate verdict."""
+        outcomes: List[Dict[str, Any]] = []
+        completed = rolled_back = 0
+        for i, engine in enumerate(self.engines):
+            if not engine.is_alive():
+                outcomes.append({"engine": i,
+                                 "address": engine.source.address,
+                                 "outcome": "skipped_dead"})
+                continue
+            deadline = time.monotonic() + pressure_timeout_s
+            while self._failover_pressure() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.05)   # pause the rollout, keep serving
+            if self._failover_pressure():
+                log.warning("rolling_swap: proceeding on engine %d "
+                            "despite failover pressure (%.1fs budget "
+                            "spent)", i, pressure_timeout_s)
+            try:
+                res = engine.swap(pipeline, version,
+                                  warmup_example=warmup_example,
+                                  policy=policy)
+            except Exception as e:  # noqa: BLE001 — e.g. engine died
+                # between the liveness check and the swap
+                outcomes.append({"engine": i,
+                                 "address": engine.source.address,
+                                 "outcome": "error",
+                                 "reason": f"{type(e).__name__}: {e}"})
+                continue
+            if res.completed:
+                completed += 1
+                outcomes.append({"engine": i,
+                                 "address": engine.source.address,
+                                 "outcome": "completed"})
+            else:
+                rolled_back += 1
+                outcomes.append({"engine": i,
+                                 "address": engine.source.address,
+                                 "outcome": "rolled_back",
+                                 "reason": res.reason})
+                log.warning("rolling_swap: %s rolled back on engine %d "
+                            "(%s); halting the rollout", version, i,
+                            res.reason)
+                break
+        return {"version": version, "completed": completed,
+                "rolled_back": rolled_back, "engines": outcomes,
+                "ok": rolled_back == 0 and completed > 0}
 
     def kill_engine(self, index: int, close_source: bool = True) -> None:
         """Chaos hook: crash (or stall, with ``close_source=False``) one

@@ -414,6 +414,50 @@ class HTTPSource:
         self.server.server_close()
 
 
+class PipelineHandle:
+    """One immutable (pipeline, version) binding plus its in-flight
+    batch count — the unit of the zero-downtime swap protocol. Every
+    dispatched micro-batch carries the handle it was BUILT with, so a
+    batch is always decoded, executed, retried, and answered by exactly
+    one model version (the no-mixed-version-batch invariant), and a
+    version's outstanding count reaching zero is the drain signal.
+
+    ``controller`` and ``rescue_to`` are set only on canary handles by
+    the lifecycle layer: canary batch outcomes feed the controller's
+    breach detector, and a failing canary batch re-executes on
+    ``rescue_to`` (the stable handle) so clients never eat a canary's
+    faults."""
+
+    __slots__ = ("pipeline", "version", "prepare", "execute", "is_canary",
+                 "controller", "rescue_to", "_outstanding", "_lock")
+
+    def __init__(self, pipeline: Transformer, version: str,
+                 is_canary: bool = False):
+        self.pipeline = pipeline
+        self.version = str(version)
+        # optional two-stage split (duck-typed; absent on plain stages)
+        self.prepare = getattr(pipeline, "prepare_batch", None)
+        self.execute = getattr(pipeline, "execute_prepared", None)
+        self.is_canary = bool(is_canary)
+        self.controller = None
+        self.rescue_to: Optional["PipelineHandle"] = None
+        self._outstanding = 0
+        self._lock = threading.Lock()
+
+    def acquire(self) -> None:
+        with self._lock:
+            self._outstanding += 1
+
+    def release(self) -> None:
+        with self._lock:
+            self._outstanding -= 1
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+
 class ServingEngine:
     """The streaming loop: source → adaptive micro-batcher → user
     pipeline → sink (the structured-streaming query of ref:
@@ -449,10 +493,20 @@ class ServingEngine:
                  batch_size: int = 64,
                  content_type: str = "application/json",
                  error_col: str = "error", workers: int = 1,
-                 max_wait_ms: float = 5.0, pipeline_depth: int = 2):
+                 max_wait_ms: float = 5.0, pipeline_depth: int = 2,
+                 version: str = "v0"):
         from mmlspark_tpu.core.metrics import histogram_set
         self.source = source
-        self.pipeline = pipeline
+        # versioned pipeline binding: batches carry the handle they
+        # were built with, so a swap can cut over atomically (one
+        # attribute store) while in-flight batches drain on their own
+        # version — see serving/lifecycle.py
+        self._active = PipelineHandle(pipeline, version)
+        self._swap_lock = threading.Lock()   # one swap at a time
+        self.swap_state = "idle"
+        self.swaps_completed = 0
+        self.swaps_rolled_back = 0
+        self.swap_events: List[Any] = []
         self.reply_col = reply_col
         self.id_col = id_col
         self.batch_size = batch_size
@@ -474,9 +528,6 @@ class ServingEngine:
         self._inflight = threading.Semaphore(
             self.workers + self.pipeline_depth - 1)
         self._dispatch_q: "queue.Queue[Tuple]" = queue.Queue()
-        # optional two-stage split (duck-typed; absent on plain stages)
-        self._prepare = getattr(pipeline, "prepare_batch", None)
-        self._execute = getattr(pipeline, "execute_prepared", None)
         self._stop = threading.Event()
         self._killed = threading.Event()   # chaos kill: no restart
         self._threads: List[threading.Thread] = []
@@ -489,6 +540,48 @@ class ServingEngine:
         self.hists = histogram_set("queue_wait_ms", "decode_ms",
                                    "pipeline_ms", "respond_ms",
                                    "batch_rows")
+
+    # -- versioned pipeline access ------------------------------------------
+
+    @property
+    def pipeline(self) -> Transformer:
+        """The currently-active pipeline (latest cutover version)."""
+        return self._active.pipeline
+
+    @pipeline.setter
+    def pipeline(self, pipeline: Transformer) -> None:
+        # raw override (tests / embeddings): rebind the active handle in
+        # place, keeping the version tag — the supported production path
+        # is swap(), which warms up and canaries the incoming model
+        self._active = PipelineHandle(pipeline, self._active.version)
+
+    @property
+    def model_version(self) -> str:
+        return self._active.version
+
+    def _route(self) -> PipelineHandle:
+        """Pick the handle for the NEXT micro-batch: the active version,
+        except during a canary phase when the swap controller diverts
+        its configured fraction of batches to the incoming version."""
+        active = self._active
+        swap_ctl = self.__dict__.get("_swap_ctl")
+        if swap_ctl is not None:
+            try:
+                return swap_ctl.route(active)
+            except Exception:  # noqa: BLE001 — a sick controller must
+                return active  # never take the serving path down
+        return active
+
+    def swap(self, pipeline: Transformer, version: str,
+             warmup_example: Any = None, policy: Any = None):
+        """Zero-downtime model swap: warm the incoming pipeline off the
+        hot path, canary a fraction of live traffic through it, promote
+        on a clean window or auto-roll-back on an error/latency breach.
+        Blocks until the swap completes or rolls back; returns a
+        ``SwapResult`` (see serving/lifecycle.py)."""
+        from mmlspark_tpu.serving.lifecycle import execute_swap
+        return execute_swap(self, pipeline, version,
+                            warmup_example=warmup_example, policy=policy)
 
     def _respond_ok(self, rid: str, rep: Any) -> None:
         body = rep if isinstance(rep, (bytes, str)) \
@@ -528,28 +621,66 @@ class ServingEngine:
         table, ids = self.source.get_batch(self.batch_size, wait_s)
         if not ids:
             return 0
-        self._execute_batch(table, ids, None)
+        self._execute_batch(table, ids, None, self._active)
         return len(ids)
 
     def _execute_batch(self, table: DataTable, ids: List[str],
-                       prepped: Any) -> None:
+                       prepped: Any,
+                       handle: Optional[PipelineHandle] = None) -> None:
         """Stage 2 of the pipeline: device execution + reply flush for
         one micro-batch (``prepped`` carries stage 1's decode output
-        when the pipeline supports the split)."""
+        when the pipeline supports the split). The whole batch runs on
+        ``handle``'s pipeline version — retries included — so no reply
+        batch ever mixes model versions."""
+        if handle is None:
+            handle = self._active
+        # canary handles carry their controller; stable batches report
+        # to whatever swap is in flight (the latency-delta baseline)
+        ctl = handle.controller if handle.controller is not None \
+            else self.__dict__.get("_swap_ctl")
         t0 = time.perf_counter()
         try:
-            if prepped is not None and self._execute is not None:
-                out = self._execute(table, prepped)
+            if prepped is not None and handle.execute is not None:
+                out = handle.execute(table, prepped)
             else:
-                out = self.pipeline.transform(table)
+                out = handle.pipeline.transform(table)
         except Exception as e:  # noqa: BLE001 — isolate the poison row(s)
+            if handle.is_canary and handle.rescue_to is not None:
+                # a canary batch's faults are the SWAP's problem, not
+                # the clients': record the strike and re-execute the
+                # whole batch on the stable version (fresh decode — the
+                # prepped payload may be the poisoned stage's output)
+                log.warning("canary batch failed (%s); rescuing on %s",
+                            e, handle.rescue_to.version)
+                if ctl is not None:
+                    ctl.observe(handle, ok=False, latency_ms=(
+                        time.perf_counter() - t0) * 1e3, error=e)
+                self._run_rescued(table, ids, handle.rescue_to)
+                return
             log.warning("serving batch failed (%s); retrying per-row", e)
-            self._process_rows_individually(table, ids)
+            self._process_rows_individually(table, ids, handle)
             with self._stats_lock:
                 self.batches_processed += 1
             return
-        self.hists["pipeline_ms"].observe(
-            (time.perf_counter() - t0) * 1e3)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if ctl is not None:
+            # the controller discards row_errors for stable handles, so
+            # only canary batches pay the error-column scan
+            row_errors = (self._count_row_errors(out)
+                          if handle.is_canary else 0)
+            if (row_errors > 0 and handle.is_canary
+                    and handle.rescue_to is not None):
+                # row-level canary errors must not leak to clients
+                # either: strike the canary, answer from stable. The
+                # engine histogram is observed by the rescue run only —
+                # one client batch, one pipeline_ms sample.
+                ctl.observe(handle, ok=True, latency_ms=dt_ms,
+                            row_errors=row_errors)
+                self._run_rescued(table, ids, handle.rescue_to)
+                return
+            ctl.observe(handle, ok=True, latency_ms=dt_ms,
+                        row_errors=row_errors)
+        self.hists["pipeline_ms"].observe(dt_ms)
         t1 = time.perf_counter()
         try:
             self._answer_output(out, ids)
@@ -563,39 +694,65 @@ class ServingEngine:
         with self._stats_lock:
             self.batches_processed += 1
 
+    def _run_rescued(self, table: DataTable, ids: List[str],
+                     rescue: PipelineHandle) -> None:
+        """Re-execute a failed canary batch on the stable handle,
+        COUNTED as in-flight on it: the swap's drain phase polls the
+        old handle's outstanding count, so an untracked rescue could
+        let the drain complete while this batch still runs on the old
+        version."""
+        rescue.acquire()
+        try:
+            self._execute_batch(table, ids, None, rescue)
+        finally:
+            rescue.release()
+
+    def _count_row_errors(self, out: DataTable) -> int:
+        """Non-null error_col rows in a transformed batch (the canary
+        controller counts them against the incoming version)."""
+        if self.error_col not in out.column_names:
+            return 0
+        errs = out[self.error_col]
+        return sum(1 for e in errs if e is not None and e == e)
+
     def _process_rows_individually(self, table: DataTable,
-                                   ids: List[str]) -> None:
+                                   ids: List[str],
+                                   handle: Optional[PipelineHandle] = None,
+                                   ) -> None:
         """Batch-failure fallback: run each row alone so one poison
         request cannot 500 its batchmates (the per-row half of the
         reference's error isolation, SimpleHTTPTransformer.scala:104-150)."""
+        if handle is None:
+            handle = self._active
         requests = table["request"]
         for rid, req in zip(ids, requests):
             row = DataTable({"id": [rid], "request": [req]})
             try:
-                out = self.pipeline.transform(row)
+                out = handle.pipeline.transform(row)
                 self._answer_output(out, [rid])
             except Exception as e:  # noqa: BLE001
                 self.source.respond(rid, HTTPSchema.response(
                     500, f"pipeline error: {e}", None))
 
-    def _build_item(self, parked: List[_ParkedRequest]) -> Tuple:
+    def _build_item(self, parked: List[_ParkedRequest],
+                    handle: PipelineHandle) -> Tuple:
         """Assemble + (optionally) decode one collected batch: the host
         half of the two-stage pipeline, run on the batcher thread."""
         table = DataTable({"id": [p.id for p in parked],
                            "request": [p.request for p in parked]})
         ids = [p.id for p in parked]
         prepped = None
-        if self._prepare is not None and self._execute is not None:
+        if handle.prepare is not None and handle.execute is not None:
             t0 = time.perf_counter()
             try:
-                prepped = self._prepare(table)
+                prepped = handle.prepare(table)
                 self.hists["decode_ms"].observe(
                     (time.perf_counter() - t0) * 1e3)
             except Exception:  # noqa: BLE001 — poison rows can die in
                 # decode too: hand the batch over un-prepared so the
                 # worker's per-row retry isolates the offender
                 prepped = None
-        return table, ids, prepped
+        return table, ids, prepped, handle
 
     def _batcher_loop(self):
         """Stage 1 of the pipeline: adaptive collect + (optional) host
@@ -637,9 +794,24 @@ class ServingEngine:
             # must give it back, or each incident would permanently
             # shrink the engine's dispatch budget
             handed_off = False
+            handle = None
             try:
+                # version routing happens HERE, once per batch: the
+                # handle rides with the item so decode, execution,
+                # retries, and replies all use one model version.
+                # acquire() BEFORE any other work, then re-check the
+                # active handle: a cutover landing between route and
+                # acquire would otherwise let the swap's drain poll
+                # read outstanding==0 while this batch is still headed
+                # for the old version.
+                handle = self._route()
+                handle.acquire()
+                if not handle.is_canary and handle is not self._active:
+                    handle.release()
+                    handle = self._active   # stale route: follow cutover
+                    handle.acquire()
                 try:
-                    item = self._build_item(parked)
+                    item = self._build_item(parked, handle)
                 except Exception as e:  # noqa: BLE001
                     log.error("batch assembly failed (%s); "
                               "dropping to 500s", e)
@@ -651,6 +823,10 @@ class ServingEngine:
                 handed_off = True
             finally:
                 if not handed_off:
+                    # both the in-flight token AND the version handle
+                    # must come back on any non-dispatch exit
+                    if handle is not None:
+                        handle.release()
                     self._inflight.release()
             for p in parked:
                 # dequeue stamp, not dispatch time: queue_wait must not
@@ -673,7 +849,10 @@ class ServingEngine:
             finally:
                 # token back even when the thread is dying (SystemExit
                 # passes through): a leaked token would shrink the
-                # engine's in-flight budget forever
+                # engine's in-flight budget forever — and the version
+                # handle must drain even on a crashed batch, or a swap
+                # would wait on its outstanding count forever
+                item[3].release()
                 self._inflight.release()
 
     def _spawn_worker(self) -> threading.Thread:
@@ -730,8 +909,18 @@ class ServingEngine:
             out: Dict[str, Any] = {
                 "batches_processed": self.batches_processed,
                 "workers_restarted": self.workers_restarted,
+                "model_version": self.model_version,
+                "swap_state": self.swap_state,
+                "swaps_completed": self.swaps_completed,
+                "swaps_rolled_back": self.swaps_rolled_back,
             }
         out.update({k: h.summary() for k, h in self.hists.items()})
+        swap_ctl = self.__dict__.get("_swap_ctl")
+        if swap_ctl is not None:
+            try:
+                out["swap"] = swap_ctl.stats()
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         stage = getattr(self.pipeline, "metrics", None)
         if callable(stage):
             try:
@@ -783,7 +972,8 @@ def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
                 port: int = 8899, batch_size: int = 64,
                 reply_col: str = "reply",
                 workers: int = 1, max_wait_ms: float = 5.0,
-                pipeline_depth: int = 2) -> ServingEngine:
+                pipeline_depth: int = 2,
+                version: str = "v0") -> ServingEngine:
     """One-call serving: the ``.server()`` DSL analog
     (ref: ServingImplicits.scala:10-50). Batches flush on
     ``batch_size`` rows or ``max_wait_ms`` elapsed, whichever first;
@@ -795,4 +985,5 @@ def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
     return ServingEngine(source, pipeline, reply_col=reply_col,
                          batch_size=batch_size, workers=workers,
                          max_wait_ms=max_wait_ms,
-                         pipeline_depth=pipeline_depth).start()
+                         pipeline_depth=pipeline_depth,
+                         version=version).start()

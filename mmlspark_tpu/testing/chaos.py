@@ -193,6 +193,25 @@ class FaultInjector:
         fleet.kill_engine(index, close_source=True)
 
     @staticmethod
+    def kill_engine_after(fleet, index: int, delay_s: float
+                          ) -> threading.Thread:
+        """Arm a delayed engine kill on a daemon timer — the
+        mid-swap-crash drill: start a rolling swap, have this fire
+        while it is in flight, and the lifecycle layer must roll the
+        dead engine's swap back (decision timeout) while the rest of
+        the fleet completes."""
+        def fire():
+            time.sleep(delay_s)
+            try:
+                FaultInjector.kill_engine(fleet, index)
+            except Exception:  # noqa: BLE001 — already stopped
+                pass
+        t = threading.Thread(target=fire, daemon=True,
+                             name="chaos-delayed-kill")
+        t.start()
+        return t
+
+    @staticmethod
     def stall_engine(fleet, index: int) -> None:
         """Wedge one engine: it keeps ACCEPTING requests but never
         replies — clients burn their timeout (the stalled-process shape
@@ -207,3 +226,73 @@ class FaultInjector:
                     "injected_latency_rows":
                         self.injected_latency_rows,
                     "worker_kills_fired": self.worker_kills_fired}
+
+
+# ---------------------------------------------------------------------------
+# swap-phase faults (the model-lifecycle chaos drills)
+# ---------------------------------------------------------------------------
+
+
+class PoisonedModel:
+    """A model that passes warmup but errors on live batches — the
+    looks-fine-until-production canary shape. ``fail_batches`` bounds
+    the poison (float('inf') = always); after that many failed batches
+    it behaves (the transient-poison variant).
+
+    Deliberately duck-typed like _ChaosPipeline (transform /
+    transform_schema / warmup), so the lifecycle layer sees a normal
+    pipeline: ``warmup`` succeeds (delegating to the inner hook when
+    present), then the first ``fail_batches`` transform calls raise.
+    The canary controller must catch this and roll back without the
+    fleet's error floor breaching (failed canary batches rescue onto
+    the stable version)."""
+
+    def __init__(self, inner, fail_batches: float = float("inf")):
+        self.inner = inner
+        self.fail_batches = fail_batches
+        self.batches_poisoned = 0
+        self.warmup_calls = 0
+        self._lock = threading.Lock()
+
+    def warmup(self, example=None, *a, **kw):
+        """Passes — poison only manifests under live traffic."""
+        with self._lock:
+            self.warmup_calls += 1
+        hook = getattr(self.inner, "warmup", None)
+        if callable(hook) and example is not None:
+            return hook(example, *a, **kw)
+        return 0
+
+    def transform(self, table):
+        with self._lock:
+            if self.batches_poisoned < self.fail_batches:
+                self.batches_poisoned += 1
+                raise ChaosError(
+                    f"poisoned model: batch {self.batches_poisoned}")
+        return self.inner.transform(table)
+
+    def transform_schema(self, schema):
+        return self.inner.transform_schema(schema)
+
+
+class StalledWarmupModel:
+    """A model whose ``warmup`` never returns within any sane budget —
+    the wedged-compile shape. The swap protocol must time the warmup
+    out and roll back WITHOUT the engine ever routing traffic to this
+    model (its transform still works; the stall is purely in warmup)."""
+
+    def __init__(self, inner, stall_s: float = 3600.0):
+        self.inner = inner
+        self.stall_s = float(stall_s)
+        self.warmup_started = threading.Event()
+
+    def warmup(self, example=None, *a, **kw):
+        self.warmup_started.set()
+        time.sleep(self.stall_s)
+        return 0
+
+    def transform(self, table):
+        return self.inner.transform(table)
+
+    def transform_schema(self, schema):
+        return self.inner.transform_schema(schema)

@@ -18,20 +18,25 @@ import urllib.request
 
 import pytest
 
-from mmlspark_tpu.serving import ServingFleet, ServingUnavailable
+from mmlspark_tpu.serving import CanaryPolicy, ServingFleet, ServingUnavailable
 from mmlspark_tpu.serving.server import serve_model
 from mmlspark_tpu.stages.basic import Lambda
-from mmlspark_tpu.testing.chaos import ChaosError, FaultInjector
+from mmlspark_tpu.testing.chaos import (
+    ChaosError, FaultInjector, PoisonedModel, StalledWarmupModel,
+)
 from mmlspark_tpu.utils.resilience import CircuitBreaker
 
 pytestmark = pytest.mark.chaos
 
 
-def echo_pipeline():
+def echo_pipeline(version=None):
     def handle(table):
-        return table.with_column("reply", [
-            {"echo": json.loads(r["entity"].decode())["x"]}
-            for r in table["request"]])
+        reply = [{"echo": json.loads(r["entity"].decode())["x"]}
+                 for r in table["request"]]
+        if version is not None:
+            for r in reply:
+                r["v"] = version
+        return table.with_column("reply", reply)
     return Lambda.apply(handle)
 
 
@@ -359,6 +364,251 @@ class TestChaosWrapperUnit:
             "request": [HTTPSchema.request("/", "POST", b'{"x": 1}')]})
         with pytest.raises(ChaosError):
             wrapped.transform(table)
+
+
+def _fleet_load(fleet, n_clients, per_client, results, timeout=5.0):
+    """Spray the fleet from n_clients threads; record per-request
+    (ok, version) into ``results``. Returns the started threads."""
+    def client(cid):
+        for j in range(per_client):
+            key = cid * per_client + j
+            try:
+                body = fleet.post({"x": key}, timeout=timeout)
+                results[key] = (body.get("echo") == key, body.get("v"))
+            except Exception:  # noqa: BLE001 — availability metric
+                results[key] = (False, None)
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_clients)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+class TestRollingSwapChaos:
+    """The model-lifecycle acceptance drills: a fleet under seeded load
+    completes a rolling swap with >=99% availability and never serves a
+    mixed-version reply batch; a poisoned canary auto-rolls-back
+    without breaching the error floor; a stalled warmup and an engine
+    killed mid-swap roll back instead of wedging the rollout."""
+
+    def test_rolling_swap_under_load_99pct_availability(self):
+        fleet = ServingFleet(echo_pipeline("v1"), n_engines=3,
+                             base_port=19600, batch_size=8, workers=1,
+                             max_wait_ms=2.0, version="v1",
+                             failure_threshold=3, breaker_cooldown=30.0)
+        n_clients, per_client = 6, 40
+        results = {}
+        try:
+            threads = _fleet_load(fleet, n_clients, per_client, results)
+            time.sleep(0.2)          # load established before the swap
+            report = fleet.rolling_swap(
+                echo_pipeline("v2"), "v2",
+                policy=CanaryPolicy(fraction=0.5, min_batches=3,
+                                    decision_timeout_s=20))
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+            assert report["ok"], report
+            assert report["completed"] == 3
+            # every engine cut over; post-swap traffic is all-new-version
+            for e in fleet.engines:
+                assert e.model_version == "v2"
+                assert e.swap_state == "idle"
+            post = fleet.post({"x": -1})
+            assert post == {"echo": -1, "v": "v2"}
+            c = fleet.counters()
+            assert c["swaps_completed"] == 3
+            assert c["swaps_rolled_back"] == 0
+            agg = fleet.metrics()["aggregate"]
+            assert agg["model_versions"] == ["v2", "v2", "v2"]
+        finally:
+            fleet.stop_all()
+        total = n_clients * per_client
+        ok = sum(v[0] for v in results.values())
+        assert len(results) == total
+        assert ok / total >= 0.99, f"availability {ok}/{total}"
+        # replies only ever carry a real version — each batch executed
+        # wholly on the handle it was built with
+        assert {v for _, v in results.values() if v} <= {"v1", "v2"}
+
+    def test_swap_zero_steady_state_recompiles(self):
+        """The warmup-before-cutover contract, measured through the
+        models' own trace counters: after the incoming model's bucket
+        warmup (inside the swap, off the hot path), serving across and
+        beyond the swap adds ZERO jit cache misses."""
+        import jax
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        import numpy as np
+
+        module = build_network({"type": "mlp", "features": [16],
+                                "num_classes": 4})
+        x0 = np.zeros((1, 8), np.float32)
+
+        def make_model(seed):
+            weights = {"params": module.init(
+                jax.random.PRNGKey(seed), x0)["params"]}
+            return TPUModel(
+                modelFn=lambda w, ins: module.apply(
+                    {"params": w["params"]}, list(ins.values())[0]),
+                weights=weights, inputCol="features",
+                outputCol="scores", batchSize=16)
+
+        m1, m2 = make_model(0), make_model(1)
+        m1.warmup({"features": x0})
+        fleet = ServingFleet(json_scoring_pipeline(m1), n_engines=2,
+                             base_port=19620, batch_size=16,
+                             max_wait_ms=2.0)
+        payload = {"features": [0.1] * 8}
+        results = {}
+        try:
+            for _ in range(8):       # steady state on v1
+                assert "prediction" in fleet.post(payload)
+            misses_v1 = m1.jit_cache_misses
+
+            def client(cid):
+                for j in range(30):
+                    try:
+                        results[(cid, j)] = "prediction" in fleet.post(
+                            payload, timeout=10)
+                    except Exception:  # noqa: BLE001
+                        results[(cid, j)] = False
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            report = fleet.rolling_swap(
+                json_scoring_pipeline(m2), "v2",
+                warmup_example={"features": x0},
+                policy=CanaryPolicy(fraction=0.5, min_batches=2,
+                                    decision_timeout_s=30))
+            for t in threads:
+                t.join(timeout=60)
+            assert report["ok"], report
+            misses_v2 = m2.jit_cache_misses
+            # post-swap steady state: more traffic, zero new compiles
+            # on either model
+            for _ in range(8):
+                assert "prediction" in fleet.post(payload)
+            assert m1.jit_cache_misses == misses_v1, \
+                "old model recompiled during the swap"
+            assert m2.jit_cache_misses == misses_v2, \
+                "new model compiled on the hot path after its warmup"
+            assert misses_v2 > 0    # warmup really compiled the buckets
+        finally:
+            fleet.stop_all()
+        ok = sum(results.values())
+        assert ok / len(results) >= 0.99
+
+    def test_poisoned_canary_auto_rolls_back_under_error_floor(self):
+        """A canary that passes warmup but errors on live batches must
+        roll back via the breach detector while clients stay whole:
+        failed canary batches rescue onto the stable version."""
+        fleet = ServingFleet(echo_pipeline("v1"), n_engines=2,
+                             base_port=19640, batch_size=8, workers=1,
+                             max_wait_ms=2.0, version="v1")
+        poisoned = PoisonedModel(echo_pipeline("v2"))
+        n_clients, per_client = 4, 40
+        results = {}
+        try:
+            threads = _fleet_load(fleet, n_clients, per_client, results)
+            time.sleep(0.1)
+            report = fleet.rolling_swap(
+                poisoned, "v2",
+                policy=CanaryPolicy(fraction=0.5, min_batches=4,
+                                    consecutive_failures=3,
+                                    decision_timeout_s=20))
+            for t in threads:
+                t.join(timeout=60)
+            assert not report["ok"]
+            assert report["rolled_back"] == 1
+            assert report["completed"] == 0    # rollout halted at once
+            assert poisoned.batches_poisoned >= 1
+            # the fleet never left v1, and keeps serving
+            for e in fleet.engines:
+                assert e.model_version == "v1"
+            assert fleet.post({"x": -5}) == {"echo": -5, "v": "v1"}
+            assert fleet.counters()["swaps_rolled_back"] == 1
+        finally:
+            fleet.stop_all()
+        total = n_clients * per_client
+        ok = sum(v[0] for v in results.values())
+        # the error floor: canary faults were rescued, not surfaced
+        assert ok / total >= 0.99, f"error floor breached {ok}/{total}"
+        assert {v for _, v in results.values() if v} == {"v1"}
+
+    def test_stalled_warmup_rolls_back_without_touching_traffic(self):
+        fleet = ServingFleet(echo_pipeline("v1"), n_engines=2,
+                             base_port=19660, batch_size=4, version="v1")
+        stalled = StalledWarmupModel(echo_pipeline("v2"), stall_s=60.0)
+        results = {}
+        try:
+            threads = _fleet_load(fleet, 2, 20, results)
+            t0 = time.perf_counter()
+            report = fleet.rolling_swap(
+                stalled, "v2",
+                policy=CanaryPolicy(warmup_timeout_s=0.5,
+                                    decision_timeout_s=5))
+            dt = time.perf_counter() - t0
+            for t in threads:
+                t.join(timeout=30)
+            assert not report["ok"]
+            assert "warmup_timeout" in report["engines"][0]["reason"]
+            assert stalled.warmup_started.is_set()
+            assert dt < 10, f"stalled warmup wedged the rollout {dt:.1f}s"
+            assert fleet.engines[0].model_version == "v1"
+            assert fleet.post({"x": -7})["v"] == "v1"
+        finally:
+            fleet.stop_all()
+        ok = sum(v[0] for v in results.values())
+        assert ok / len(results) >= 0.99
+
+    @pytest.mark.slow   # two fault classes + full fleet load — the
+    #                     tier-1 acceptance drills above cover the
+    #                     individual mechanisms
+    def test_engine_killed_mid_rolling_swap(self):
+        """Hard-kill one engine while the rollout is in flight: the
+        dead engine's swap must resolve (skip or timeout-rollback, not
+        a wedge) and the fleet must keep its availability floor via
+        circuit-breaking failover."""
+        fleet = ServingFleet(echo_pipeline("v1"), n_engines=3,
+                             base_port=19680, batch_size=8, workers=1,
+                             max_wait_ms=2.0, version="v1",
+                             failure_threshold=2, breaker_cooldown=30.0)
+        n_clients, per_client = 6, 30
+        results = {}
+        try:
+            threads = _fleet_load(fleet, n_clients, per_client, results)
+            time.sleep(0.2)
+            FaultInjector.kill_engine_after(fleet, 1, 0.15)
+            t0 = time.perf_counter()
+            report = fleet.rolling_swap(
+                echo_pipeline("v2"), "v2",
+                policy=CanaryPolicy(fraction=0.5, min_batches=3,
+                                    decision_timeout_s=2.0),
+                pressure_timeout_s=3.0)
+            dt = time.perf_counter() - t0
+            for t in threads:
+                t.join(timeout=60)
+            # the rollout RESOLVED (no wedge) and made progress
+            assert dt < 30, f"rollout wedged for {dt:.1f}s"
+            assert report["completed"] >= 1, report
+            outcomes = {e["outcome"] for e in report["engines"]}
+            assert outcomes <= {"completed", "rolled_back",
+                                "skipped_dead", "error"}
+            # engines that completed really serve the new version
+            for entry in report["engines"]:
+                if entry["outcome"] == "completed":
+                    assert fleet.engines[
+                        entry["engine"]].model_version == "v2"
+        finally:
+            fleet.stop_all()
+        total = n_clients * per_client
+        ok = sum(v[0] for v in results.values())
+        assert len(results) == total
+        assert ok / total >= 0.99, f"availability {ok}/{total}"
+        assert {v for _, v in results.values() if v} <= {"v1", "v2"}
 
 
 class TestAdaptiveBatcherChaos:

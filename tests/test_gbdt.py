@@ -404,6 +404,115 @@ class TestWarmStart:
                   np.arange(150) % 3, init_model=b)
 
 
+class TestBoostMore:
+    """Continued boosting (the incremental-refresh path of the model
+    lifecycle): boost_more(data=None) on retained training state is
+    BIT-IDENTICAL to one longer run; boost_more(fresh data) appends
+    trees against the frozen BinMapper deterministically."""
+
+    # num_leaves/max_bin/hist_method match TestChunkedBoosting's binary
+    # config, so the jitted chunk programs come out of _make_chunk_step's
+    # lru cache instead of compiling a fresh (leaves, bins) family; all
+    # tier-1 iteration counts stay < 16 so only the length-1 chunk
+    # program is ever built (chunk-length invariance itself is pinned
+    # by TestChunkedBoosting)
+    KW = {"objective": "binary", "num_iterations": 8, "num_leaves": 15,
+          "max_bin": 31, "hist_method": "scatter", "seed": 3,
+          "keep_training_data": True}
+
+    @staticmethod
+    def _assert_forests_equal(a, b):
+        assert a.num_trees == b.num_trees
+        for key in a.trees:
+            assert np.array_equal(a.trees[key], b.trees[key]), key
+        np.testing.assert_array_equal(a.init_score, b.init_score)
+
+    def test_retained_continuation_bit_identical(self, breast_cancer):
+        X, y = breast_cancer
+        one_shot = train({**self.KW, "num_iterations": 12}, X, y)
+        grown = train(self.KW, X, y).boost_more(4)
+        self._assert_forests_equal(one_shot, grown)
+        assert grown.train_info["bin_path"] == "retained"
+
+    @pytest.mark.slow   # 3 trains; the single-continuation parity pin
+    #                     above is the tier-1 guard
+    def test_chained_continuation_bit_identical(self, breast_cancer):
+        # two boost_more calls == one longer run; the state moves to
+        # the newest booster each time (donated buffers)
+        X, y = breast_cancer
+        one_shot = train({**self.KW, "num_iterations": 20}, X, y)
+        b = train(self.KW, X, y)
+        grown = b.boost_more(8).boost_more(4)
+        self._assert_forests_equal(one_shot, grown)
+        with pytest.raises(ValueError, match="consumed"):
+            b.boost_more(1)   # the oldest state is single-use
+
+    @pytest.mark.slow   # heaviest variant (sampling-mask compiles x2);
+    #                     mask chunk-invariance is already pinned by
+    #                     TestChunkedBoosting, continuation by the
+    #                     tier-1 parity pin above
+    def test_retained_continuation_with_sampling(self, breast_cancer):
+        # bagging + feature-fraction masks key on the ABSOLUTE
+        # iteration index (fold_in), so continuation samples exactly
+        # the bags one longer run would
+        X, y = breast_cancer
+        kw = {**self.KW, "bagging_fraction": 0.7, "bagging_freq": 1,
+              "feature_fraction": 0.8}
+        one_shot = train({**kw, "num_iterations": 12}, X, y)
+        grown = train(kw, X, y).boost_more(4)
+        self._assert_forests_equal(one_shot, grown)
+
+    def test_retained_state_requires_opt_in(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 4}, X, y)
+        with pytest.raises(ValueError, match="keep_training_data"):
+            b.boost_more(2)
+
+    def test_fresh_data_frozen_mapper_deterministic(self, breast_cancer):
+        X, y = breast_cancer
+        base = train(self.KW, X, y)
+        rng = np.random.default_rng(7)
+        idx = rng.permutation(len(y))[:200]
+        X2, y2 = X[idx], y[idx]
+        a = base.boost_more(4, X2, y2)
+        b = base.boost_more(4, X2, y2)
+        assert a.num_trees == base.num_trees + 4
+        self._assert_forests_equal(a, b)   # deterministic
+        # appended trees split in the base forest's bin space: every
+        # new threshold is one of the frozen mapper's cut values
+        new_internal = ~a.trees["is_leaf"][base.num_trees:].astype(bool)
+        thr = a.trees["threshold"][base.num_trees:][new_internal]
+        feats = a.trees["feature"][base.num_trees:][new_internal]
+        lut = base.bin_mapper.threshold_matrix(
+            int(base.bin_mapper.num_bins.max()))
+        for t, f in zip(thr, feats):
+            assert np.isin(t, lut[f]).item() or not np.isfinite(t), (t, f)
+
+    @pytest.mark.slow   # quality smoke; determinism + frozen-mapper
+    #                     structure above are the tier-1 contract
+    def test_fresh_data_improves_fit(self, breast_cancer):
+        X, y = breast_cancer
+        base = train({**self.KW, "num_iterations": 5}, X, y)
+        grown = base.boost_more(10, X, y)
+        assert _auc(y, grown.predict(X)) >= _auc(y, base.predict(X))
+
+    def test_deserialized_booster_rejects_fresh_data(self, breast_cancer):
+        X, y = breast_cancer
+        b = train({"objective": "binary", "num_iterations": 3}, X, y)
+        loaded = Booster.from_string(b.model_to_string())
+        with pytest.raises(ValueError, match="BinMapper"):
+            loaded.boost_more(2, X, y)
+
+    def test_estimator_keep_training_data_param(self, breast_cancer):
+        X, y = breast_cancer
+        t = DataTable({"features": np.asarray(X, np.float64),
+                       "label": np.asarray(y, np.float64)})
+        m = TPUBoostClassifier(numIterations=4,
+                               keepTrainingData=True).fit(t)
+        grown = m.get_booster().boost_more(2)
+        assert grown.num_trees == 6
+
+
 class TestStreamingIngestion:
     def test_shard_stream_matches_dense(self, breast_cancer):
         # iterator-of-shards feed: only the binned int32 matrix is kept

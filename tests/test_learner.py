@@ -166,6 +166,42 @@ def test_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(f, r, rtol=1e-3, atol=1e-3)
 
 
+def test_corrupt_checkpoint_falls_back_to_previous(tmp_path):
+    """A corrupt/truncated newest checkpoint must not kill resume:
+    fit() logs, falls back to the PREVIOUS checkpoint, and finishes
+    (the all-corrupt -> fresh-init twin runs against webdav in
+    tests/test_remote_fs.py)."""
+    import os
+    t = _toy_table(seed=4)
+    ck = str(tmp_path / "ckpt")
+    common = dict(
+        networkSpec={"type": "mlp", "features": [16], "num_classes": 4},
+        epochs=2, batchSize=64, learningRate=0.05, computeDtype="float32",
+        schedule="constant",
+        checkpointDir=ck, checkpointEvery=4, logEvery=1000, seed=9)
+    TPULearner(**common).fit(t)               # 8 steps -> ckpts @ 4, 8
+    steps = sorted(d for d in os.listdir(ck) if d.startswith("step_"))
+    assert len(steps) >= 2, "need >= 2 checkpoints for the fallback"
+    # truncate the NEWEST checkpoint's leaves mid-file (crash-mid-save)
+    newest = os.path.join(ck, steps[-1], "leaves.npz")
+    with open(newest, "r+b") as f:
+        f.truncate(os.path.getsize(newest) // 2)
+    prev_step = int(steps[-2].rsplit("_", 1)[1])
+    newest_step = int(steps[-1].rsplit("_", 1)[1])
+    # logEvery=1: every step lands in history, so the first logged
+    # step IS the resume point
+    resumed_learner = TPULearner(**{**common, "epochs": 4,
+                                    "logEvery": 1})
+    model = resumed_learner.fit(t)            # no raise: previous ckpt
+    assert model is not None
+    assert resumed_learner.history, "training never ran"
+    first = min(h["step"] for h in resumed_learner.history)
+    # resumed from the PREVIOUS checkpoint: past it, not past the
+    # corrupt newest one (which a successful load would skip to)
+    assert prev_step < first <= newest_step, (
+        first, prev_step, newest_step)
+
+
 def test_learned_model_roundtrip(tmp_path):
     t = _toy_table(seed=5)
     learner = TPULearner(

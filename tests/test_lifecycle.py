@@ -1,0 +1,451 @@
+"""Model-lifecycle suite: versioned registry, zero-downtime hot swap,
+canary/rollback, incremental refresh (partial_fit), and drift counters.
+
+The chaos-under-load variants (rolling swap on a fleet with seeded
+faults) live in tests/test_chaos.py; this file pins the protocol and
+the incremental-update math deterministically.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.metrics import DriftMonitor
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.models.linear import (
+    TPULinearRegression, TPULogisticRegression,
+)
+from mmlspark_tpu.serving import (
+    CanaryPolicy, ModelRegistry, SwapInProgress, serve_model,
+)
+from mmlspark_tpu.stages.basic import Lambda
+
+
+def versioned_pipeline(version):
+    """Echo pipeline that stamps its version into every reply — the
+    instrument for no-mixed-version and cutover assertions."""
+    def handle(table):
+        return table.with_column("reply", [
+            {"echo": json.loads(r["entity"].decode())["x"], "v": version}
+            for r in table["request"]])
+    return Lambda.apply(handle)
+
+
+def _post(addr, payload, timeout=5.0):
+    req = urllib.request.Request(
+        addr, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class _Load:
+    """Background request stream against one engine; collects
+    (status, version) per reply."""
+
+    def __init__(self, addr, n_threads=2):
+        self.addr = addr
+        self.results = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [threading.Thread(target=self._run, args=(i,),
+                                          daemon=True)
+                         for i in range(n_threads)]
+
+    def _run(self, tid):
+        i = 0
+        while not self._stop.is_set():
+            try:
+                status, body = _post(self.addr,
+                                     {"x": tid * 100000 + i}, timeout=5)
+                out = (status, body.get("v"))
+            except Exception as e:  # noqa: BLE001 — availability metric
+                out = (0, f"{type(e).__name__}")
+            with self._lock:
+                self.results.append(out)
+            i += 1
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+
+
+class TestModelRegistry:
+    def test_register_get_order(self):
+        reg = ModelRegistry()
+        reg.register("v1", "model-one", metadata={"auc": 0.9})
+        reg.register("v2", "model-two")
+        assert reg.get("v1") == "model-one"
+        assert reg.versions() == ["v1", "v2"]
+        assert reg.latest() == "v2"
+        assert reg.previous("v2") == "v1"
+        assert reg.previous("v1") is None
+        assert reg.metadata("v1") == {"auc": 0.9}
+
+    def test_duplicate_and_unknown_version(self):
+        reg = ModelRegistry()
+        reg.register("v1", object())
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register("v1", object())
+        with pytest.raises(KeyError, match="unknown model version"):
+            reg.get("nope")
+
+
+class TestEngineSwap:
+    def test_swap_completes_under_load_no_mixed_replies(self):
+        engine = serve_model(versioned_pipeline("v1"), port=20100,
+                             batch_size=4, version="v1")
+        try:
+            with _Load(engine.source.address) as load:
+                time.sleep(0.1)
+                res = engine.swap(
+                    versioned_pipeline("v2"), "v2",
+                    policy=CanaryPolicy(fraction=0.5, min_batches=3,
+                                        decision_timeout_s=20))
+                assert res.completed, res.reason
+                # post-cutover replies are all v2
+                s, body = _post(engine.source.address, {"x": -1})
+                assert s == 200 and body["v"] == "v2"
+            statuses = [s for s, _ in load.results]
+            versions = {v for s, v in load.results if s == 200}
+            assert statuses and all(s == 200 for s in statuses)
+            assert versions <= {"v1", "v2"}
+            assert engine.model_version == "v2"
+            assert engine.swap_state == "idle"
+            assert engine.swaps_completed == 1
+            assert engine.swaps_rolled_back == 0
+            assert engine.swap_events[-1].kind == "completed"
+        finally:
+            engine.stop()
+
+    def test_swap_warms_up_before_cutover(self):
+        warmed = threading.Event()
+        pipe = versioned_pipeline("v2")
+
+        def warmup(example):
+            warmed.set()
+            return 0
+        pipe.warmup = warmup
+        engine = serve_model(versioned_pipeline("v1"), port=20110,
+                             batch_size=4, version="v1")
+        try:
+            res = engine.swap(pipe, "v2", warmup_example={"x": [0]},
+                              policy=CanaryPolicy(fraction=0.0))
+            assert res.completed
+            assert warmed.is_set()
+            assert engine.model_version == "v2"
+        finally:
+            engine.stop()
+
+    def test_warmup_failure_rolls_back(self):
+        pipe = versioned_pipeline("v2")
+
+        def warmup(example):
+            raise RuntimeError("compile exploded")
+        pipe.warmup = warmup
+        engine = serve_model(versioned_pipeline("v1"), port=20120,
+                             batch_size=4, version="v1")
+        try:
+            res = engine.swap(pipe, "v2", warmup_example={"x": [0]})
+            assert res.rolled_back
+            assert "warmup_failed" in res.reason
+            assert engine.model_version == "v1"
+            assert engine.swap_state == "rolled_back"
+            assert engine.swaps_rolled_back == 1
+            # still serving on the old version
+            assert _post(engine.source.address, {"x": 5})[1]["v"] == "v1"
+        finally:
+            engine.stop()
+
+    def test_warmup_requiring_example_without_one_rolls_back(self):
+        pipe = versioned_pipeline("v2")
+        pipe.warmup = lambda example: 0
+        engine = serve_model(versioned_pipeline("v1"), port=20130,
+                             batch_size=4, version="v1")
+        try:
+            res = engine.swap(pipe, "v2")   # no warmup_example
+            assert res.rolled_back
+            assert "requires an example" in res.reason
+        finally:
+            engine.stop()
+
+    def test_decision_timeout_rolls_back_without_traffic(self):
+        # no load -> the canary never sees a batch -> the safe default
+        # is rollback, not a promote on zero evidence
+        engine = serve_model(versioned_pipeline("v1"), port=20140,
+                             batch_size=4, version="v1")
+        try:
+            res = engine.swap(
+                versioned_pipeline("v2"), "v2",
+                policy=CanaryPolicy(fraction=0.5, min_batches=2,
+                                    decision_timeout_s=0.5))
+            assert res.rolled_back
+            assert res.reason == "breach:decision_timeout"
+            assert engine.model_version == "v1"
+        finally:
+            engine.stop()
+
+    def test_second_swap_while_swapping_raises(self):
+        engine = serve_model(versioned_pipeline("v1"), port=20150,
+                             batch_size=4, version="v1")
+        try:
+            started = threading.Event()
+            outcome = {}
+
+            def slow_swap():
+                pipe = versioned_pipeline("v2")
+
+                def warmup(example):
+                    started.set()
+                    time.sleep(1.0)
+                    return 0
+                pipe.warmup = warmup
+                outcome["res"] = engine.swap(
+                    pipe, "v2", warmup_example={"x": [0]},
+                    policy=CanaryPolicy(fraction=0.0))
+            t = threading.Thread(target=slow_swap, daemon=True)
+            t.start()
+            assert started.wait(5)
+            with pytest.raises(SwapInProgress):
+                engine.swap(versioned_pipeline("v3"), "v3")
+            t.join(timeout=10)
+            assert outcome["res"].completed
+        finally:
+            engine.stop()
+
+    def test_registry_records_swap_events(self):
+        reg = ModelRegistry()
+        reg.register("v1", versioned_pipeline("v1"))
+        reg.register("v2", versioned_pipeline("v2"))
+        engine = serve_model(reg.get("v1"), port=20160, batch_size=4,
+                             version="v1")
+        try:
+            from mmlspark_tpu.serving.lifecycle import execute_swap
+            res = execute_swap(engine, reg.get("v2"), "v2",
+                               policy=CanaryPolicy(fraction=0.0),
+                               registry=reg)
+            assert res.completed
+            assert [e.kind for e in reg.events] == ["completed"]
+            assert reg.events[0].to_version == "v2"
+        finally:
+            engine.stop()
+
+    def test_healthz_reports_lifecycle_fields(self):
+        engine = serve_model(versioned_pipeline("v1"), port=20170,
+                             batch_size=4, version="v1")
+        try:
+            assert _post(engine.source.address, {"x": 1})[0] == 200
+            with urllib.request.urlopen(
+                    engine.source.address + "/healthz", timeout=5) as r:
+                stats = json.loads(r.read())
+            m = stats["metrics"]
+            assert m["model_version"] == "v1"
+            assert m["swap_state"] == "idle"
+            assert m["swaps_completed"] == 0
+            assert m["swaps_rolled_back"] == 0
+        finally:
+            engine.stop()
+
+
+class TestPartialFit:
+    @pytest.fixture(scope="class")
+    def blobs(self):
+        rng = np.random.default_rng(1)
+        n, d = 600, 6
+        X = rng.normal(size=(n, d))
+        w = rng.normal(size=d)
+        y = (X @ w > 0).astype(np.float64)
+        return X, y
+
+    def test_partial_fit_none_model_is_fit(self, blobs):
+        X, y = blobs
+        t = DataTable({"features": X, "label": y})
+        est = TPULogisticRegression(maxIter=50)
+        a = est.partial_fit(t)
+        b = est.fit(t)
+        for key in ("W", "b"):
+            np.testing.assert_array_equal(a.get("weights")[key],
+                                          b.get("weights")[key])
+
+    def test_partial_fit_deterministic(self, blobs):
+        X, y = blobs
+        t = DataTable({"features": X, "label": y})
+        est = TPULogisticRegression(maxIter=50)
+        base = est.fit(t)
+        m1 = est.partial_fit(t, base)
+        m2 = est.partial_fit(t, base)
+        for key in ("W", "b"):
+            np.testing.assert_array_equal(m1.get("weights")[key],
+                                          m2.get("weights")[key])
+
+    def test_incremental_batches_converge_to_full_refit_selection(
+            self, blobs):
+        # the online-refresh property: warm start + incremental batches
+        # reaches the same SELECTION (predicted labels) as a full refit
+        X, y = blobs
+        full_t = DataTable({"features": X, "label": y})
+        est = TPULogisticRegression(maxIter=200, stepSize=0.5)
+        full = est.fit(full_t)
+        pred_full = np.asarray(full.transform(full_t)["prediction"])
+        m = est.fit(DataTable({"features": X[:200], "label": y[:200]}))
+        for _epoch in range(2):
+            for lo in range(0, len(y), 200):
+                m = est.partial_fit(
+                    DataTable({"features": X[lo:lo + 200],
+                               "label": y[lo:lo + 200]}), m)
+        # stats frozen at the INITIAL (first-200-rows) fit, never
+        # re-derived: they cannot equal the full-table fit's
+        assert not np.array_equal(m.get("weights")["mu"],
+                                  full.get("weights")["mu"])
+        pred_inc = np.asarray(m.transform(full_t)["prediction"])
+        assert (pred_inc == pred_full).mean() >= 0.99
+
+    def test_standardization_stats_frozen(self, blobs):
+        X, y = blobs
+        est = TPULogisticRegression(maxIter=20)
+        base = est.fit(DataTable({"features": X, "label": y}))
+        shifted = DataTable({"features": X + 10.0, "label": y})
+        m = est.partial_fit(shifted, base)
+        np.testing.assert_array_equal(m.get("weights")["mu"],
+                                      base.get("weights")["mu"])
+        np.testing.assert_array_equal(m.get("weights")["sd"],
+                                      base.get("weights")["sd"])
+
+    def test_empty_batch_is_a_noop(self, blobs):
+        # an empty refresh window must not NaN the weights
+        X, y = blobs
+        est = TPULogisticRegression(maxIter=10)
+        base = est.fit(DataTable({"features": X, "label": y}))
+        empty = DataTable({"features": np.zeros((0, X.shape[1])),
+                           "label": np.zeros(0)})
+        m = est.partial_fit(empty, base)
+        assert m is base
+        lin = TPULinearRegression(maxIter=10)
+        lbase = lin.fit(DataTable({"features": X,
+                                   "label": X[:, 0].astype(np.float64)}))
+        assert lin.partial_fit(empty, lbase) is lbase
+
+    def test_label_outside_warm_classes_rejected(self, blobs):
+        X, y = blobs
+        est = TPULogisticRegression(maxIter=10)
+        base = est.fit(DataTable({"features": X, "label": y}))
+        bad = DataTable({"features": X[:10],
+                         "label": np.full(10, 5.0)})
+        with pytest.raises(ValueError, match="classes"):
+            est.partial_fit(bad, base)
+
+    def test_linear_partial_fit_converges(self, blobs):
+        X, _ = blobs
+        rng = np.random.default_rng(3)
+        w = rng.normal(size=X.shape[1])
+        y = X @ w + rng.normal(scale=0.05, size=len(X))
+        t = DataTable({"features": X, "label": y})
+        est = TPULinearRegression(maxIter=200)
+        full = est.fit(t)
+        m = est.fit(DataTable({"features": X[:300], "label": y[:300]}))
+        for _epoch in range(3):
+            for lo in range(0, len(y), 300):
+                m = est.partial_fit(
+                    DataTable({"features": X[lo:lo + 300],
+                               "label": y[lo:lo + 300]}), m)
+        pf = np.asarray(full.transform(t)["prediction"])
+        pi = np.asarray(m.transform(t)["prediction"])
+        assert np.corrcoef(pf, pi)[0, 1] > 0.999
+
+
+class TestDriftMonitor:
+    def test_in_distribution_traffic_shows_no_drift(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2000, 5))
+        dm = DriftMonitor.from_matrix(X)
+        dm.observe(X[:500])
+        dm.observe(X[500:900])
+        s = dm.summary()
+        assert s["rows"] == 900
+        assert s["max_abs_mean_delta_sigma"] < 0.3
+        assert 0.7 < s["max_var_ratio"] < 1.3
+        assert s["null_rate"] == 0.0
+
+    def test_shifted_traffic_flags_the_right_feature(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(2000, 5))
+        dm = DriftMonitor.from_matrix(X)
+        served = X[:400].copy()
+        served[:, 3] += 5.0
+        dm.observe(served)
+        s = dm.summary()
+        assert s["max_abs_mean_delta_sigma"] > 3.0
+        assert s["worst_feature"] == 3
+
+    def test_null_rate_counts_nan_and_inf(self):
+        X = np.zeros((100, 2))
+        dm = DriftMonitor.from_matrix(np.random.default_rng(1).normal(
+            size=(100, 2)))
+        X[:10, 0] = np.nan
+        X[:5, 1] = np.inf
+        dm.observe(X)
+        assert dm.summary()["null_rate"] == pytest.approx(15 / 200)
+
+    def test_batched_observe_matches_one_shot(self):
+        rng = np.random.default_rng(2)
+        ref = rng.normal(size=(500, 3))
+        X = rng.normal(loc=0.3, size=(400, 3))
+        a = DriftMonitor.from_matrix(ref)
+        b = DriftMonitor.from_matrix(ref)
+        a.observe(X)
+        for lo in range(0, 400, 64):
+            b.observe(X[lo:lo + 64])
+        sa, sb = a.snapshot(), b.snapshot()
+        np.testing.assert_allclose(sa["mean"], sb["mean"], rtol=1e-10)
+        np.testing.assert_allclose(sa["var"], sb["var"], rtol=1e-8)
+
+    def test_model_drift_monitor_hook(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(300, 4))
+        y = (X[:, 0] > 0).astype(np.float64)
+        model = TPULogisticRegression(maxIter=20).fit(
+            DataTable({"features": X, "label": y}))
+        dm = model.drift_monitor()
+        dm.observe(X)
+        assert dm.summary()["max_abs_mean_delta_sigma"] < 0.2
+
+
+class TestServingDriftExport:
+    def test_drift_rides_healthz(self):
+        import jax
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        rng = np.random.default_rng(0)
+        Xfit = rng.normal(size=(256, 8)).astype(np.float32)
+        dm = DriftMonitor.from_matrix(Xfit)
+        W = rng.normal(size=(8, 3)).astype(np.float32)
+        model = TPUModel(
+            modelFn=lambda w, ins: list(ins.values())[0] @ w["W"],
+            weights={"W": W}, inputCol="features", outputCol="scores",
+            batchSize=16)
+        del jax
+        engine = serve_model(
+            json_scoring_pipeline(model, drift_monitor=dm),
+            port=20180, batch_size=16, version="v1")
+        try:
+            for i in range(4):
+                status, body = _post(
+                    engine.source.address,
+                    {"features": (Xfit[i] + 2.0).tolist()})
+                assert status == 200 and "prediction" in body
+            m = engine.metrics()
+            drift = m["pipeline_stage"]["drift"]
+            assert drift["rows"] == 4
+            assert drift["max_abs_mean_delta_sigma"] > 0.5
+        finally:
+            engine.stop()
