@@ -478,6 +478,112 @@ def bench_serving() -> dict:
     }
 
 
+OBS_REQUESTS = 400
+OBS_REPS = 2
+
+
+def bench_observability() -> dict:
+    """Tracing overhead on the serving hot path: the serving-scenario
+    load runs with request tracing OFF and ON (interleaved reps,
+    best-of per mode so shared-host noise hits both sides), reporting
+    qps for each, the overhead percentage, the tail-sampling buffer
+    stats, one exported trace's span coverage of its request wall, and
+    the /metrics exposition size. The ≤3% overhead contract is pinned
+    by tests/test_perf_floors.py::TestTracingOverheadFloor."""
+    import concurrent.futures
+
+    from mmlspark_tpu.core.trace import Tracer, to_chrome_trace
+    from mmlspark_tpu.models.networks import build_network
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    from mmlspark_tpu.serving.fleet import ServingFleet, json_scoring_pipeline
+
+    import jax
+
+    module = build_network({"type": "mlp", "features": [256, 128],
+                            "num_classes": 10})
+    rng = np.random.default_rng(0)
+    x0 = np.zeros((1, SERVING_FEATURE_DIM), np.float32)
+    weights = {"params": module.init(
+        jax.random.PRNGKey(0), x0)["params"]}
+    model = TPUModel(modelFn=lambda w, ins: module.apply(
+        {"params": w["params"]}, list(ins.values())[0]),
+        weights=weights, inputCol="features", outputCol="scores",
+        batchSize=256, computeDtype="float32")
+    model.warmup({"features": x0})
+    payload = json.dumps(
+        {"features": rng.normal(size=SERVING_FEATURE_DIM).tolist()}
+    ).encode()
+
+    def run_once(tracing: bool, base_port: int):
+        tracer = Tracer(enabled=True) if tracing else None
+        fleet = ServingFleet(json_scoring_pipeline(model), n_engines=2,
+                             base_port=base_port, batch_size=256,
+                             workers=2,
+                             max_wait_ms=SERVING_MAX_WAIT_MS,
+                             tracer=tracer, tracing=tracing)
+        try:
+            def post(_i):
+                body = fleet.post(payload, timeout=60)
+                assert "prediction" in body, body
+            for _ in fleet.addresses:
+                post(0)
+            t0 = time.perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(
+                    SERVING_CLIENTS) as ex:
+                list(ex.map(post, range(OBS_REQUESTS)))
+            wall = time.perf_counter() - t0
+            extras = {}
+            if tracing:
+                extras["buffer"] = tracer.buffer.stats()
+                traces = [t for t in tracer.buffer.traces()
+                          if t.root.name == "request"
+                          and t.root.end is not None]
+                if traces:
+                    tr = traces[-1]
+                    child = [s for s in tr.spans()
+                             if s is not tr.root and s.end is not None]
+                    extras["sample_trace"] = {
+                        "trace_id": tr.trace_id,
+                        "wall_ms": round(tr.duration_ms, 3),
+                        "spans": {s.name: round(s.duration_ms, 3)
+                                  for s in child},
+                        "span_coverage": round(
+                            sum(s.duration_ms for s in child)
+                            / max(tr.duration_ms, 1e-9), 3),
+                        "chrome_events": len(to_chrome_trace(
+                            [tr])["traceEvents"]),
+                    }
+                extras["metrics_exposition_lines"] = len(
+                    fleet.metrics_text().splitlines())
+        finally:
+            fleet.stop_all()
+        return OBS_REQUESTS / wall, extras
+
+    qps_off = qps_on = 0.0
+    extras_on = {}
+    port = 19000
+    for _ in range(OBS_REPS):     # interleaved: noise hits both modes
+        q, _x = run_once(False, port)
+        qps_off = max(qps_off, q)
+        port += 40
+        q, extras = run_once(True, port)
+        if q > qps_on:
+            qps_on, extras_on = q, extras
+        port += 40
+    overhead = (qps_off - qps_on) / qps_off * 100 if qps_off else None
+    return {
+        "metric": "serving_tracing_overhead",
+        "value": round(overhead, 2) if overhead is not None else None,
+        "unit": "% qps lost with tracing on (best-of interleaved reps)",
+        "qps_tracing_off": round(qps_off, 1),
+        "qps_tracing_on": round(qps_on, 1),
+        **extras_on,
+        "config": (f"{OBS_REQUESTS} reqs x {OBS_REPS} reps per mode, "
+                   f"{SERVING_CLIENTS} clients, 2 engines x 2 workers, "
+                   f"MLP-{SERVING_FEATURE_DIM}, batch 256"),
+    }
+
+
 SWAP_REQUESTS = 600
 SWAP_CLIENTS = 12
 
@@ -601,6 +707,8 @@ SCENARIOS = {
     "serving": lambda: ("secondary_serving", bench_serving()),
     "swap": lambda: ("secondary_swap", bench_swap()),
     "automl": lambda: ("secondary_automl", bench_automl()),
+    "observability": lambda: ("secondary_observability",
+                              bench_observability()),
 }
 
 
@@ -610,7 +718,7 @@ def main():
     ap.add_argument(
         "--scenarios", default="all",
         help="comma list from {cifar,resnet,lm,higgs,serving,swap,"
-             "automl} or 'all' (the full flagship bench)")
+             "automl,observability} or 'all' (the full flagship bench)")
     args = ap.parse_args()
     if args.scenarios != "all":
         _enable_compile_cache()

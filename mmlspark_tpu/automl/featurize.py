@@ -134,6 +134,10 @@ class Featurize(Estimator):
             # reference drops unsupported columns
         MC.automl_histograms()["featurize_fit"].observe(
             (time.perf_counter() - t0) * 1e3)
+        from mmlspark_tpu.core.trace import get_tracer
+        get_tracer().emit("automl.featurize_fit", t0,
+                          attrs={"columns": len(cols),
+                                 "specs": len(specs)})
         return FeaturizeModel(specs=specs,
                               outputCol=self.get("outputCol"))
 
@@ -336,6 +340,10 @@ class FeaturizeModel(Model):
                                     Field(out_col, VECTOR))
         MC.automl_histograms()["featurize_transform"].observe(
             (time.perf_counter() - t0) * 1e3)
+        from mmlspark_tpu.core.trace import get_tracer
+        get_tracer().emit("automl.featurize_transform", t0,
+                          attrs={"rows": len(table),
+                                 "specs": len(specs)})
         return out
 
     def transform_rowloop(self, table: DataTable) -> DataTable:

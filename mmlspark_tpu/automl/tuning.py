@@ -301,6 +301,10 @@ class TuneHyperparameters(Estimator):
         "not, 'off' = always the serial thread pool)", default="auto")
 
     def fit(self, table: DataTable) -> "TuneHyperparametersModel":
+        from mmlspark_tpu.core.trace import get_tracer
+        tracer = get_tracer()
+        tune_trace = tracer.new_trace("automl.tune") \
+            if tracer.enabled else None
         hists = MC.automl_histograms()
         models: List[Estimator] = self.get("models")
         space = self.get("paramSpace")
@@ -320,6 +324,9 @@ class TuneHyperparameters(Estimator):
             for i in range(k)]
         hists["tune_fold_build"].observe(
             (time.perf_counter() - t0) * 1e3)
+        if tune_trace is not None:
+            tracer.emit("tune_fold_build", t0, trace=tune_trace,
+                        attrs={"folds": k})
 
         candidates: List[Tuple[Estimator, Dict[str, Any]]] = []
         for est in models:
@@ -361,6 +368,10 @@ class TuneHyperparameters(Estimator):
             with ThreadPoolExecutor(self.get("parallelism")) as pool:
                 results = list(pool.map(eval_candidate, candidates))
         hists["tune_trials"].observe((time.perf_counter() - t0) * 1e3)
+        if tune_trace is not None:
+            tracer.emit("tune_trials", t0, trace=tune_trace,
+                        attrs={"path": info["path"],
+                               "candidates": info["candidates"]})
 
         best_i = int(np.argmax(results) if ascending
                      else np.argmin(results))
@@ -371,6 +382,10 @@ class TuneHyperparameters(Estimator):
         t0 = time.perf_counter()
         best_model = final.fit(table)
         hists["tune_refit"].observe((time.perf_counter() - t0) * 1e3)
+        if tune_trace is not None:
+            tracer.emit("tune_refit", t0, trace=tune_trace)
+            tune_trace.root.set("path", info["path"])
+            tracer.finish(tune_trace)
         history = [{"model": type(e).__name__, "params": pm,
                     "metric": r}
                    for (e, pm), r in zip(candidates, results)]

@@ -17,6 +17,16 @@ _DEFAULTS: Dict[str, Any] = {
     "cache_dir": os.path.expanduser("~/.mmlspark_tpu"),
     "model_zoo_dir": os.path.expanduser("~/.mmlspark_tpu/models"),
     "log_level": "INFO",
+    # 'text' (human console) | 'json' (one-line structured records
+    # carrying trace_id/model_version when emitted inside a span)
+    "log_format": "text",
+    # request/training tracing (core.trace): master switch, completed-
+    # trace ring capacity, tail-sampling slow percentile, and the head
+    # sample rate for bulk (non-error, non-slow) traces
+    "trace.enabled": True,
+    "trace.capacity": 256,
+    "trace.slow_percentile": 90.0,
+    "trace.sample_rate": 1.0,
     "serving.port": 8899,
     "serving.host": "0.0.0.0",
     "http.concurrency": 8,

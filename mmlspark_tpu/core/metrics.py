@@ -111,12 +111,10 @@ class LatencyHistogram:
             out.merge(h)
         return out
 
-    def percentile(self, q: float) -> float:
-        """Approximate q-th percentile (q in [0, 100]) by linear
+    def _pct_from(self, counts: Sequence[int], count: int, mx: float,
+                  q: float) -> float:
+        """q-th percentile from ONE consistent counts snapshot: linear
         interpolation inside the containing bucket."""
-        with self._lock:
-            counts = list(self._counts)
-            count, mx = self._count, self._max
         if count == 0:
             return 0.0
         rank = q / 100.0 * count
@@ -132,26 +130,42 @@ class LatencyHistogram:
             seen += c
         return mx
 
-    def summary(self) -> Dict[str, float]:
+    def percentile(self, q: float) -> float:
+        """Approximate q-th percentile (q in [0, 100])."""
         with self._lock:
+            counts = list(self._counts)
+            count, mx = self._count, self._max
+        return self._pct_from(counts, count, mx, q)
+
+    def summary(self) -> Dict[str, float]:
+        # ONE snapshot under the lock: count/mean/percentiles all
+        # describe the same instant — the old per-percentile re-reads
+        # could mix in observes that landed between them
+        with self._lock:
+            counts = list(self._counts)
             count, total, mx = self._count, self._sum, self._max
         if count == 0:
             return {"count": 0}
         return {
             "count": count,
             "mean": round(total / count, 3),
-            "p50": round(self.percentile(50), 3),
-            "p90": round(self.percentile(90), 3),
-            "p99": round(self.percentile(99), 3),
+            "p50": round(self._pct_from(counts, count, mx, 50), 3),
+            "p90": round(self._pct_from(counts, count, mx, 90), 3),
+            "p99": round(self._pct_from(counts, count, mx, 99), 3),
             "max": round(mx, 3),
         }
 
     def snapshot(self) -> Dict[str, object]:
-        """Raw buckets for exporters: parallel bound/count lists."""
+        """Raw buckets for exporters (one consistent view: the bucket
+        counts, total count, and sum are read under a single lock so
+        sum(counts) == count always holds — the Prometheus renderer
+        depends on it for monotone cumulative buckets)."""
         with self._lock:
             counts = list(self._counts)
+            count, total, mx = self._count, self._sum, self._max
         return {"unit": self.unit, "bounds": list(self.bounds),
-                "counts": counts}
+                "counts": counts, "count": count, "sum": total,
+                "max": mx}
 
     def reset(self) -> None:
         with self._lock:

@@ -1,0 +1,216 @@
+"""Prometheus text-exposition rendering (format version 0.0.4).
+
+The serving layer's observability used to be bespoke healthz JSON; this
+module renders every counter, ``LatencyHistogram``, swap state, and
+``DriftMonitor`` snapshot in the Prometheus text format so any standard
+scraper can consume ``/metrics`` on an engine (and
+``ServingFleet.metrics_text()`` for the aggregate view). Stdlib-only;
+the histogram renderer reads the raw bucket snapshot (exact cumulative
+counts — the standard Prometheus histogram contract the
+``LatencyHistogram`` bucket layout was designed for).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Dict, List, Optional
+
+# the scrape Content-Type the text format mandates
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_OK = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def sanitize_name(name: str) -> str:
+    """Coerce an arbitrary key into a legal metric/label name."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+    if not out or not re.match(r"[a-zA-Z_:]", out[0]):
+        out = "_" + out
+    return out
+
+
+def escape_label_value(value: Any) -> str:
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_value(value: Any) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    v = float(value)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _labels_str(labels: Optional[Dict[str, Any]]) -> str:
+    if not labels:
+        return ""
+    parts = [f'{sanitize_name(k)}="{escape_label_value(v)}"'
+             for k, v in labels.items()]
+    return "{" + ",".join(parts) + "}"
+
+
+class PromRenderer:
+    """Accumulates metric families and renders the text exposition.
+    ``# HELP``/``# TYPE`` headers emit once per family regardless of how
+    many label sets sample into it (e.g. one histogram family with a
+    ``phase`` label fed by seven phase histograms)."""
+
+    def __init__(self):
+        self._lines: List[str] = []
+        self._seen: set = set()
+
+    def _header(self, name: str, mtype: str, help_text: str) -> None:
+        if name in self._seen:
+            return
+        self._seen.add(name)
+        self._lines.append(f"# HELP {name} {escape_help(help_text)}")
+        self._lines.append(f"# TYPE {name} {mtype}")
+
+    def sample(self, name: str, value: Any,
+               labels: Optional[Dict[str, Any]] = None) -> None:
+        self._lines.append(
+            f"{name}{_labels_str(labels)} {format_value(value)}")
+
+    def counter(self, name: str, help_text: str, value: Any,
+                labels: Optional[Dict[str, Any]] = None) -> None:
+        name = sanitize_name(name)
+        self._header(name, "counter", help_text)
+        self.sample(name, value, labels)
+
+    def gauge(self, name: str, help_text: str, value: Any,
+              labels: Optional[Dict[str, Any]] = None) -> None:
+        name = sanitize_name(name)
+        self._header(name, "gauge", help_text)
+        self.sample(name, value, labels)
+
+    def info(self, name: str, help_text: str,
+             labels: Dict[str, Any]) -> None:
+        """The `*_info` idiom: constant 1 gauge whose labels carry the
+        metadata (model version, swap state, …)."""
+        self.gauge(name, help_text, 1, labels)
+
+    def histogram(self, name: str, help_text: str, hist: Any,
+                  labels: Optional[Dict[str, Any]] = None) -> None:
+        """Render one ``LatencyHistogram`` (or anything exposing its
+        ``snapshot()`` contract: bounds/counts/count/sum) as a
+        Prometheus histogram family — cumulative ``_bucket{le=...}``
+        series ending at ``+Inf``, plus ``_sum`` and ``_count``."""
+        name = sanitize_name(name)
+        self._header(name, "histogram", help_text)
+        snap = hist.snapshot() if hasattr(hist, "snapshot") else dict(hist)
+        bounds = snap["bounds"]
+        counts = snap["counts"]
+        total = snap.get("count", sum(counts))
+        cum = 0
+        base = dict(labels or {})
+        for bound, c in zip(bounds, counts):
+            cum += c
+            le = "+Inf" if math.isinf(bound) else format_value(bound)
+            self.sample(f"{name}_bucket", cum, {**base, "le": le})
+        self.sample(f"{name}_sum", snap.get("sum", 0.0), base)
+        self.sample(f"{name}_count", total, base)
+
+    def render(self) -> str:
+        return "\n".join(self._lines) + "\n"
+
+
+def process_families(r: PromRenderer, tracer: Any = None) -> None:
+    """The process-wide (non-engine) families every exposition carries:
+    GBDT and AutoML training-phase histograms, trace-buffer tail
+    sampling stats, and device memory stats when a backend reports
+    them — so one scrape correlates serving load, training phases, and
+    on-chip memory. ``tracer`` is the tracer whose buffer the caller
+    actually traces into (an engine/fleet constructed with its own
+    Tracer must report THAT buffer, not the process-global one)."""
+    from mmlspark_tpu.core import metrics as MC
+    for phase, hist in MC.gbdt_train_histograms().items():
+        r.histogram("gbdt_train_phase_ms",
+                    "GBDT train() per-phase wall milliseconds",
+                    hist, {"phase": phase})
+    for phase, hist in MC.automl_histograms().items():
+        r.histogram("automl_phase_ms",
+                    "AutoML hot-path per-phase wall milliseconds",
+                    hist, {"phase": phase})
+    if tracer is None:
+        from mmlspark_tpu.core.trace import get_tracer
+        tracer = get_tracer()
+    stats = tracer.buffer.stats()
+    r.gauge("trace_buffer_traces", "completed traces currently buffered",
+            stats["buffered"])
+    r.counter("trace_traces_added_total",
+              "traces ever offered to the buffer", stats["added"])
+    r.counter("trace_traces_error_kept_total",
+              "error traces tail-kept", stats["errors_kept"])
+    r.counter("trace_traces_slow_kept_total",
+              "slow-percentile traces tail-kept", stats["slow_kept"])
+    from mmlspark_tpu.utils.profiling import device_memory_stats
+    mem = device_memory_stats()
+    if mem:
+        for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+            if key in mem:
+                r.gauge(f"device_memory_{key}",
+                        "accelerator memory stats (device 0)", mem[key])
+
+
+def pipeline_families(r: PromRenderer, pipeline: Any,
+                      labels: Optional[Dict[str, Any]] = None) -> None:
+    """The duck-typed pipeline surface (model histograms, jit-cache
+    misses, drift monitor) rendered once — shared by the engine's and
+    the fleet's expositions so a new pipeline hook is wired in ONE
+    place."""
+    model_hists = getattr(pipeline, "histograms", None)
+    if callable(model_hists):
+        try:
+            for name, hist in model_hists().items():
+                r.histogram(f"serving_model_{sanitize_name(name)}",
+                            "model-stage latency distribution", hist,
+                            labels)
+        except Exception:  # noqa: BLE001 — stats stay partial
+            pass
+    miss_fn = getattr(pipeline, "jit_cache_miss_count", None)
+    if callable(miss_fn):
+        try:
+            r.counter("serving_jit_cache_misses_total",
+                      "XLA compiles triggered by the serving forward "
+                      "(steady state should be flat)", miss_fn(), labels)
+        except Exception:  # noqa: BLE001 — stats stay partial
+            pass
+    monitor = getattr(pipeline, "drift_monitor", None)
+    if monitor is not None:
+        try:
+            drift_families(r, monitor, labels)
+        except Exception:  # noqa: BLE001 — stats stay partial
+            pass
+
+
+def drift_families(r: PromRenderer, monitor: Any,
+                   labels: Optional[Dict[str, Any]] = None) -> None:
+    """``DriftMonitor`` summary as gauges (served-traffic feature drift
+    vs fit-time statistics)."""
+    summary = monitor.summary()
+    base = dict(labels or {})
+    r.gauge("serving_drift_rows", "rows folded into the drift monitor",
+            summary.get("rows", 0), base)
+    if summary.get("rows", 0) == 0:
+        return
+    r.gauge("serving_drift_max_abs_mean_delta_sigma",
+            "max per-feature |mean shift| in fit-time sigma units",
+            summary["max_abs_mean_delta_sigma"], base)
+    r.gauge("serving_drift_max_var_ratio",
+            "max per-feature served/fit variance ratio",
+            summary["max_var_ratio"], base)
+    r.gauge("serving_drift_null_rate",
+            "NaN/inf rate across served feature cells",
+            summary["null_rate"], base)

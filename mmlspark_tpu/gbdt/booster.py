@@ -679,6 +679,12 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     and ``train_info``: {bin_path: 'device'|'host', boost_chunk,
     boost_chunks}."""
     import time as _time
+    from mmlspark_tpu.core.trace import get_tracer
+    _tracer = get_tracer()
+    # one trace per train(): the phase marks below double as spans, so
+    # the same bin/ship/boost intervals that feed the histograms are
+    # readable per-run in /debug/traces and perfetto
+    _trace = _tracer.new_trace("gbdt.train") if _tracer.enabled else None
     _phases: Dict[str, float] = {}
     _t_phase = _time.perf_counter()
 
@@ -686,6 +692,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         nonlocal _t_phase
         now = _time.perf_counter()
         _phases[name] = _phases.get(name, 0.0) + (now - _t_phase)
+        if _trace is not None:
+            _tracer.emit(name, _t_phase, now, trace=_trace)
         _t_phase = now
 
     p = dict(DEFAULTS)
@@ -1334,8 +1342,12 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             # device execution (blocking here would serialize the async
             # pipeline). The compile-bearing first chunk lands under
             # first_iter, not in this series.
-            boost_chunk_hist.observe(
-                (_time.perf_counter() - t_chunk) * 1e3)
+            _t_chunk_end = _time.perf_counter()
+            boost_chunk_hist.observe((_t_chunk_end - t_chunk) * 1e3)
+            if _trace is not None:
+                _tracer.emit("boost_chunk", t_chunk, _t_chunk_end,
+                             trace=_trace,
+                             attrs={"it0": int(it0), "length": int(S)})
 
         if use_valid:
             eval_fn = _make_valid_eval(obj_key, K, lr, S, valid_depth)
@@ -1430,6 +1442,11 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         h = hists.get(phase_name)
         if h is not None:
             h.observe(secs * 1e3)
+    if _trace is not None:
+        _trace.root.set("bin_path", bin_path)
+        _trace.root.set("boost_chunks", n_chunks)
+        _trace.root.set("trees", trees_done)
+        _tracer.finish(_trace)
     return booster
 
 

@@ -211,6 +211,16 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
     profileDir = StringParam(
         "emit a jax.profiler xplane trace of the training loop here "
         "('' = off; SURVEY §5 profiler upgrade)", default="")
+    traceAnnotations = BoolParam(
+        "wrap each train-step/chunk dispatch in a named "
+        "jax.profiler.TraceAnnotation so an on-chip (xplane) profile's "
+        "rows correlate 1:1 with the framework's learner.step/chunk "
+        "spans (opt-in: annotations cost a TraceMe record per dispatch)",
+        default=False)
+    memoryStatsEvery = IntParam(
+        "steps between device-memory-stats samples (bytes_in_use/peak) "
+        "recorded into learner.memory_samples and the fit trace "
+        "(0 = off; device-feed mode samples once per chunk)", default=0)
 
     def _post_init(self):
         self._mesh: Optional[Mesh] = None
@@ -499,6 +509,38 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
 
         self.history = []
         self.timing: Dict[str, float] = {}
+        # fit-scoped trace: per-step/chunk dispatch spans + optional
+        # device-memory samples, in the same buffer the serving spans
+        # land in (span count capped so a long fit can't balloon it)
+        from mmlspark_tpu.core.trace import get_tracer
+        _tracer = get_tracer()
+        fit_trace = _tracer.new_trace("learner.fit") \
+            if _tracer.enabled else None
+        _SPAN_CAP = 2048
+        ann_on = bool(self.get("traceAnnotations"))
+        mem_every = int(self.get("memoryStatsEvery") or 0)
+        self.memory_samples: List[Dict[str, Any]] = []
+
+        def _emit_span(name, t0, **attrs):
+            if fit_trace is not None and \
+                    len(fit_trace._spans) < _SPAN_CAP:
+                _tracer.emit(name, t0, trace=fit_trace, attrs=attrs)
+
+        def _sample_memory(step_, force=False):
+            if not mem_every or (not force and step_ % mem_every):
+                return
+            from mmlspark_tpu.utils.profiling import device_memory_stats
+            stats = device_memory_stats()
+            if not stats:
+                return
+            sample = {"step": int(step_)}
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in stats:
+                    sample[key] = stats[key]
+            self.memory_samples.append(sample)
+            _emit_span("memory", _time.perf_counter(), **sample)
+
         np_rng = np.random.default_rng(self.get("seed"))
         log_every = self.get("logEvery")
         ckpt_every = self.get("checkpointEvery")
@@ -798,19 +840,43 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                             flops_per_step = _step_flops(
                                 probe.lower(state, batch_sds).compile())
                             flops_per_step = flops_per_step or -1.0
-                        state, losses, cnt = fn(
-                            state, x_dev, y_dev, w_dev,
-                            np.int32(epoch), np.int32(i))
+                        from mmlspark_tpu.utils.profiling import annotate
+                        t_chunk = _time.perf_counter()
+                        if ann_on:
+                            with annotate("learner_chunk"):
+                                state, losses, cnt = fn(
+                                    state, x_dev, y_dev, w_dev,
+                                    np.int32(epoch), np.int32(i))
+                        else:
+                            state, losses, cnt = fn(
+                                state, x_dev, y_dev, w_dev,
+                                np.int32(epoch), np.int32(i))
                         global_step = base + seg_end
                         chunk_bookkeeping(losses, cnt, length, epoch)
+                        _emit_span("learner.chunk", t_chunk,
+                                   step=global_step, epoch=epoch,
+                                   length=length)
+                        _sample_memory(global_step, force=bool(mem_every))
                         i = seg_end
         else:
+            from mmlspark_tpu.utils.profiling import annotate
             feed = make_prefetcher(index_stream(), make_batch, depth=2)
             try:
                 with maybe_trace(self.get("profileDir")):
                     for epoch, global_step, true_len, batch in feed:
-                        state, loss = jit_step(state, batch)
+                        t_step = _time.perf_counter()
+                        if ann_on:
+                            with annotate("learner_step"):
+                                state, loss = jit_step(state, batch)
+                        else:
+                            state, loss = jit_step(state, batch)
+                        # dispatch-enqueue wall (steps run async): the
+                        # span shows host-side stalls, the xplane
+                        # annotation shows the on-chip time
+                        _emit_span("learner.step", t_step,
+                                   step=global_step, epoch=epoch)
                         step_bookkeeping(loss, true_len, epoch)
+                        _sample_memory(global_step)
             finally:
                 # abnormal exit must not leave the worker blocked in put()
                 # pinning prefetched batches in HBM
@@ -871,6 +937,15 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
                     self.timing["mfu"] = tflops * 1e12 / peak
         if ckpt_dir:
             _save_checkpoint(ckpt_dir, global_step, state)
+        if fit_trace is not None:
+            fit_trace.root.set("steps", int(global_step))
+            fit_trace.root.set("feed",
+                               "device" if device_feed else "host")
+            if self.timing:
+                fit_trace.root.set(
+                    "examples_per_sec",
+                    round(self.timing.get("examples_per_sec", 0.0), 1))
+            _tracer.finish(fit_trace)
 
         host_params = jax.device_get(state["params"])
         host_bs = jax.device_get(state["batch_stats"])

@@ -233,6 +233,22 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
             self.transform(table._take_indices(idx))
         return self.jit_cache_misses - before
 
+    def bucket_for(self, rows: int) -> int:
+        """The padded bucket a ``rows``-row micro-batch compiles/runs
+        at (the pow-2 padding rule of ``bucket_sizes``): serving spans
+        annotate it so a trace shows which executable a batch hit."""
+        cap = int(self.get("batchSize"))
+        b = MIN_BUCKET
+        while b < rows:
+            b *= 2
+        return min(b, cap)
+
+    def histograms(self) -> Dict[str, Any]:
+        """Raw pad/device histogram objects (exact buckets) for the
+        Prometheus exposition — ``metrics()`` keeps returning the
+        summary view."""
+        return dict(self._hists)
+
     def metrics(self) -> Dict[str, Any]:
         """Serving instrumentation: pad/device latency summaries + the
         compile-cache miss counter (duck-typed hook consumed by
@@ -268,8 +284,8 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
             batch sizes — serving micro-batches drain whatever is queued
             — would each trigger a fresh XLA compile (seconds through a
             remote backend). Buckets bound the distinct shapes to
-            log2(batchSize)+1 (see bucket_sizes); padded rows are sliced
-            off by the [:true_len] readback."""
+            log2(batchSize)+1 (see bucket_sizes/bucket_for); padded rows
+            are sliced off by the [:true_len] readback."""
             b = MIN_BUCKET
             while b < rows:
                 b *= 2

@@ -130,6 +130,22 @@ def json_scoring_pipeline(model, field: str = "features",
     model_warmup = getattr(model, "warmup", None)
     if callable(model_warmup):
         lam.warmup = model_warmup
+    # observability hooks the engine duck-types: raw histogram objects
+    # (the Prometheus /metrics renderer needs exact buckets, not
+    # summaries), the compile-cache counter (device spans flag
+    # jit_cache_miss per batch; /metrics exports the total), the shape
+    # bucket a batch pads to (span annotation), and the drift monitor
+    # (drift gauges on /metrics)
+    model_hists = getattr(model, "histograms", None)
+    if callable(model_hists):
+        lam.histograms = model_hists
+    if hasattr(model, "jit_cache_misses"):
+        lam.jit_cache_miss_count = lambda: model.jit_cache_misses
+    model_bucket = getattr(model, "bucket_for", None)
+    if callable(model_bucket):
+        lam.bucket_for = model_bucket
+    if drift_monitor is not None:
+        lam.drift_monitor = drift_monitor
     return lam
 
 
@@ -197,7 +213,19 @@ class ServingFleet:
                  max_parked: Optional[int] = None,
                  max_wait_ms: float = 5.0,
                  pipeline_depth: int = 2,
-                 version: str = "v0"):
+                 version: str = "v0", tracer=None,
+                 tracing: Optional[bool] = None):
+        from mmlspark_tpu.core import trace as trace_mod
+        # ONE tracer across the fleet: every engine's completed traces
+        # land in the same tail-sampled buffer, so fleet.traces() is
+        # the whole fleet's story (default: the process-wide tracer)
+        if tracing is None:
+            from mmlspark_tpu.core import config as _config
+            tracing = bool(_config.get("trace.enabled", True))
+        self.tracer = (tracer if tracer is not None
+                       else trace_mod.get_tracer()) if tracing else None
+        if self.tracer is not None and not self.tracer.enabled:
+            self.tracer = None
         self.engines: List[ServingEngine] = []
         self.transport_errors = 0
         self.hedged_requests = 0
@@ -218,7 +246,8 @@ class ServingFleet:
                         batch_size=batch_size, workers=workers,
                         max_wait_ms=max_wait_ms,
                         pipeline_depth=pipeline_depth,
-                        version=version).start()
+                        version=version, tracer=self.tracer,
+                        tracing=self.tracer is not None).start()
                 except Exception:
                     source.close()   # don't orphan the bound port
                     raise
@@ -637,6 +666,79 @@ class ServingFleet:
         aggregate["swaps_rolled_back"] = sum(
             m.get("swaps_rolled_back", 0) for m in per_engine)
         return {"engines": per_engine, "aggregate": aggregate}
+
+    def traces(self, limit: Optional[int] = None,
+               raw: bool = False) -> Any:
+        """The fleet's completed (tail-sampled) traces. Default: Chrome
+        trace-event JSON (save to a file, open in Perfetto); pass
+        ``raw=True`` for the Trace objects. Engines share one tracer,
+        so this is every engine's traffic on one timeline."""
+        if self.tracer is None:
+            from mmlspark_tpu.core.trace import to_chrome_trace
+            return [] if raw else to_chrome_trace([])
+        traces = self.tracer.buffer.traces(limit)
+        if raw:
+            return traces
+        from mmlspark_tpu.core.trace import to_chrome_trace
+        return to_chrome_trace(traces)
+
+    def metrics_text(self) -> str:
+        """Fleet-wide Prometheus text exposition: per-engine counters
+        (labeled ``engine="<i>"``), the merged cross-engine latency
+        histograms, fleet client counters (failover/hedging), and the
+        process-wide phase/trace families. Each engine also serves its
+        own ``/metrics``; this is the aggregate the ops view scrapes."""
+        from mmlspark_tpu.core.metrics import LatencyHistogram
+        from mmlspark_tpu.core.prometheus import (
+            PromRenderer, pipeline_families, process_families,
+        )
+        r = PromRenderer()
+        for i, e in enumerate(self.engines):
+            src = e.source
+            with src._lock:
+                seen, answered, rejected = (
+                    src.requests_seen, src.requests_answered,
+                    src.requests_rejected)
+            labels = {"engine": str(i)}
+            r.counter("serving_requests_seen_total",
+                      "requests hitting the HTTP source", seen, labels)
+            r.counter("serving_requests_answered_total",
+                      "requests answered", answered, labels)
+            r.counter("serving_requests_rejected_total",
+                      "requests shed", rejected, labels)
+            _, snap = e._lifecycle_snapshot()
+            r.counter("serving_batches_processed_total",
+                      "micro-batches executed",
+                      snap["batches_processed"], labels)
+            r.counter("serving_swaps_completed_total",
+                      "model swaps completed",
+                      snap["swaps_completed"], labels)
+            r.counter("serving_swaps_rolled_back_total",
+                      "model swaps rolled back",
+                      snap["swaps_rolled_back"], labels)
+            r.info("serving_model_info",
+                   "active model version and swap state per engine",
+                   {**labels, "version": snap["model_version"],
+                    "swap_state": snap["swap_state"]})
+        if self.engines:
+            for key in self.engines[0].hists:
+                merged = LatencyHistogram.merged(
+                    [e.hists[key] for e in self.engines])
+                r.histogram(f"serving_{key}",
+                            "fleet-merged hot-path stage distribution",
+                            merged)
+            # fleet engines share one pipeline object, so its hooks
+            # (model hists, jit misses, drift) are already fleet-wide
+            pipeline_families(r, self.engines[0].pipeline)
+        with self._stats_lock:
+            transport, hedged = self.transport_errors, \
+                self.hedged_requests
+        r.counter("serving_fleet_transport_errors_total",
+                  "client-side transport failures", transport)
+        r.counter("serving_fleet_hedged_requests_total",
+                  "tail-latency hedge requests fired", hedged)
+        process_families(r, tracer=self.tracer)
+        return r.render()
 
     def counters(self) -> Dict[str, int]:
         return {

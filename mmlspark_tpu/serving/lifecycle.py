@@ -387,8 +387,12 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
         def rolled_back(reason: str,
                         stats: Optional[Dict[str, Any]] = None
                         ) -> SwapResult:
-            engine.swap_state = ROLLED_BACK
+            # state + counter move together under the stats lock, so a
+            # concurrent metrics()/healthz scrape can never see
+            # rolled_back state with the old rollback count (the
+            # consistent-snapshot contract of engine._lifecycle_snapshot)
             with engine._stats_lock:
+                engine.swap_state = ROLLED_BACK
                 engine.swaps_rolled_back += 1
             event = SwapEvent("rolled_back", from_version, version,
                               reason=reason, stats=stats)
@@ -404,7 +408,8 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
             return rolled_back("engine_dead")
 
         # -- warming: compile every bucket OFF the hot path -----------------
-        engine.swap_state = WARMING
+        with engine._stats_lock:
+            engine.swap_state = WARMING
         reason = _run_warmup(pipeline, warmup_example,
                              policy.warmup_timeout_s)
         if reason is not None:
@@ -416,7 +421,8 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
             canary = PipelineHandle(pipeline, version, is_canary=True)
             ctl = SwapController(old, canary, policy)
             engine._swap_ctl = ctl
-            engine.swap_state = CANARY
+            with engine._stats_lock:
+                engine.swap_state = CANARY
             try:
                 decision = ctl.wait_decision(policy.decision_timeout_s)
                 stats = ctl.stats()
@@ -426,9 +432,14 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
                 return rolled_back(decision, stats)
 
         # -- draining: atomic cutover, old version drains -------------------
-        engine.swap_state = DRAINING
         new_handle = PipelineHandle(pipeline, version)
-        engine._active = new_handle      # THE cutover: one atomic store
+        with engine._stats_lock:
+            # THE cutover: handle + state flip in one locked block —
+            # batchers read _active lock-free (a plain ref load), but a
+            # metrics()/healthz snapshot sees version and swap_state
+            # move together instead of piecemeal
+            engine._active = new_handle
+            engine.swap_state = DRAINING
         deadline = time.monotonic() + policy.drain_timeout_s
         while old.outstanding > 0 and time.monotonic() < deadline:
             time.sleep(0.005)
@@ -438,8 +449,8 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
                 "flight after %.1fs drain budget (cutover already "
                 "done; they will answer on %s)", from_version, version,
                 old.outstanding, policy.drain_timeout_s, from_version)
-        engine.swap_state = IDLE
         with engine._stats_lock:
+            engine.swap_state = IDLE
             engine.swaps_completed += 1
         event = SwapEvent("completed", from_version, version, stats=stats)
         engine.swap_events.append(event)

@@ -20,12 +20,14 @@ replicated by jax, no cross-host reply routing is ever needed.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import queue
 import secrets
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -96,6 +98,10 @@ class _ParkedRequest:
         # drain_parked/top_up, the two exits from the source queue)
         self.enqueued_at: float = 0.0
         self.dequeued_at: float = 0.0
+        # the request's Trace (core.trace) when the engine traces; the
+        # handler thread is the single finalization point (success,
+        # shed, timeout, client-gone — every exit buffers the trace)
+        self.trace = None
 
     def respond(self, response: Dict[str, Any]) -> None:
         self.response = response
@@ -141,6 +147,12 @@ class HTTPSource:
         # set by ServingEngine.start(): () -> dict of latency-histogram
         # summaries (queue-wait/pad/device/respond), exported on /healthz
         self.metrics_probe: Optional[Callable[[], Dict[str, Any]]] = None
+        # set by ServingEngine.start(): the engine's Tracer (ingress
+        # creates each request's trace, honoring X-Trace-Id), the
+        # /debug/traces exporter, and the /metrics Prometheus renderer
+        self.tracer = None
+        self.trace_probe: Optional[Callable[..., Dict[str, Any]]] = None
+        self.prom_probe: Optional[Callable[[], str]] = None
         self._pending: Dict[str, _ParkedRequest] = {}
         self._lock = threading.Lock()
         self._new_rid = _request_id_factory()
@@ -178,8 +190,55 @@ class HTTPSource:
                           "retry_after": source.retry_after_s},
                     {"Retry-After": str(source.retry_after_s)})
 
+            def _send_text(self, code: int, text: str,
+                           content_type: str = "text/plain"):
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):  # noqa: N802 (http.server API)
                 path_only = self.path.split("?", 1)[0].rstrip("/")
+                if path_only == "/metrics":
+                    # Prometheus text exposition of every counter,
+                    # histogram, swap/drift state (see core.prometheus)
+                    if source.prom_probe is None:
+                        self.send_error(
+                            404, "no engine attached (metrics)")
+                        return
+                    try:
+                        text = source.prom_probe()
+                    except Exception as e:  # noqa: BLE001
+                        self.send_error(500, f"metrics render: {e}")
+                        return
+                    from mmlspark_tpu.core.prometheus import \
+                        PROM_CONTENT_TYPE
+                    self._send_text(200, text, PROM_CONTENT_TYPE)
+                    return
+                if path_only == "/debug/traces":
+                    # tail-sampled completed traces as Chrome
+                    # trace-event JSON (open directly in Perfetto)
+                    if source.trace_probe is None:
+                        self.send_error(
+                            404, "no engine attached (traces)")
+                        return
+                    limit = None
+                    query = urllib.parse.parse_qs(
+                        urllib.parse.urlsplit(self.path).query)
+                    if query.get("limit"):
+                        try:
+                            limit = int(query["limit"][0])
+                        except ValueError:
+                            pass
+                    try:
+                        payload = source.trace_probe(limit)
+                    except Exception as e:  # noqa: BLE001
+                        self.send_error(500, f"trace export: {e}")
+                        return
+                    self._send_json(200, payload)
+                    return
                 if path_only != "/healthz":
                     self.send_error(404, f"unknown path {path_only}")
                     return
@@ -235,6 +294,32 @@ class HTTPSource:
                     self.path, "POST", body,
                     {k: v for k, v in self.headers.items()})
                 parked = _ParkedRequest(source._new_rid(), req)
+                tracer = source.tracer
+                if tracer is not None and tracer.enabled:
+                    # request-scoped trace: root span from ingress,
+                    # trace id propagated from (or issued to) the
+                    # client via X-Trace-Id. This handler is the single
+                    # finalization point — every exit below buffers it.
+                    parked.trace = tracer.new_trace(
+                        "request",
+                        trace_id=self.headers.get("X-Trace-Id"))
+                    parked.trace.root.set("path", self.path)
+
+                def _finalize(code: int) -> None:
+                    tr = parked.trace
+                    if tr is None:
+                        return
+                    tr.root.set("http_status", code)
+                    if code == 503:
+                        # load shedding is EXPECTED back-pressure, not
+                        # a failure: marking sheds as errors would let
+                        # an overload flood the protected tail ring and
+                        # evict the genuine error traces it exists for
+                        tr.root.set("shed", True)
+                    elif code >= 500:
+                        tr.root.error()
+                    tracer.finish(tr)
+
                 with source._lock:
                     if len(source._pending) >= source.max_parked:
                         shed = True
@@ -243,6 +328,7 @@ class HTTPSource:
                         shed = False
                 if shed:
                     self._shed("parked-request table full")
+                    _finalize(503)
                     return
                 parked.enqueued_at = time.perf_counter()
                 try:
@@ -253,6 +339,7 @@ class HTTPSource:
                     with source._lock:
                         source._pending.pop(parked.id, None)
                     self._shed("queue full")
+                    _finalize(503)
                     return
                 resp = parked.wait(reply_timeout)
                 with source._lock:
@@ -260,6 +347,7 @@ class HTTPSource:
                 try:
                     if resp is None:
                         self.send_error(504, "serving timeout")
+                        _finalize(504)
                         return
                     code = resp["statusLine"]["statusCode"]
                     entity = resp.get("entity") or b""
@@ -271,9 +359,15 @@ class HTTPSource:
                     # duplicate/conflict
                     _framing = {"content-length", "transfer-encoding",
                                 "connection"}
+                    sent_trace_id = False
                     for k, v in (resp.get("headers") or {}).items():
                         if k.lower() not in _framing:
+                            if k.lower() == "x-trace-id":
+                                sent_trace_id = True
                             self.send_header(k, v)
+                    if parked.trace is not None and not sent_trace_id:
+                        self.send_header("X-Trace-Id",
+                                         parked.trace.trace_id)
                     self.send_header("Content-Length", str(len(entity)))
                     self.end_headers()
                     self.wfile.write(entity)
@@ -281,10 +375,14 @@ class HTTPSource:
                     # client gave up (timeout/disconnect) before the
                     # reply flushed: fold the connection quietly instead
                     # of killing the handler thread with a stack trace
+                    if parked.trace is not None:
+                        parked.trace.root.set("client_disconnected", True)
+                        _finalize(499)
                     self.close_connection = True
                     return
                 with source._lock:
                     source.requests_answered += 1
+                _finalize(code)
 
             def log_message(self, *a):  # silence default stderr logging
                 pass
@@ -458,6 +556,54 @@ class PipelineHandle:
             return self._outstanding
 
 
+class _BatchTraceCtx:
+    """Per-micro-batch tracing context, riding the dispatch item from
+    the batcher to the worker (and through retries/rescues) so every
+    stage lands spans on the right request traces.
+
+    Batch-join semantics: ``batch_span`` creates ONE span that is
+    shared by every member trace and ``links`` each request's root
+    span — one decode/device span explains all N rows it served."""
+
+    __slots__ = ("tracer", "traces", "by_rid", "primary", "roots",
+                 "dispatched_at")
+
+    def __init__(self, tracer, parked: List[_ParkedRequest]):
+        self.tracer = tracer
+        self.traces = []
+        self.by_rid: Dict[str, Any] = {}
+        self.roots = []
+        # stamped when the batcher hands the item to the dispatch
+        # queue; the FIRST device span starts here so the worker-wake
+        # handoff is attributed instead of falling between spans
+        self.dispatched_at: Optional[float] = None
+        for p in parked:
+            if p.trace is not None:
+                self.traces.append(p.trace)
+                self.by_rid[p.id] = p.trace
+                self.roots.append(p.trace.root)
+        self.primary = self.traces[0] if self.traces else None
+
+    def batch_span(self, name: str, start: Optional[float] = None):
+        if self.primary is None:
+            return None
+        span = self.tracer.start_span(name, self.primary,
+                                      parent=self.primary.root,
+                                      start=start)
+        for root in self.roots:
+            span.link(root.trace_id, root.span_id)
+        for tr in self.traces[1:]:
+            tr.add(span)
+        return span
+
+    def request_span(self, rid: str, name: str,
+                     start: Optional[float] = None):
+        tr = self.by_rid.get(rid)
+        if tr is None:
+            return None
+        return self.tracer.start_span(name, tr, start=start)
+
+
 class ServingEngine:
     """The streaming loop: source → adaptive micro-batcher → user
     pipeline → sink (the structured-streaming query of ref:
@@ -494,9 +640,24 @@ class ServingEngine:
                  content_type: str = "application/json",
                  error_col: str = "error", workers: int = 1,
                  max_wait_ms: float = 5.0, pipeline_depth: int = 2,
-                 version: str = "v0"):
+                 version: str = "v0", tracer=None,
+                 tracing: Optional[bool] = None):
         from mmlspark_tpu.core.metrics import histogram_set
+        from mmlspark_tpu.core import trace as trace_mod
         self.source = source
+        # request tracing: ``tracing`` overrides config
+        # ``trace.enabled``; the tracer (and so the completed-trace
+        # buffer) defaults to the process-wide one, so a fleet's
+        # engines share one buffer and training spans land beside
+        # serving spans. ``self.tracer is None`` == tracing off — the
+        # hot path pays one attribute check.
+        if tracing is None:
+            from mmlspark_tpu.core import config as _config
+            tracing = bool(_config.get("trace.enabled", True))
+        self.tracer = (tracer if tracer is not None
+                       else trace_mod.get_tracer()) if tracing else None
+        if self.tracer is not None and not self.tracer.enabled:
+            self.tracer = None
         # versioned pipeline binding: batches carry the handle they
         # were built with, so a swap can cut over atomically (one
         # attribute store) while in-flight batches drain on their own
@@ -552,8 +713,11 @@ class ServingEngine:
     def pipeline(self, pipeline: Transformer) -> None:
         # raw override (tests / embeddings): rebind the active handle in
         # place, keeping the version tag — the supported production path
-        # is swap(), which warms up and canaries the incoming model
-        self._active = PipelineHandle(pipeline, self._active.version)
+        # is swap(), which warms up and canaries the incoming model.
+        # Under _stats_lock like every other handle/state write, so
+        # metrics()/healthz snapshots stay consistent.
+        with self._stats_lock:
+            self._active = PipelineHandle(pipeline, self._active.version)
 
     @property
     def model_version(self) -> str:
@@ -591,11 +755,34 @@ class ServingEngine:
             else body.encode("utf-8"),
             {"Content-Type": self.content_type}))
 
-    def _answer_output(self, out: DataTable, ids: List[str]) -> None:
+    def _finish_request_trace(self, tctx: Optional[_BatchTraceCtx],
+                              rid: str, t_answer: float,
+                              error: bool = False) -> None:
+        """Trace bookkeeping for one reply, BEFORE the respond() event
+        fires: a ``respond`` span covering wait-for-my-turn in the
+        answer loop + this row's flush, then the root closes at
+        reply-enqueue. All trace writes happen before the handler
+        thread (which buffers the finished trace) can wake."""
+        if tctx is None:
+            return
+        span = tctx.request_span(rid, "respond", start=t_answer)
+        if span is None:
+            return
+        if error:
+            span.error()
+        span.finish()
+        root = tctx.by_rid[rid].root
+        if error:
+            root.error()
+        root.finish()
+
+    def _answer_output(self, out: DataTable, ids: List[str],
+                       tctx: Optional[_BatchTraceCtx] = None) -> None:
         """Answer one transformed batch, splitting per-row errors: a
         non-null ``error_col`` value means that row failed and gets a
         500 while its batchmates still get their 200s
         (ref: SimpleHTTPTransformer.scala:104-150 error-split pipeline)."""
+        t_answer = time.perf_counter()
         replies = out[self.reply_col]
         out_ids = out[self.id_col]
         errors = (out[self.error_col]
@@ -604,13 +791,18 @@ class ServingEngine:
         for i, (rid, rep) in enumerate(zip(out_ids, replies)):
             err = errors[i] if errors is not None else None
             if err is not None and err == err:  # non-null, non-NaN
+                self._finish_request_trace(tctx, rid, t_answer,
+                                           error=True)
                 self.source.respond(rid, HTTPSchema.response(
                     500, f"row error: {err}", None))
             else:
+                self._finish_request_trace(tctx, rid, t_answer)
                 self._respond_ok(rid, rep)
             answered.add(rid)
         for rid in ids:
             if rid not in answered:
+                self._finish_request_trace(tctx, rid, t_answer,
+                                           error=True)
                 self.source.respond(rid, HTTPSchema.response(
                     500, "row dropped by pipeline", None))
 
@@ -624,27 +816,68 @@ class ServingEngine:
         self._execute_batch(table, ids, None, self._active)
         return len(ids)
 
+    def _device_span(self, tctx: Optional[_BatchTraceCtx],
+                     handle: PipelineHandle, rows: int):
+        """The batch-join device span: ONE span shared by every request
+        trace in the micro-batch, linking their root spans and carrying
+        the version/routing annotations the swap protocol needs to be
+        debuggable. Returns (span, jit_miss_probe, misses_before)."""
+        if tctx is None or tctx.primary is None:
+            return None, None, None
+        start = tctx.dispatched_at     # consumed once: a rescue/retry
+        tctx.dispatched_at = None      # re-run starts its span at now
+        ds = tctx.batch_span("device", start=start)
+        ds.set("model_version", handle.version)
+        ds.set("rows", rows)
+        if handle.is_canary:
+            ds.set("canary", True)
+        state = self.swap_state
+        if state != "idle":
+            ds.set("swap_state", state)
+        bucket_for = getattr(handle.pipeline, "bucket_for", None)
+        if callable(bucket_for):
+            try:
+                ds.set("bucket", int(bucket_for(rows)))
+            except Exception:  # noqa: BLE001 — annotation only
+                pass
+        miss_fn = getattr(handle.pipeline, "jit_cache_miss_count", None)
+        miss0 = None
+        if callable(miss_fn):
+            try:
+                miss0 = int(miss_fn())
+            except Exception:  # noqa: BLE001 — annotation only
+                miss_fn = None
+        return ds, miss_fn, miss0
+
     def _execute_batch(self, table: DataTable, ids: List[str],
                        prepped: Any,
-                       handle: Optional[PipelineHandle] = None) -> None:
+                       handle: Optional[PipelineHandle] = None,
+                       tctx: Optional[_BatchTraceCtx] = None) -> None:
         """Stage 2 of the pipeline: device execution + reply flush for
         one micro-batch (``prepped`` carries stage 1's decode output
         when the pipeline supports the split). The whole batch runs on
         ``handle``'s pipeline version — retries included — so no reply
         batch ever mixes model versions."""
+        from mmlspark_tpu.core.trace import use_span
         if handle is None:
             handle = self._active
         # canary handles carry their controller; stable batches report
         # to whatever swap is in flight (the latency-delta baseline)
         ctl = handle.controller if handle.controller is not None \
             else self.__dict__.get("_swap_ctl")
+        ds, miss_fn, miss0 = self._device_span(tctx, handle, len(ids))
+        span_ctx = use_span(ds) if ds is not None \
+            else contextlib.nullcontext()
         t0 = time.perf_counter()
         try:
-            if prepped is not None and handle.execute is not None:
-                out = handle.execute(table, prepped)
-            else:
-                out = handle.pipeline.transform(table)
+            with span_ctx:
+                if prepped is not None and handle.execute is not None:
+                    out = handle.execute(table, prepped)
+                else:
+                    out = handle.pipeline.transform(table)
         except Exception as e:  # noqa: BLE001 — isolate the poison row(s)
+            if ds is not None:
+                ds.error(e).finish()
             if handle.is_canary and handle.rescue_to is not None:
                 # a canary batch's faults are the SWAP's problem, not
                 # the clients': record the strike and re-execute the
@@ -655,14 +888,21 @@ class ServingEngine:
                 if ctl is not None:
                     ctl.observe(handle, ok=False, latency_ms=(
                         time.perf_counter() - t0) * 1e3, error=e)
-                self._run_rescued(table, ids, handle.rescue_to)
+                self._run_rescued(table, ids, handle.rescue_to, tctx)
                 return
             log.warning("serving batch failed (%s); retrying per-row", e)
-            self._process_rows_individually(table, ids, handle)
+            self._process_rows_individually(table, ids, handle, tctx)
             with self._stats_lock:
                 self.batches_processed += 1
             return
         dt_ms = (time.perf_counter() - t0) * 1e3
+        if ds is not None:
+            if miss_fn is not None:
+                try:
+                    ds.set("jit_cache_miss", bool(miss_fn() - miss0))
+                except Exception:  # noqa: BLE001 — annotation only
+                    pass
+            ds.finish()
         if ctl is not None:
             # the controller discards row_errors for stable handles, so
             # only canary batches pay the error-column scan
@@ -676,14 +916,14 @@ class ServingEngine:
                 # one client batch, one pipeline_ms sample.
                 ctl.observe(handle, ok=True, latency_ms=dt_ms,
                             row_errors=row_errors)
-                self._run_rescued(table, ids, handle.rescue_to)
+                self._run_rescued(table, ids, handle.rescue_to, tctx)
                 return
             ctl.observe(handle, ok=True, latency_ms=dt_ms,
                         row_errors=row_errors)
         self.hists["pipeline_ms"].observe(dt_ms)
         t1 = time.perf_counter()
         try:
-            self._answer_output(out, ids)
+            self._answer_output(out, ids, tctx)
         except Exception as e:  # noqa: BLE001 — e.g. missing reply column
             log.warning("answering batch failed (%s); sending 500s", e)
             for rid in ids:
@@ -695,15 +935,18 @@ class ServingEngine:
             self.batches_processed += 1
 
     def _run_rescued(self, table: DataTable, ids: List[str],
-                     rescue: PipelineHandle) -> None:
+                     rescue: PipelineHandle,
+                     tctx: Optional[_BatchTraceCtx] = None) -> None:
         """Re-execute a failed canary batch on the stable handle,
         COUNTED as in-flight on it: the swap's drain phase polls the
         old handle's outstanding count, so an untracked rescue could
         let the drain complete while this batch still runs on the old
-        version."""
+        version. The trace context rides along — a rescued trace shows
+        two device spans (the failed canary's and the stable rerun's),
+        which is exactly the story a swap post-mortem needs."""
         rescue.acquire()
         try:
-            self._execute_batch(table, ids, None, rescue)
+            self._execute_batch(table, ids, None, rescue, tctx)
         finally:
             rescue.release()
 
@@ -718,41 +961,81 @@ class ServingEngine:
     def _process_rows_individually(self, table: DataTable,
                                    ids: List[str],
                                    handle: Optional[PipelineHandle] = None,
+                                   tctx: Optional[_BatchTraceCtx] = None,
                                    ) -> None:
         """Batch-failure fallback: run each row alone so one poison
         request cannot 500 its batchmates (the per-row half of the
-        reference's error isolation, SimpleHTTPTransformer.scala:104-150)."""
+        reference's error isolation, SimpleHTTPTransformer.scala:104-150).
+        Each retried row gets its OWN device span (retry=true) on its
+        trace — the poison row's trace shows the failed batch span AND
+        its lone-row verdict."""
         if handle is None:
             handle = self._active
         requests = table["request"]
         for rid, req in zip(ids, requests):
             row = DataTable({"id": [rid], "request": [req]})
+            span = tctx.request_span(rid, "device") if tctx is not None \
+                else None
+            if span is not None:
+                span.set("model_version", handle.version)
+                span.set("rows", 1)
+                span.set("retry", True)
             try:
                 out = handle.pipeline.transform(row)
-                self._answer_output(out, [rid])
+                if span is not None:
+                    span.finish()
+                self._answer_output(out, [rid], tctx)
             except Exception as e:  # noqa: BLE001
+                if span is not None:
+                    span.error(e).finish()
                 self.source.respond(rid, HTTPSchema.response(
                     500, f"pipeline error: {e}", None))
 
     def _build_item(self, parked: List[_ParkedRequest],
                     handle: PipelineHandle) -> Tuple:
         """Assemble + (optionally) decode one collected batch: the host
-        half of the two-stage pipeline, run on the batcher thread."""
+        half of the two-stage pipeline, run on the batcher thread.
+        Tracing: each member request gets a ``queue_wait`` span
+        (ingress enqueue → batch assembly, covering both the source
+        queue AND the adaptive collect window) and the batch gets a
+        shared ``decode`` span; both ride the returned item so the
+        worker's device/respond spans land on the same traces."""
         table = DataTable({"id": [p.id for p in parked],
                            "request": [p.request for p in parked]})
         ids = [p.id for p in parked]
+        tctx: Optional[_BatchTraceCtx] = None
+        if self.tracer is not None:
+            ctx = _BatchTraceCtx(self.tracer, parked)
+            if ctx.primary is not None:
+                tctx = ctx
+                t_build = time.perf_counter()
+                for p in parked:
+                    if p.trace is not None:
+                        self.tracer.start_span(
+                            "queue_wait", p.trace,
+                            start=p.enqueued_at).finish(t_build)
         prepped = None
         if handle.prepare is not None and handle.execute is not None:
             t0 = time.perf_counter()
+            dspan = tctx.batch_span("decode", start=t0) \
+                if tctx is not None else None
+            if dspan is not None:
+                dspan.set("rows", len(ids))
             try:
                 prepped = handle.prepare(table)
+                if dspan is not None:
+                    dspan.finish()
                 self.hists["decode_ms"].observe(
                     (time.perf_counter() - t0) * 1e3)
-            except Exception:  # noqa: BLE001 — poison rows can die in
-                # decode too: hand the batch over un-prepared so the
+            except Exception as e:  # noqa: BLE001 — poison rows can die
+                # in decode too: hand the batch over un-prepared so the
                 # worker's per-row retry isolates the offender
+                if dspan is not None:
+                    dspan.error(e).finish()
                 prepped = None
-        return table, ids, prepped, handle
+        if tctx is not None:
+            tctx.dispatched_at = time.perf_counter()
+        return table, ids, prepped, handle, tctx
 
     def _batcher_loop(self):
         """Stage 1 of the pipeline: adaptive collect + (optional) host
@@ -899,21 +1182,32 @@ class ServingEngine:
                           or self._batcher.is_alive())
         return workers_ok and batcher_ok
 
+    def _lifecycle_snapshot(self) -> Tuple[PipelineHandle, Dict[str, Any]]:
+        """ONE consistent (handle, swap_state, counters) snapshot under
+        ``_stats_lock`` — the lock every lifecycle writer (cutover,
+        state transitions, counter bumps — see serving/lifecycle.py)
+        holds. Reading these fields piecemeal raced a concurrent
+        ``swap()``: a scrape could see the NEW version with the OLD
+        swaps_completed count, or ``swap_state == idle`` with the
+        not-yet-cut-over pipeline."""
+        with self._stats_lock:
+            active = self._active
+            return active, {
+                "batches_processed": self.batches_processed,
+                "workers_restarted": self.workers_restarted,
+                "model_version": active.version,
+                "swap_state": self.swap_state,
+                "swaps_completed": self.swaps_completed,
+                "swaps_rolled_back": self.swaps_rolled_back,
+            }
+
     def metrics(self) -> Dict[str, Any]:
         """Hot-path latency breakdown: engine histograms (queue wait,
         decode, pipeline, respond, batch occupancy) plus whatever the
         pipeline exposes through a duck-typed ``metrics`` hook
         (TPUModel adds its pad/device split and the jit-cache-miss
         counter). Exported on /healthz."""
-        with self._stats_lock:
-            out: Dict[str, Any] = {
-                "batches_processed": self.batches_processed,
-                "workers_restarted": self.workers_restarted,
-                "model_version": self.model_version,
-                "swap_state": self.swap_state,
-                "swaps_completed": self.swaps_completed,
-                "swaps_rolled_back": self.swaps_rolled_back,
-            }
+        active, out = self._lifecycle_snapshot()
         out.update({k: h.summary() for k, h in self.hists.items()})
         swap_ctl = self.__dict__.get("_swap_ctl")
         if swap_ctl is not None:
@@ -921,13 +1215,92 @@ class ServingEngine:
                 out["swap"] = swap_ctl.stats()
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
-        stage = getattr(self.pipeline, "metrics", None)
+        stage = getattr(active.pipeline, "metrics", None)
         if callable(stage):
             try:
                 out["pipeline_stage"] = stage()
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
         return out
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition (format 0.0.4) of everything the
+        engine knows: source/engine counters, the per-stage latency
+        histograms with exact buckets, the lifecycle state as an
+        ``_info`` series, the model's pad/device histograms and
+        jit-cache-miss counter, drift gauges, and the process-wide
+        GBDT/AutoML phase + trace-buffer families. Served on
+        ``/metrics``."""
+        from mmlspark_tpu.core.prometheus import (
+            PromRenderer, pipeline_families, process_families,
+        )
+        r = PromRenderer()
+        src = self.source
+        with src._lock:
+            seen, accepted = src.requests_seen, src.requests_accepted
+            answered, rejected = src.requests_answered, \
+                src.requests_rejected
+            parked = len(src._pending)
+        r.counter("serving_requests_seen_total",
+                  "requests hitting the HTTP source", seen)
+        r.counter("serving_requests_accepted_total",
+                  "requests parked + enqueued", accepted)
+        r.counter("serving_requests_answered_total",
+                  "requests answered through the held connection",
+                  answered)
+        r.counter("serving_requests_rejected_total",
+                  "requests shed with 503 + Retry-After", rejected)
+        r.gauge("serving_parked_requests",
+                "connections currently held open", parked)
+        r.gauge("serving_queue_depth", "source queue depth",
+                src.queue.qsize())
+        active, snap = self._lifecycle_snapshot()
+        r.counter("serving_batches_processed_total",
+                  "micro-batches executed", snap["batches_processed"])
+        r.counter("serving_workers_restarted_total",
+                  "worker/batcher threads respawned by the supervisor",
+                  snap["workers_restarted"])
+        r.counter("serving_swaps_completed_total",
+                  "model swaps promoted + cut over",
+                  snap["swaps_completed"])
+        r.counter("serving_swaps_rolled_back_total",
+                  "model swaps rolled back", snap["swaps_rolled_back"])
+        r.info("serving_model_info",
+               "active model version and swap state (labels)",
+               {"version": snap["model_version"],
+                "swap_state": snap["swap_state"]})
+        for name, hist in self.hists.items():
+            r.histogram(f"serving_{name}",
+                        "engine hot-path stage distribution", hist)
+        ctl = self.__dict__.get("_swap_ctl")
+        if ctl is not None:
+            try:
+                stats = ctl.stats()
+                r.gauge("serving_canary_batches",
+                        "canary batch outcomes for the swap in flight",
+                        stats["canary_ok"], {"outcome": "ok"})
+                r.sample("serving_canary_batches",
+                         stats["canary_failed"], {"outcome": "failed"})
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
+        pipeline_families(r, active.pipeline)
+        process_families(r, tracer=self.tracer)
+        return r.render()
+
+    # -- trace export -------------------------------------------------------
+
+    def traces(self, limit: Optional[int] = None) -> List[Any]:
+        """Completed (tail-sampled) traces from this engine's buffer,
+        oldest first."""
+        if self.tracer is None:
+            return []
+        return self.tracer.buffer.traces(limit)
+
+    def export_traces(self, limit: Optional[int] = None) -> Dict[str, Any]:
+        """The buffer as Chrome trace-event JSON (the /debug/traces
+        payload — save it and open in Perfetto)."""
+        from mmlspark_tpu.core.trace import to_chrome_trace
+        return to_chrome_trace(self.traces(limit))
 
     def start(self) -> "ServingEngine":
         with self._threads_lock:
@@ -939,6 +1312,9 @@ class ServingEngine:
         self._supervisor.start()
         self.source.health_probe = self.is_alive
         self.source.metrics_probe = self.metrics
+        self.source.tracer = self.tracer
+        self.source.trace_probe = self.export_traces
+        self.source.prom_probe = self.metrics_text
         return self
 
     def kill(self, close_source: bool = True) -> None:
@@ -973,7 +1349,8 @@ def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
                 reply_col: str = "reply",
                 workers: int = 1, max_wait_ms: float = 5.0,
                 pipeline_depth: int = 2,
-                version: str = "v0") -> ServingEngine:
+                version: str = "v0", tracer=None,
+                tracing: Optional[bool] = None) -> ServingEngine:
     """One-call serving: the ``.server()`` DSL analog
     (ref: ServingImplicits.scala:10-50). Batches flush on
     ``batch_size`` rows or ``max_wait_ms`` elapsed, whichever first;
@@ -986,4 +1363,5 @@ def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
                          batch_size=batch_size, workers=workers,
                          max_wait_ms=max_wait_ms,
                          pipeline_depth=pipeline_depth,
-                         version=version).start()
+                         version=version, tracer=tracer,
+                         tracing=tracing).start()

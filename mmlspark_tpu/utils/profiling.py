@@ -33,3 +33,86 @@ def trace_files(trace_dir: str) -> List[str]:
     """The xplane protobufs a trace run produced (for tests/tools)."""
     return sorted(glob.glob(
         os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
+
+
+@contextlib.contextmanager
+def annotate(name: str, enabled: bool = True) -> Iterator[None]:
+    """Named ``jax.profiler.TraceAnnotation`` around a code block when
+    ``enabled`` (else a no-op): framework spans (core.trace) and the
+    on-chip xplane timeline then share the same phase names, so a
+    device profile row correlates 1:1 with a framework span. Opt-in —
+    annotations cost a TraceMe record per entry even outside an active
+    profiler session."""
+    if not enabled:
+        yield
+        return
+    try:
+        import jax
+        ann = jax.profiler.TraceAnnotation(str(name))
+    except Exception:  # noqa: BLE001 — profiler API absent: still run
+        yield
+        return
+    with ann:
+        yield
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """Device 0's (or ``device``'s) ``memory_stats()`` as a plain dict,
+    or None when the backend doesn't report them (CPU) or jax isn't
+    loaded — safe to call from exporters at any time (a /metrics
+    scrape must not be the thing that pays jax's import + backend
+    init in a process that never touched it)."""
+    import sys
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+        d = device if device is not None else jax.local_devices()[0]
+        stats = d.memory_stats()
+    except Exception:  # noqa: BLE001 — no backend / no stats: no sample
+        return None
+    return dict(stats) if stats else None
+
+
+class MemorySampler:
+    """Background device-memory-stats sampler: a daemon thread snapshots
+    ``memory_stats()`` every ``interval_s`` into a bounded ring, so a
+    training run's framework spans can be read against the on-chip
+    memory curve (``TPULearner(memoryStatsEvery=...)`` uses the inline
+    per-step variant; this is the wall-clock variant for serving)."""
+
+    def __init__(self, interval_s: float = 1.0, capacity: int = 512,
+                 device=None):
+        import collections
+        import threading
+        self.interval_s = float(interval_s)
+        self.device = device
+        self.samples: "collections.deque" = collections.deque(
+            maxlen=int(capacity))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MemorySampler":
+        import threading
+        import time
+        if self._thread is not None:
+            return self
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                stats = device_memory_stats(self.device)
+                if stats is not None:
+                    stats["t"] = time.time()
+                    self.samples.append(stats)
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="mem-sampler")
+        self._thread.start()
+        return self
+
+    def stop(self) -> List[dict]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s + 1)
+            self._thread = None
+        return list(self.samples)

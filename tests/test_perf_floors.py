@@ -213,6 +213,85 @@ class TestServingQPSFloor:
             f"{recompiles} recompile(s) during steady-state serving")
 
 
+class TestTracingOverheadFloor:
+    def test_tracing_overhead_within_3_percent(self):
+        """Request tracing must stay ≤3% of serving throughput (the
+        observability contract: spans on by default may not tax the hot
+        path). Same serving-scenario shape as the QPS floor; tracing
+        OFF and ON runs interleave and each mode keeps its best rep, so
+        shared-host noise hits both sides of the ratio. The 3% pin gets
+        a small absolute-qps guard band on top purely for CI noise —
+        the bench observability scenario reports the unpadded number."""
+        import concurrent.futures
+        import json
+
+        import jax
+        from mmlspark_tpu.core.trace import Tracer
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+
+        dim, n_req, clients, reps = 32, 200, 8, 3
+        module = build_network({"type": "mlp", "features": [32],
+                                "num_classes": 4})
+        weights = {"params": module.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, dim), np.float32))["params"]}
+        model = TPUModel(modelFn=lambda w, ins: module.apply(
+            {"params": w["params"]}, list(ins.values())[0]),
+            weights=weights, inputCol="features", outputCol="scores",
+            batchSize=64, computeDtype="float32")
+        model.warmup({"features": np.zeros((1, dim), np.float32)})
+        body = json.dumps({"features": [0.1] * dim}).encode()
+
+        def run_once(tracing: bool, base_port: int) -> float:
+            tracer = Tracer(enabled=True) if tracing else None
+            fleet = ServingFleet(
+                json_scoring_pipeline(model), n_engines=2,
+                base_port=base_port, batch_size=64, workers=2,
+                max_wait_ms=6.0, tracer=tracer, tracing=tracing)
+            try:
+                def post(_):
+                    out = fleet.post(body, timeout=60)
+                    assert "prediction" in out, out
+                for _ in range(8):
+                    post(0)
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(
+                        clients) as ex:
+                    list(ex.map(post, range(n_req)))
+                wall = time.perf_counter() - t0
+                if tracing:
+                    # the tracer really ran: completed request traces
+                    # landed in the buffer during the measured window
+                    # (handlers buffer AFTER the response write, so the
+                    # last few finalizations can trail the client)
+                    time.sleep(0.3)
+                    assert tracer.buffer.stats()["added"] >= n_req - \
+                        clients
+            finally:
+                fleet.stop_all()
+            return n_req / wall
+
+        qps_off = qps_on = 0.0
+        port = 19600
+        for _ in range(reps):
+            qps_off = max(qps_off, run_once(False, port))
+            port += 30
+            qps_on = max(qps_on, run_once(True, port))
+            port += 30
+        overhead = (qps_off - qps_on) / qps_off
+        # ≤3% pinned, plus a 2-point guard band for this shared-host
+        # class's residual best-of-3 jitter (idle-host measurements sit
+        # at ≈0-1.5%; a per-request lock convoy or an unbounded buffer
+        # scan shows up as 10%+ and still fails hard)
+        assert overhead <= 0.05, (
+            f"tracing overhead {overhead:.1%} "
+            f"(off {qps_off:.1f} qps, on {qps_on:.1f} qps)")
+
+
 class TestAutoMLFloor:
     def test_featurize_vectorization_floor(self):
         """The columnar Featurize kernels vs the retained row-loop
