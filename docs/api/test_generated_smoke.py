@@ -659,6 +659,34 @@ def test_simplehttptransformer_smoke():
             assert clone.get(p) == stage.get(p)
 
 
+def test_standardscaler_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import StandardScaler
+    stage = StandardScaler()
+    assert stage.uid.startswith("StandardScaler")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is StandardScaler
+    assert clone.uid == stage.uid
+    for p in StandardScaler.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
+def test_standardscalermodel_smoke():
+    """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
+    from mmlspark_tpu.stages.dataprep import StandardScalerModel
+    stage = StandardScalerModel()
+    assert stage.uid.startswith("StandardScalerModel")
+    stage.explain_params()
+    clone = stage.copy()
+    assert type(clone) is StandardScalerModel
+    assert clone.uid == stage.uid
+    for p in StandardScalerModel.params():
+        if p.has_default and not p.is_complex:
+            assert clone.get(p) == stage.get(p)
+
+
 def test_stopwordsremover_smoke():
     """GENERATED — do not edit (ref: codegen PySparkWrapperTest)."""
     from mmlspark_tpu.stages.text import StopWordsRemover

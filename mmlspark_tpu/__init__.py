@@ -31,6 +31,22 @@ from mmlspark_tpu.core.stage import (
 )
 from mmlspark_tpu.core.params import Param
 
+# fusion exports resolve lazily (PEP 562): core.fusion imports jax at
+# module scope, and `import mmlspark_tpu` must stay host-only cheap —
+# schema/codegen tooling imports the package without paying JAX
+# backend initialization
+_FUSION_EXPORTS = ("DeviceOp", "DeviceTable", "FusedPipelineModel",
+                   "FusionPlan", "fuse")
+
+
+def __getattr__(name):
+    if name in _FUSION_EXPORTS:
+        from mmlspark_tpu.core import fusion
+        return getattr(fusion, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "__version__",
     "DataTable",
@@ -46,4 +62,9 @@ __all__ = [
     "PipelineModel",
     "load_stage",
     "Param",
+    "DeviceOp",
+    "DeviceTable",
+    "FusedPipelineModel",
+    "FusionPlan",
+    "fuse",
 ]

@@ -96,6 +96,17 @@ class Featurize(Estimator):
             return 1 << 18    # the reference's sparse default
         return self.get("numberOfFeatures")
 
+    def reads_columns(self, schema):
+        cols = self.get_or_none("featureColumns")
+        if cols is not None:
+            return list(cols)
+        if schema is None:
+            return None
+        return [c for c in schema.names if c != self.get("outputCol")]
+
+    def writes_columns(self, schema):
+        return [self.get("outputCol")]
+
     def fit(self, table: DataTable) -> "FeaturizeModel":
         t0 = time.perf_counter()
         cols = self.get_or_none("featureColumns")
@@ -290,6 +301,101 @@ def _assemble(parts: List[Any], output_col: str, table: DataTable
 class FeaturizeModel(Model):
     specs = ListParam("per-column featurization specs", default=None)
     outputCol = ColParam("assembled features column", default="features")
+
+    def reads_columns(self, schema):
+        return [s["col"] for s in (self.get("specs") or [])]
+
+    def writes_columns(self, schema):
+        return [self.get("outputCol")]
+
+    def device_op(self, schema):
+        """Fusion hook (core/fusion.py): the host-only kernels (arrow
+        dictionary string codes, FNV token hashing — the PR 4 columnar
+        paths) run as ``Feed`` loaders on the host/batcher thread; the
+        impute / one-hot / assembly runs inside the fused program, so
+        the assembled (N, D) matrix is an XLA intermediate flowing
+        straight into the model forward, never a host column. All parts
+        are exact in f32 (selects, compares, small-int counts), so the
+        fused featurize is bit-identical to the host ``transform``."""
+        from mmlspark_tpu.core import fusion as FZ
+        import jax.numpy as jnp
+        specs = self.get("specs") or []
+        if not specs or any(s["kind"] == "hash" and s.get("sparse")
+                            for s in specs):
+            return None    # CSR assembly stays on host
+        out_col = self.get("outputCol")
+        reads: List[str] = []
+        feeds: List[Any] = []
+        metas: List[Dict[str, Any]] = []
+        for i, spec in enumerate(specs):
+            c, kind = spec["col"], spec["kind"]
+            m: Dict[str, Any] = {"kind": kind}
+            if kind in ("numeric", "vector"):
+                if c not in reads:
+                    reads.append(c)
+                m["read"] = c
+            elif kind == "onehot":
+                name = f"{self.uid}:{i}:{c}:i32"
+                feeds.append(FZ.Feed(
+                    name, lambda t, _c=c: np.asarray(
+                        t[_c], dtype=np.int64).astype(np.int32)))
+                m["feed"] = name
+                m["size"] = spec["size"]
+            elif kind in ("string_index", "string_onehot"):
+                name = f"{self.uid}:{i}:{c}:codes"
+                levels = spec["levels"]
+                feeds.append(FZ.Feed(
+                    name, lambda t, _c=c, _lv=levels:
+                    _string_codes(t[_c], _lv).astype(np.int32)))
+                m["feed"] = name
+                if kind == "string_onehot":
+                    m["size"] = len(levels)
+            elif kind == "hash":
+                name = f"{self.uid}:{i}:{c}:hash"
+                size = spec["size"]
+                feeds.append(FZ.Feed(
+                    name, lambda t, _c=c, _m=size:
+                    hash_counts_dense(t[_c], _m, binary=False)))
+                m["feed"] = name
+            else:
+                return None
+            if kind == "numeric":
+                m["ci"] = sum(1 for mm in metas if mm["kind"] == "numeric")
+            metas.append(m)
+
+        def make_consts():
+            return {"fills": np.asarray(
+                [s["fill"] for s in specs if s["kind"] == "numeric"],
+                np.float32)}
+
+        def fn(consts, env, _metas=tuple(metas), _o=out_col):
+            parts = []
+            for m in _metas:
+                kind = m["kind"]
+                if kind == "numeric":
+                    x = env[m["read"]]
+                    parts.append(jnp.where(
+                        jnp.isfinite(x), x,
+                        consts["fills"][m["ci"]])[:, None])
+                elif kind == "vector":
+                    parts.append(env[m["read"]].astype(jnp.float32))
+                elif kind == "string_index":
+                    parts.append(env[m["feed"]]
+                                 .astype(jnp.float32)[:, None])
+                elif kind in ("onehot", "string_onehot"):
+                    codes = env[m["feed"]]
+                    size = m["size"]
+                    oh = (codes[:, None] == jnp.arange(size, dtype=codes.dtype)
+                          ).astype(jnp.float32)
+                    parts.append(oh)
+                else:   # hash counts, already (N, m) f32
+                    parts.append(env[m["feed"]])
+            return {_o: jnp.concatenate(parts, axis=1)}
+
+        return FZ.DeviceOp(
+            self, reads=reads, writes=[out_col], fn=fn,
+            make_consts=make_consts, feeds=feeds,
+            out_fields={out_col: Field(out_col, VECTOR)})
 
     def transform(self, table: DataTable) -> DataTable:
         # all parts float32: device stages consume f32/bf16 anyway, and a

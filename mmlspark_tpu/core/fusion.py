@@ -1,0 +1,847 @@
+"""Whole-pipeline fusion: compile a fitted pipeline's device-capable
+stage runs into single XLA programs with device-resident tables.
+
+``PipelineModel.transform`` runs stage-at-a-time: every stage ships its
+inputs to device, reads its outputs back, and materializes a full host
+column between stages — N dispatches, N-1 host round trips (the VERDICT
+hot-path finding this module closes; ROADMAP "whole-pipeline fusion").
+The XLA way (SNIPPETS [1]/[2]) is ONE jitted program whose intermediates
+never leave the device and whose input buffers are donated.
+
+Three layers:
+
+- **DeviceOp** — one stage's computation as data: a pure-JAX function
+  ``fn(consts, env) -> {col: Array}`` over an environment of named
+  device arrays, plus host-side ``Feed`` loaders for inputs that need
+  host work first (string codes, token hashing — the PR 4 columnar
+  kernels run on the host/batcher thread and feed the program directly)
+  and a ``make_consts`` hook for the stage's device-resident constants
+  (weights, imputation fills, forest arrays). Stages advertise fusion
+  support through a duck-typed ``device_op(schema)`` method.
+
+- **FusionPlan** — the compiler: walks the fitted stage list with the
+  schema, groups maximal runs of device-capable stages into
+  ``FusedSegment``s (one jitted function each; intermediate columns
+  flow device-to-device and are never materialized unless live), keeps
+  host-only stages (string featurization, image decode, UDFs) between
+  segments, and runs the shared column-liveness pass so dead
+  intermediates are pruned from the host tables too.
+
+- **DeviceTable** — the device-resident cache: table columns and
+  derived feeds ship ONCE per (table, column) and stay on device across
+  stages and repeated transforms (weakly keyed by the host table);
+  per-stage constants are keyed by ``(stage uid, param epoch)`` so
+  mutating a stage param invalidates exactly that stage's device state.
+
+``FusedPipelineModel`` packages a plan behind the PipelineModel API and
+adds the serving discipline (pow-2 shape buckets, ``warmup()``,
+``jit_cache_misses``) so ``json_scoring_pipeline`` can score raw rows
+end-to-end through the fused program with zero steady-state recompiles
+and at most one device round trip per scored batch.
+
+Numerics contract: fused segments compute in float32 (the device
+boundary dtype). ``transform_staged`` — the same device ops dispatched
+one stage at a time with a host round trip between stages — is
+bit-identical to the fused path (XLA elementwise ops and identically
+shaped dots are deterministic); the legacy host path differs only by
+its float64 numpy arithmetic (predictions agree, probabilities agree to
+f32 rounding). See docs/pipeline_fusion.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import (
+    Any, Callable, Dict, List, Optional, Sequence, Set, Tuple,
+)
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu.core import metrics as MC
+from mmlspark_tpu.core.schema import (
+    Field, Schema, BOOL, F32, F64, I8, I16, I32, I64, TENSOR, VECTOR,
+)
+from mmlspark_tpu.core.table import DataTable
+
+_NUMERIC_TAGS = {F32, F64, I8, I16, I32, I64, BOOL}
+
+# every DeviceOp fn registers its code object here — the static
+# no-host-round-trip check (tools/check_fusion_kernels.py) audits these
+# sources, so kernel code can't silently grow an np.asarray /
+# device_get / block_until_ready host sync
+KERNEL_REGISTRY: Dict[Any, str] = {}
+
+
+def register_kernel(fn: Callable, name: str) -> Callable:
+    KERNEL_REGISTRY[fn.__code__] = name
+    return fn
+
+
+pipeline_histograms = MC.pipeline_histograms
+
+
+# ---------------------------------------------------------------------------
+# column liveness (the pruning pass shared by PipelineModel + the planner)
+# ---------------------------------------------------------------------------
+
+
+def stage_io(stage, schema: Optional[Schema]
+             ) -> Tuple[Optional[Set[str]], Optional[Set[str]], Optional[Set[str]]]:
+    """A stage's declared (reads, writes, removes) column sets; any
+    ``None`` means unknown — the stage must be treated as reading and
+    writing everything (no pruning across it)."""
+    reads_fn = getattr(stage, "reads_columns", None)
+    writes_fn = getattr(stage, "writes_columns", None)
+    removes_fn = getattr(stage, "removes_columns", None)
+    if reads_fn is None or writes_fn is None or removes_fn is None:
+        return None, None, None
+    try:
+        reads = reads_fn(schema)
+        writes = writes_fn(schema)
+        removes = removes_fn(schema)
+    except Exception:  # noqa: BLE001 — undeclarable: stay conservative
+        return None, None, None
+    return (None if reads is None else set(reads),
+            None if writes is None else set(writes),
+            None if removes is None else set(removes))
+
+
+def column_liveness(stages: Sequence[Any], in_schema: Schema,
+                    final_needed: Optional[Set[str]] = None,
+                    ) -> List[Optional[Set[str]]]:
+    """``needed[i]`` = columns that must exist ENTERING stage ``i``
+    (``needed[len(stages)]`` = columns required in the final output);
+    ``None`` = everything (no pruning at that boundary).
+
+    ``final_needed=None`` means the caller keeps the whole final table
+    (``transform``); a set restricts it (``Pipeline.fit`` passes ``{}``
+    — intermediate tables only feed later stages; serving passes the
+    reply column). Unknown stages (no reads/writes declaration, e.g. a
+    Lambda) poison every boundary upstream of themselves to ``None``,
+    and schema propagation is only trusted while every stage seen so
+    far declares itself — a Lambda that invents columns its
+    ``transform_schema`` doesn't mention can never cause a wrong drop."""
+    n = len(stages)
+    schemas: List[Optional[Schema]] = [in_schema]
+    names_valid = [True]
+    cur_schema: Optional[Schema] = in_schema
+    valid = True
+    for stage in stages:
+        r, w, rm = stage_io(stage, cur_schema)
+        if r is None or w is None or rm is None:
+            valid = False
+        if cur_schema is not None:
+            try:
+                cur_schema = stage.transform_schema(cur_schema)
+            except Exception:  # noqa: BLE001 — schema walk is best-effort
+                cur_schema = None
+        if cur_schema is None:
+            valid = False
+        elif valid and w:
+            # the recovery branch below rebuilds needed-sets from these
+            # schemas, so they are only trustworthy while every stage's
+            # declared writes actually appear in its transform_schema
+            # output — an Estimator whose transform_schema is the
+            # identity (e.g. Featurize) would otherwise make its model's
+            # output column invisible and get it wrongly pruned
+            if not set(w) <= set(cur_schema.names):
+                valid = False
+        schemas.append(cur_schema)
+        names_valid.append(valid)
+
+    needed: List[Optional[Set[str]]] = [None] * (n + 1)
+    if final_needed is not None:
+        needed[n] = set(final_needed)
+    elif names_valid[n] and schemas[n] is not None:
+        needed[n] = set(schemas[n].names)
+    cur = needed[n]
+    for i in reversed(range(n)):
+        reads, writes, removes = stage_io(stages[i], schemas[i])
+        if reads is None or writes is None or removes is None:
+            cur = None
+        elif cur is None:
+            if names_valid[i] and schemas[i] is not None:
+                # everything flowing out is needed: pass-through =
+                # (in-names - removes - writes); plus the stage's reads
+                cur = (set(schemas[i].names) - removes - writes) | reads
+            else:
+                cur = None
+        else:
+            cur = (cur - writes) | reads
+        needed[i] = cur
+    return needed
+
+
+def prune_table(table: DataTable,
+                keep: Optional[Set[str]]) -> DataTable:
+    """Drop dead columns (those not in ``keep``); no-op when liveness is
+    unknown or nothing is dead."""
+    if keep is None:
+        return table
+    dead = [c for c in table.column_names if c not in keep]
+    return table.drop(*dead) if dead else table
+
+
+# ---------------------------------------------------------------------------
+# device ops
+# ---------------------------------------------------------------------------
+
+
+class Feed:
+    """One derived host-computed device input of a DeviceOp: ``load``
+    runs on the host (the serving batcher thread) and its array ships
+    to the device under ``name`` in the op environment. This is how
+    host-only work (string codes, token hashing — the PR 4 columnar
+    kernels) feeds the fused program directly."""
+
+    __slots__ = ("name", "load")
+
+    def __init__(self, name: str, load: Callable[[DataTable], np.ndarray]):
+        self.name = name
+        self.load = load
+
+
+class DeviceOp:
+    """One stage's device computation.
+
+    - ``reads``: environment keys consumed — table column names,
+      satisfied either by an upstream op's writes (device-resident) or
+      by shipping the host column through the standard f32 loader.
+    - ``feeds``: derived host-computed inputs (see ``Feed``).
+    - ``writes``: environment keys produced.
+    - ``fn(consts, env) -> {name: Array}``: the pure-JAX kernel. It must
+      not touch the host (audited by tools/check_fusion_kernels.py).
+    - ``make_consts()``: host constants (weights, fills, forests) read
+      from the stage AT CALL TIME, device-put once per (uid, epoch) by
+      DeviceTable.
+    - ``out_fields`` / ``out_dtypes``: schema Field and readback dtype
+      per written column, so fused materialization matches the staged
+      host path's column types exactly.
+    """
+
+    __slots__ = ("stage", "name", "reads", "feeds", "writes", "fn",
+                 "make_consts", "out_fields", "out_dtypes")
+
+    def __init__(self, stage, reads: Sequence[str], writes: Sequence[str],
+                 fn: Callable, make_consts: Callable[[], Any],
+                 feeds: Sequence[Feed] = (),
+                 out_fields: Optional[Dict[str, Field]] = None,
+                 out_dtypes: Optional[Dict[str, Any]] = None,
+                 name: Optional[str] = None):
+        self.stage = stage
+        self.name = name or f"{type(stage).__name__}:{stage.uid}"
+        self.reads = tuple(reads)
+        self.feeds = tuple(feeds)
+        self.writes = tuple(writes)
+        self.fn = register_kernel(fn, self.name)
+        self.make_consts = make_consts
+        self.out_fields = dict(out_fields or {})
+        self.out_dtypes = dict(out_dtypes or {})
+
+
+def load_column_f32(table: DataTable, name: str) -> np.ndarray:
+    """The standard host->device loader: numeric/vector column as a
+    dense float32 array (the same cast the staged host kernels apply,
+    so fused and staged consume identical bits)."""
+    col = table.column(name)
+    from mmlspark_tpu.core.sparse import CSRMatrix
+    if isinstance(col, CSRMatrix):
+        raise TypeError(f"column {name!r} is sparse; not device-loadable")
+    if isinstance(col, np.ndarray):
+        return np.asarray(col, dtype=np.float32)
+    return np.stack([np.asarray(v, dtype=np.float32) for v in col])
+
+
+def fusable_field(field: Optional[Field]) -> bool:
+    """Whether the standard loader can ship this column."""
+    if field is None:
+        return False
+    if field.tag in _NUMERIC_TAGS:
+        return True
+    if field.tag == VECTOR and not field.meta.get("sparse"):
+        return True
+    return False
+
+
+def stage_device_op(stage, schema: Schema) -> Optional[DeviceOp]:
+    """A stage's DeviceOp, or None when it must run on the host."""
+    hook = getattr(stage, "device_op", None)
+    if hook is None:
+        return None
+    try:
+        return hook(schema)
+    except Exception:  # noqa: BLE001 — unfusable configs fall back host
+        return None
+
+
+def stage_epoch(stage) -> int:
+    """The stage's param-mutation epoch (bumped by ``set``/``clear``);
+    the DeviceTable consts key and the plan-cache key both include it,
+    so a mutated stage recompiles its consts/plan and nothing else."""
+    return int(getattr(stage, "_param_epoch", 0))
+
+
+# ---------------------------------------------------------------------------
+# DeviceTable — device-resident columns + per-stage consts
+# ---------------------------------------------------------------------------
+
+
+class DeviceTable:
+    """Device-resident cache with two keyed stores:
+
+    - **columns/feeds**: weakly keyed by the host DataTable; each
+      (table, key) ships exactly once, so repeated transforms of the
+      same table (CV folds, chained fused pipelines) pay one H2D per
+      column total. DataTables are immutable, making identity a sound
+      cache key; dropping the table frees the device buffers.
+    - **consts**: keyed by ``(stage uid, param epoch)`` — a stage
+      mutation (new weights, changed fill) invalidates exactly that
+      stage's device constants, nothing else. The previous epoch's
+      entry is evicted eagerly so swapped-out weights don't pin HBM.
+    """
+
+    def __init__(self):
+        self._tables: "weakref.WeakKeyDictionary[DataTable, Dict]" = \
+            weakref.WeakKeyDictionary()
+        self._consts: Dict[str, Tuple[int, Any]] = {}
+        self._lock = threading.Lock()
+        self.column_ships = 0     # H2D transfers actually paid
+        self.column_hits = 0      # cache hits (no reship)
+        self.const_ships = 0
+
+    def column(self, table: DataTable, key: str,
+               load: Callable[[DataTable], np.ndarray]) -> jnp.ndarray:
+        with self._lock:
+            per = self._tables.get(table)
+            if per is None:
+                per = {}
+                self._tables[table] = per
+            arr = per.get(key)
+            if arr is not None:
+                self.column_hits += 1
+                return arr
+        host = load(table)
+        dev = jax.device_put(host)
+        with self._lock:
+            per[key] = dev
+            self.column_ships += 1
+        return dev
+
+    def consts(self, op: DeviceOp) -> Any:
+        uid = op.stage.uid
+        epoch = stage_epoch(op.stage)
+        key = f"{uid}:{op.name}"
+        with self._lock:
+            hit = self._consts.get(key)
+            if hit is not None and hit[0] == epoch:
+                return hit[1]
+        dev = jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.asarray(a)), op.make_consts())
+        with self._lock:
+            self._consts[key] = (epoch, dev)   # evicts the stale epoch
+            self.const_ships += 1
+        return dev
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"column_ships": self.column_ships,
+                    "column_hits": self.column_hits,
+                    "const_ships": self.const_ships,
+                    "tables_cached": len(self._tables),
+                    "consts_cached": len(self._consts)}
+
+
+# ---------------------------------------------------------------------------
+# fused segments
+# ---------------------------------------------------------------------------
+
+
+def _donatable() -> bool:
+    # CPU's donation support is backend-version dependent and only
+    # warns there; donate where it pays (the TPUModel discipline)
+    return jax.default_backend() not in ("cpu",)
+
+
+class FusedSegment:
+    """A maximal run of device ops compiled as one jitted program.
+
+    ``external_reads`` ship from the host table; everything an op reads
+    that an earlier op in the run wrote flows device-to-device inside
+    the one program (XLA owns the intermediate buffers — they are never
+    materialized). ``writes_live`` is the subset of writes anything
+    outside the segment still needs; only those return from the program
+    and only those are fetched (ONE D2H round trip per segment).
+    """
+
+    def __init__(self, ops: List[DeviceOp], writes_live: List[str]):
+        self.ops = list(ops)
+        all_writes: Set[str] = set()
+        ext: List[str] = []
+        for op in self.ops:
+            for r in op.reads:
+                if r not in all_writes and r not in ext:
+                    ext.append(r)
+            all_writes.update(op.writes)
+        self.external_reads = tuple(ext)
+        self.feeds = tuple(f for op in self.ops for f in op.feeds)
+        self.writes_live = tuple(w for w in writes_live
+                                 if w in all_writes)
+        self.name = "+".join(type(op.stage).__name__ for op in self.ops)
+        self._jitted: Dict[bool, Callable] = {}
+        self._op_jitted: Dict[int, Callable] = {}
+        self._lock = threading.Lock()
+        self.trace_count = 0      # one per XLA compile of the fused fn
+
+    # -- program construction ----------------------------------------------
+
+    def _make_fn(self, count_traces: bool) -> Callable:
+        ops = self.ops
+        writes_live = self.writes_live
+        seg = self
+
+        def run(consts: List[Any], env: Dict[str, jnp.ndarray]):
+            if count_traces:
+                # trace-time side effect: once per XLA compile — the
+                # zero-steady-state-recompile guard (TPUModel contract)
+                with seg._lock:
+                    seg.trace_count += 1
+            e = dict(env)
+            for op, c in zip(ops, consts):
+                e.update(op.fn(c, e))
+            return {k: e[k] for k in writes_live}
+
+        return run
+
+    def compiled(self, donate: bool) -> Callable:
+        donate = donate and _donatable()
+        fn = self._jitted.get(donate)
+        if fn is None:
+            with self._lock:
+                fn = self._jitted.get(donate)
+                if fn is None:
+                    # creation under the lock: two racing first calls
+                    # must share ONE jit wrapper or the trace counter
+                    # would double-count their compiles (tracing itself
+                    # happens later, at call time, outside this lock)
+                    fn = jax.jit(self._make_fn(count_traces=True),
+                                 donate_argnums=(1,) if donate else ())
+                    self._jitted[donate] = fn
+        return fn
+
+    def op_compiled(self, i: int) -> Callable:
+        """Per-op jit — the stage-at-a-time baseline (one dispatch per
+        stage, host round trip between stages). Not trace-counted: the
+        serving recompile guard watches the fused path only."""
+        fn = self._op_jitted.get(i)
+        if fn is None:
+            with self._lock:
+                fn = self._op_jitted.get(i)
+                if fn is None:
+                    op = self.ops[i]
+
+                    def run(consts, env, _op=op):
+                        return dict(_op.fn(consts, env))
+
+                    fn = jax.jit(run)
+                    self._op_jitted[i] = fn
+        return fn
+
+    # -- execution -----------------------------------------------------------
+
+    def build_env(self, table: DataTable, device_table: DeviceTable,
+                  ) -> Dict[str, jnp.ndarray]:
+        """Ship the segment's external inputs: cached table columns +
+        derived feeds (host kernels) — the H2D half of the round trip.
+        Plain column casts/puts land under the ``ship`` phase; the Feed
+        kernels (string codes, token hashing) under ``prepare``."""
+        hists = pipeline_histograms()
+        env: Dict[str, jnp.ndarray] = {}
+        t0 = time.perf_counter()
+        for col in self.external_reads:
+            env[col] = device_table.column(table, col,
+                                           lambda t, c=col:
+                                           load_column_f32(t, c))
+        t1 = time.perf_counter()
+        hists["ship"].observe((t1 - t0) * 1e3)
+        for feed in self.feeds:
+            env[feed.name] = device_table.column(
+                table, f"feed:{feed.name}", feed.load)
+        hists["prepare"].observe(
+            (time.perf_counter() - t1) * 1e3)
+        return env
+
+    def consts_list(self, device_table: DeviceTable) -> List[Any]:
+        return [device_table.consts(op) for op in self.ops]
+
+    def out_field(self, col: str, value: np.ndarray) -> Field:
+        for op in self.ops:
+            if col in op.out_fields:
+                return op.out_fields[col]
+        # inference mirrors TPUModel.transform's readback tagging
+        tag = VECTOR if value.ndim == 2 else \
+            TENSOR if value.ndim > 2 else F32
+        return Field(col, tag)
+
+    def out_cast(self, col: str):
+        for op in self.ops:
+            if col in op.out_dtypes:
+                return op.out_dtypes[col]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the plan
+# ---------------------------------------------------------------------------
+
+
+class _HostStep:
+    __slots__ = ("stage",)
+
+    def __init__(self, stage):
+        self.stage = stage
+
+
+class FusionPlan:
+    """The compiled execution plan for one (stage list, input schema):
+    an alternating sequence of host steps and fused segments, plus the
+    per-boundary liveness sets used to prune dead host columns."""
+
+    def __init__(self, stages: Sequence[Any], in_schema: Schema,
+                 final_needed: Optional[Set[str]] = None):
+        self.stages = list(stages)
+        self.in_schema = in_schema
+        self.final_needed = (set(final_needed)
+                             if final_needed is not None else None)
+        self.needed = column_liveness(self.stages, in_schema, final_needed)
+        self.steps: List[Any] = []          # _HostStep | FusedSegment
+        self.step_boundaries: List[int] = []  # stage index AFTER each step
+        self.device_table = DeviceTable()
+        self.last_roundtrips = 0            # D2H fetches of the last run
+        self._build()
+
+    # -- planning ------------------------------------------------------------
+
+    def _build(self) -> None:
+        schema: Optional[Schema] = self.in_schema
+        run: List[Tuple[int, DeviceOp]] = []
+
+        def flush(end_idx: int) -> None:
+            if not run:
+                return
+            ops = [op for _, op in run]
+            live = self._live_writes(run, end_idx)
+            self.steps.append(FusedSegment(ops, live))
+            self.step_boundaries.append(end_idx)
+            run.clear()
+
+        for i, stage in enumerate(self.stages):
+            op = stage_device_op(stage, schema) if schema is not None \
+                else None
+            if op is not None and self._reads_satisfiable(op, schema, run):
+                run.append((i, op))
+            else:
+                flush(i)
+                self.steps.append(_HostStep(stage))
+                self.step_boundaries.append(i + 1)
+            if schema is not None:
+                try:
+                    schema = stage.transform_schema(schema)
+                except Exception:  # noqa: BLE001
+                    schema = None
+        flush(len(self.stages))
+
+    def _reads_satisfiable(self, op: DeviceOp, schema: Schema,
+                           run: List[Tuple[int, DeviceOp]]) -> bool:
+        written = {w for _, prev in run for w in prev.writes}
+        for r in op.reads:
+            if r in written:
+                continue
+            if not fusable_field(schema.get(r)):
+                return False
+        return True
+
+    def _live_writes(self, run: List[Tuple[int, DeviceOp]],
+                     end_idx: int) -> List[str]:
+        """Writes of a fused run that anything AFTER the run still
+        needs (later host stages / segments, or the final output) —
+        everything else stays an XLA intermediate and is never
+        fetched."""
+        needed_after = self.needed[end_idx] if end_idx < len(self.needed) \
+            else None
+        writes: List[str] = []
+        for _, op in run:
+            writes.extend(op.writes)
+        if needed_after is None:
+            return writes
+        return [w for w in writes if w in needed_after]
+
+    @property
+    def segments(self) -> List[FusedSegment]:
+        return [s for s in self.steps if isinstance(s, FusedSegment)]
+
+    def describe(self) -> str:
+        """Compact plan string (trace/span annotation)."""
+        bits = []
+        for step in self.steps:
+            if isinstance(step, FusedSegment):
+                bits.append(f"[{step.name}]")
+            else:
+                bits.append(type(step.stage).__name__)
+        return " -> ".join(bits)
+
+    @property
+    def jit_cache_misses(self) -> int:
+        return sum(s.trace_count for s in self.segments)
+
+    # -- execution -----------------------------------------------------------
+
+    def _materialize(self, table: DataTable, segment: FusedSegment,
+                     out: Dict[str, jnp.ndarray]) -> DataTable:
+        hists = pipeline_histograms()
+        t0 = time.perf_counter()
+        for col in segment.writes_live:
+            val = np.asarray(out[col])
+            cast = segment.out_cast(col)
+            if cast is not None:
+                val = val.astype(cast)
+            table = table.with_column(col, val,
+                                      segment.out_field(col, val))
+        self.last_roundtrips += 1
+        hists["fetch"].observe((time.perf_counter() - t0) * 1e3)
+        return table
+
+    def execute(self, table: DataTable, staged: bool = False) -> DataTable:
+        """Run the plan. ``staged=False`` — fused: one dispatch + one
+        fetch per segment. ``staged=True`` — the stage-at-a-time
+        baseline: every op dispatches alone and materializes ALL its
+        writes to host before the next op ships them back (bit-identical
+        outputs, N round trips — what fusion deletes)."""
+        from mmlspark_tpu.core.trace import get_tracer
+        hists = pipeline_histograms()
+        tracer = get_tracer()
+        self.last_roundtrips = 0
+        cur = table
+        for step, end_idx in zip(self.steps, self.step_boundaries):
+            t0 = time.perf_counter()
+            if isinstance(step, _HostStep):
+                cur = step.stage.transform(cur)
+                hists["host_stage"].observe(
+                    (time.perf_counter() - t0) * 1e3)
+            elif staged:
+                cur = self._execute_segment_staged(cur, step)
+            else:
+                env = step.build_env(cur, self.device_table)
+                consts = step.consts_list(self.device_table)
+                t1 = time.perf_counter()
+                out = step.compiled(donate=False)(consts, env)
+                cur = self._materialize(cur, step, out)
+                hists["device"].observe(
+                    (time.perf_counter() - t1) * 1e3)
+                if tracer.enabled:
+                    tracer.emit("pipeline.fused_segment", t1,
+                                attrs={"segment": step.name,
+                                       "rows": len(cur),
+                                       "outputs": len(step.writes_live)})
+            cur = prune_table(cur, self.needed[end_idx]
+                              if end_idx < len(self.needed) else None)
+        return cur
+
+    def _execute_segment_staged(self, table: DataTable,
+                                segment: FusedSegment) -> DataTable:
+        """One op at a time with a FULL host round trip between ops —
+        the measured baseline for the fusion speedup claim."""
+        for i, op in enumerate(segment.ops):
+            env: Dict[str, jnp.ndarray] = {}
+            for r in op.reads:
+                env[r] = jnp.asarray(load_column_f32(table, r))
+            for feed in op.feeds:
+                env[feed.name] = jnp.asarray(feed.load(table))
+            consts = self.device_table.consts(op)
+            out = segment.op_compiled(i)(consts, env)
+            self.last_roundtrips += 1    # one D2H per op — the point
+            for col in op.writes:
+                val = np.asarray(out[col])
+                cast = op.out_dtypes.get(col)
+                if cast is not None:
+                    val = val.astype(cast)
+                field = op.out_fields.get(col)
+                if field is None:
+                    field = segment.out_field(col, val)
+                table = table.with_column(col, val, field)
+        return table
+
+
+# ---------------------------------------------------------------------------
+# FusedPipelineModel
+# ---------------------------------------------------------------------------
+
+# smallest serving bucket (shared discipline with models/tpu_model.py;
+# duplicated constant to avoid importing the model layer from core)
+MIN_BUCKET = 8
+
+
+class FusedPipelineModel:
+    """A fitted pipeline compiled for fused execution.
+
+    Not a registered PipelineStage: it wraps a fitted ``PipelineModel``
+    (or stage list) and exposes the same ``transform`` surface plus the
+    serving discipline — ``warmup()``/``bucket_sizes``/``bucket_for``/
+    ``jit_cache_misses``/``metrics()``. Persistence goes through the
+    wrapped PipelineModel (``.pipeline.save``); re-fuse after load.
+    """
+
+    def __init__(self, stages: Sequence[Any],
+                 batch_size: int = 256):
+        self.stages = list(stages)
+        self.batch_size = int(batch_size)
+        self._plans: Dict[Tuple, FusionPlan] = {}
+        self._plan_lock = threading.Lock()
+        # trace counts of evicted (stale-epoch) plans: folded into
+        # jit_cache_misses so the counter stays MONOTONIC — a stage
+        # mutation that rebuilds plans must not subtract the old plans'
+        # compiles, or before/after delta checks (the serving recompile
+        # guard) would read zero across a full recompile
+        self._retired_traces = 0
+
+    @staticmethod
+    def _schema_sig(schema: Schema) -> Tuple:
+        # numeric tags collapse to one bucket: i64-vs-f64 raw columns
+        # load identically (standard f32 loader), so they must not key
+        # distinct plans (serving JSON ints/floats would churn plans)
+        return tuple((f.name, "num" if f.tag in _NUMERIC_TAGS else f.tag,
+                      bool(f.meta.get("sparse"))) for f in schema)
+
+    def _plan_key(self, schema: Schema,
+                  final_needed: Optional[Set[str]]) -> Tuple:
+        return (self._schema_sig(schema),
+                None if final_needed is None else frozenset(final_needed),
+                tuple((s.uid, stage_epoch(s)) for s in self.stages))
+
+    def plan_for(self, schema: Schema,
+                 final_needed: Optional[Set[str]] = None) -> FusionPlan:
+        key = self._plan_key(schema, final_needed)
+        plan = self._plans.get(key)
+        if plan is None:
+            with self._plan_lock:
+                plan = self._plans.get(key)
+                if plan is None:
+                    plan = FusionPlan(self.stages, schema, final_needed)
+                    # param-epoch bumps leave stale keys behind; drop
+                    # them so swapped-out weights don't pin device
+                    # state — but retire their trace counts first
+                    # (jit_cache_misses must never go backwards)
+                    stale = [k for k in self._plans if k[2] != key[2]]
+                    for k in stale:
+                        old = self._plans.pop(k, None)
+                        if old is not None:
+                            self._retired_traces += old.jit_cache_misses
+                    self._plans[key] = plan
+        return plan
+
+    # -- PipelineModel surface ----------------------------------------------
+
+    def get_stages(self) -> List[Any]:
+        return list(self.stages)
+
+    @property
+    def pipeline(self):
+        from mmlspark_tpu.core.stage import PipelineModel
+        return PipelineModel(stages=self.stages)
+
+    def transform(self, table: DataTable) -> DataTable:
+        return self.plan_for(table.schema).execute(table)
+
+    def transform_staged(self, table: DataTable) -> DataTable:
+        """The stage-at-a-time baseline over the SAME device kernels
+        (one dispatch + host round trip per stage) — bit-identical to
+        ``transform``; what the fused speedup is measured against."""
+        return self.plan_for(table.schema).execute(table, staged=True)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        for stage in self.stages:
+            schema = stage.transform_schema(schema)
+        return schema
+
+    def __call__(self, table: DataTable) -> DataTable:
+        return self.transform(table)
+
+    # -- serving discipline ---------------------------------------------------
+
+    def bucket_sizes(self) -> List[int]:
+        cap = self.batch_size
+        sizes: List[int] = []
+        b = MIN_BUCKET
+        while b < cap:
+            sizes.append(b)
+            b *= 2
+        sizes.append(cap)
+        return sizes
+
+    def bucket_for(self, rows: int) -> int:
+        b = MIN_BUCKET
+        while b < rows:
+            b *= 2
+        return min(b, self.batch_size)
+
+    @property
+    def jit_cache_misses(self) -> int:
+        return self._retired_traces + sum(
+            p.jit_cache_misses for p in self._plans.values())
+
+    def jit_cache_miss_count(self) -> int:
+        return self.jit_cache_misses
+
+    def warmup(self, example, sizes: Optional[List[int]] = None) -> int:
+        """Pre-compile every serving bucket's fused programs (tile the
+        example rows up to each bucket and transform) — the lifecycle
+        swap protocol's off-hot-path compile hook. Returns compiles
+        triggered (0 = already warm)."""
+        table = example if isinstance(example, DataTable) \
+            else DataTable(dict(example))
+        if len(table) == 0:
+            raise ValueError("warmup needs at least one example row")
+        before = self.jit_cache_misses
+        for b in (sizes or self.bucket_sizes()):
+            idx = np.resize(np.arange(len(table)), b)
+            self.transform(table._take_indices(idx))
+        return self.jit_cache_misses - before
+
+    def metrics(self) -> Dict[str, Any]:
+        plans = list(self._plans.values())
+        out: Dict[str, Any] = {
+            "jit_cache_misses": self.jit_cache_misses,
+            "plans": len(plans),
+        }
+        if plans:
+            # aggregate DeviceTable stats across plans (batch + serving
+            # plans both count; under traffic the serving plan's
+            # ship/hit counters are the interesting ones)
+            agg: Dict[str, int] = {}
+            for p in plans:
+                for k, v in p.device_table.stats().items():
+                    agg[k] = agg.get(k, 0) + int(v)
+            out["device_table"] = agg
+            out["fusion_plan"] = plans[0].describe()
+        return out
+
+    def describe(self) -> str:
+        for p in self._plans.values():
+            return p.describe()
+        return "(unplanned)"
+
+
+def fuse(pipeline, batch_size: int = 256) -> FusedPipelineModel:
+    """Compile a fitted PipelineModel (or plain stage list / single
+    fitted model) for fused execution."""
+    stages = pipeline
+    get_stages = getattr(pipeline, "get_stages", None)
+    if callable(get_stages):
+        stages = get_stages()
+    elif not isinstance(pipeline, (list, tuple)):
+        stages = [pipeline]
+    return FusedPipelineModel(stages, batch_size=batch_size)

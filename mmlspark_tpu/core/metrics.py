@@ -230,6 +230,27 @@ def automl_histograms() -> Dict[str, LatencyHistogram]:
 
 
 # ---------------------------------------------------------------------------
+# fused-pipeline phase histograms
+# ---------------------------------------------------------------------------
+
+# per-phase wall milliseconds across fused pipeline executions
+# (core/fusion.py): host_stage (unfused stages run on host), prepare
+# (host feed kernels — string codes / token hashing on the batcher
+# thread), ship (H2D of external reads + consts), device (fused-segment
+# dispatch -> output ready), fetch (D2H materialization of live
+# outputs — exactly one per segment). Exporters read them like the
+# GBDT/AutoML families above.
+PIPELINE_PHASES = ("host_stage", "prepare", "ship", "device", "fetch")
+_PIPELINE_HISTS: Dict[str, LatencyHistogram] = histogram_set(
+    *PIPELINE_PHASES)
+
+
+def pipeline_histograms() -> Dict[str, LatencyHistogram]:
+    """The process-wide fused-pipeline phase histogram family."""
+    return _PIPELINE_HISTS
+
+
+# ---------------------------------------------------------------------------
 # feature-drift counters (serving-time vs fit-time statistics)
 # ---------------------------------------------------------------------------
 

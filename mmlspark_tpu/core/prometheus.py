@@ -143,6 +143,10 @@ def process_families(r: PromRenderer, tracer: Any = None) -> None:
         r.histogram("automl_phase_ms",
                     "AutoML hot-path per-phase wall milliseconds",
                     hist, {"phase": phase})
+    for phase, hist in MC.pipeline_histograms().items():
+        r.histogram("pipeline_fusion_phase_ms",
+                    "fused-pipeline per-phase wall milliseconds "
+                    "(core/fusion.py)", hist, {"phase": phase})
     if tracer is None:
         from mmlspark_tpu.core.trace import get_tracer
         tracer = get_tracer()

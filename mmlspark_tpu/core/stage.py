@@ -81,6 +81,9 @@ class PipelineStage:
         if isinstance(param, str):
             param = self.param(param)
         self._paramMap[param.name] = param.validate(value)
+        # param-mutation epoch: keyed invalidation for derived device
+        # state (fusion plans / DeviceTable consts key on (uid, epoch))
+        self._param_epoch = getattr(self, "_param_epoch", 0) + 1
         self._on_param_change(param.name)
         return self
 
@@ -119,6 +122,7 @@ class PipelineStage:
         if isinstance(param, str):
             param = self.param(param)
         self._paramMap.pop(param.name, None)
+        self._param_epoch = getattr(self, "_param_epoch", 0) + 1
         return self
 
     def copy(self, extra: Optional[Dict[str, Any]] = None) -> "PipelineStage":
@@ -168,6 +172,23 @@ class PipelineStage:
             raise TypeError(
                 f"loaded {type(stage).__name__}, expected {cls.__name__}")
         return stage
+
+    # -- column-flow declaration (core/fusion.py liveness pass) ------------
+    # Stages that know exactly which columns they consume/produce/remove
+    # override these; ``None`` means "unknown" and disables pruning
+    # across the stage (the conservative default — a UDF/Lambda may
+    # touch anything). For Estimators, reads must cover everything
+    # fit() consumes AND the fitted model's transform inputs; writes
+    # are the fitted model's outputs. ``removes`` is always concrete.
+
+    def reads_columns(self, schema: Schema) -> Optional[List[str]]:
+        return None
+
+    def writes_columns(self, schema: Schema) -> Optional[List[str]]:
+        return None
+
+    def removes_columns(self, schema: Schema) -> List[str]:
+        return []
 
     def __repr__(self):
         set_params = ", ".join(
@@ -230,9 +251,17 @@ class Pipeline(Estimator):
         return self.get("stages") or []
 
     def fit(self, table: DataTable) -> "PipelineModel":
+        # column pruning (shared liveness pass with the fusion planner,
+        # core/fusion.py): the intermediate tables threaded through fit
+        # only feed LATER stages — final_needed={} — so a wide hashed
+        # block or raw text column is dropped the moment no remaining
+        # stage reads it, instead of being copied through every
+        # with_column to the end of the pipeline
+        from mmlspark_tpu.core.fusion import column_liveness, prune_table
         fitted: List[Transformer] = []
         cur = table
         stages = self.get_stages()
+        needed = column_liveness(stages, table.schema, final_needed=set())
         for i, stage in enumerate(stages):
             if isinstance(stage, Estimator):
                 model = stage.fit(cur)
@@ -245,6 +274,8 @@ class Pipeline(Estimator):
                     cur = stage.transform(cur)
             else:
                 raise TypeError(f"stage {stage!r} is not Transformer/Estimator")
+            if i < len(stages) - 1:
+                cur = prune_table(cur, needed[i + 1])
         return PipelineModel(stages=fitted)
 
     def transform_schema(self, schema: Schema) -> Schema:
@@ -266,9 +297,41 @@ class PipelineModel(Model):
         return self.get("stages") or []
 
     def transform(self, table: DataTable) -> DataTable:
-        for stage in self.get_stages():
+        # stage-at-a-time host execution with dead-column pruning: an
+        # intermediate column nothing downstream reads (because a later
+        # stage drops or overwrites it) is dropped as soon as its last
+        # reader ran, so it stops riding through every subsequent
+        # with_column copy. Output is IDENTICAL — only columns that
+        # could never reach the final table are pruned. For fused
+        # device execution of the same stages, see ``fused()``.
+        from mmlspark_tpu.core.fusion import column_liveness, prune_table
+        stages = self.get_stages()
+        # single-entry liveness cache: the walk is constant for a fixed
+        # (schema, stage epochs) pair, and per-batch callers (serving
+        # micro-batches, CV folds) transform the same shape repeatedly
+        key = (tuple((f.name, f.tag) for f in table.schema),
+               tuple((s.uid, getattr(s, "_param_epoch", 0))
+                     for s in stages))
+        cached = getattr(self, "_liveness_cache", None)
+        if cached is not None and cached[0] == key:
+            needed = cached[1]
+        else:
+            needed = column_liveness(stages, table.schema)
+            self._liveness_cache = (key, needed)
+        for i, stage in enumerate(stages):
             table = stage.transform(table)
+            if i < len(stages) - 1:
+                table = prune_table(table, needed[i + 1])
         return table
+
+    def fused(self, batch_size: int = 256):
+        """Compile this fitted pipeline for fused execution: maximal
+        runs of device-capable stages become single jitted XLA programs
+        with device-resident constants (see core/fusion.py). Returns a
+        ``FusedPipelineModel`` exposing the same ``transform`` plus the
+        serving warmup/bucket discipline."""
+        from mmlspark_tpu.core.fusion import FusedPipelineModel
+        return FusedPipelineModel(self.get_stages(), batch_size=batch_size)
 
     def transform_schema(self, schema: Schema) -> Schema:
         for stage in self.get_stages():

@@ -215,6 +215,78 @@ class TPUBoostClassificationModel(Model, HasFeaturesCol, HasPredictionCol):
             self._booster = Booster.from_string(self.get("modelString"))
         return self._booster
 
+    def reads_columns(self, schema):
+        return [self.get_features_col()]
+
+    def writes_columns(self, schema):
+        return [self.get("rawPredictionCol"), self.get("probabilityCol"),
+                self.get_prediction_col()]
+
+    def device_op(self, schema):
+        """Fusion hook (core/fusion.py): binned features -> forest
+        traversal as one device op — the jitted fixed-depth pointer walk
+        (``tree.predict_trees``) plus the objective transform, with the
+        stacked forest arrays as device-resident consts. Forests whose
+        thresholds need f64 routing score on host (the
+        ``_needs_f64_inference`` discipline) and CSR features fall back
+        to the host path."""
+        from mmlspark_tpu.core import fusion as FZ
+        from mmlspark_tpu.gbdt.tree import predict_trees
+        import jax.numpy as jnp
+        try:
+            booster = self.get_booster()
+        except Exception:  # noqa: BLE001 — unparseable model: host path
+            return None
+        if booster.num_trees == 0 or booster._needs_f64_inference():
+            return None
+        feat = self.get_features_col()
+        K = booster.num_class
+        it = booster._resolve_iterations(None)
+        t_limit = it * K
+        if t_limit <= 0:
+            return None
+        max_depth = booster._max_depth(t_limit)
+        obj = booster.objective
+        raw_col = self.get("rawPredictionCol")
+        prob_col = self.get("probabilityCol")
+        pred_col = self.get_prediction_col()
+
+        def make_consts():
+            b = self.get_booster()
+            return {
+                "trees": {k: np.asarray(b.trees[k][:t_limit])
+                          for k in ("feature", "threshold", "left",
+                                    "right", "value")},
+                "init": np.asarray(b.init_score, np.float32)}
+
+        def fn(consts, env, _f=feat, _it=it, _K=K, _depth=max_depth):
+            X = env[_f]
+            tr = consts["trees"]
+            out = predict_trees(X, tr["feature"], tr["threshold"],
+                                tr["left"], tr["right"], tr["value"],
+                                max_depth=_depth)
+            raw = out.reshape(_it, _K, X.shape[0]).sum(axis=0) \
+                + consts["init"][:, None]
+            prob = obj.transform(raw)
+            if _K == 1:
+                raw2 = jnp.stack([-raw[0], raw[0]], axis=1)
+                prob2 = jnp.stack([1.0 - prob[0], prob[0]], axis=1)
+            else:
+                raw2 = raw.T
+                prob2 = prob.T
+            pred = jnp.argmax(prob2, axis=1).astype(jnp.float32)
+            return {raw_col: raw2, prob_col: prob2, pred_col: pred}
+
+        # raw/probability stay float32 like the host path's readback;
+        # only the prediction column widens to f64 (legacy dtype)
+        return FZ.DeviceOp(
+            self, reads=[feat], writes=[raw_col, prob_col, pred_col],
+            fn=fn, make_consts=make_consts,
+            out_fields={raw_col: Field(raw_col, VECTOR),
+                        prob_col: Field(prob_col, VECTOR),
+                        pred_col: Field(pred_col, F64)},
+            out_dtypes={pred_col: np.float64})
+
     def transform(self, table: DataTable) -> DataTable:
         import jax.numpy as jnp
         X = self._features_matrix(table)
@@ -298,6 +370,55 @@ class TPUBoostRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
         return self._booster
 
     _features_matrix = _BoostParams._features_matrix
+
+    def reads_columns(self, schema):
+        return [self.get_features_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_prediction_col()]
+
+    def device_op(self, schema):
+        """Fusion hook: forest walk + objective transform on device
+        (see TPUBoostClassificationModel.device_op)."""
+        from mmlspark_tpu.core import fusion as FZ
+        from mmlspark_tpu.gbdt.tree import predict_trees
+        try:
+            booster = self.get_booster()
+        except Exception:  # noqa: BLE001
+            return None
+        if booster.num_trees == 0 or booster._needs_f64_inference():
+            return None
+        feat = self.get_features_col()
+        it = booster._resolve_iterations(None)
+        if it <= 0:
+            return None
+        max_depth = booster._max_depth(it)
+        obj = booster.objective
+        pred_col = self.get_prediction_col()
+
+        def make_consts():
+            b = self.get_booster()
+            return {
+                "trees": {k: np.asarray(b.trees[k][:it])
+                          for k in ("feature", "threshold", "left",
+                                    "right", "value")},
+                "init": np.asarray(b.init_score, np.float32)}
+
+        def fn(consts, env, _f=feat, _it=it, _depth=max_depth):
+            X = env[_f]
+            tr = consts["trees"]
+            out = predict_trees(X, tr["feature"], tr["threshold"],
+                                tr["left"], tr["right"], tr["value"],
+                                max_depth=_depth)
+            raw = out.reshape(_it, 1, X.shape[0]).sum(axis=0)[0] \
+                + consts["init"][0]
+            return {pred_col: obj.transform(raw)}
+
+        return FZ.DeviceOp(
+            self, reads=[feat], writes=[pred_col], fn=fn,
+            make_consts=make_consts,
+            out_fields={pred_col: Field(pred_col, F64)},
+            out_dtypes={pred_col: np.float64})
 
     def transform(self, table: DataTable) -> DataTable:
         X = self._features_matrix(table)

@@ -216,6 +216,13 @@ class TPULogisticRegression(Estimator, HasFeaturesCol, HasLabelCol,
                           "standardized first; sparse are not — see "
                           "class docstring)", default=0.5)
 
+    def reads_columns(self, schema):
+        return [self.get_features_col(), self.get_label_col()]
+
+    def writes_columns(self, schema):
+        return ["rawPrediction", "probability",
+                self.get_prediction_col()]
+
     def fit(self, table: DataTable) -> "TPULogisticRegressionModel":
         from mmlspark_tpu.core.sparse import CSRMatrix
         y = np.asarray(table[self.get_label_col()], dtype=np.float64)
@@ -310,6 +317,61 @@ class TPULogisticRegression(Estimator, HasFeaturesCol, HasLabelCol,
 class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("W/b/mu/sd arrays", default=None)
 
+    def reads_columns(self, schema):
+        return [self.get_features_col()]
+
+    def writes_columns(self, schema):
+        return ["rawPrediction", "probability",
+                self.get_prediction_col()]
+
+    def device_op(self, schema):
+        """Fusion hook (core/fusion.py): standardize + logits + softmax
+        + argmax as one pure-f32 device kernel. The host ``transform``
+        computes the same formulas in float64 numpy, so fused
+        predictions match exactly (argmax) and probabilities to f32
+        rounding; ``transform_staged`` (the same kernel dispatched
+        stage-at-a-time) is bit-identical."""
+        from mmlspark_tpu.core import fusion as FZ
+        w = self.get("weights")
+        if w is None or "mu" not in w:
+            return None    # sparse-featured models score on host
+        feat = self.get_features_col()
+        pred_col = self.get_prediction_col()
+        binary = int(np.asarray(w["W"]).shape[1]) == 2
+
+        def make_consts():
+            ww = self.get("weights")
+            return {"W": np.asarray(ww["W"], np.float32),
+                    "b": np.asarray(ww["b"], np.float32),
+                    "mu": np.asarray(ww["mu"], np.float32),
+                    "sd": np.asarray(ww["sd"], np.float32)}
+
+        def fn(consts, env, _f=feat, _p=pred_col, _bin=binary):
+            X = env[_f]
+            Xs = (X - consts["mu"]) / consts["sd"]
+            logits = Xs @ consts["W"] + consts["b"]
+            m = jnp.max(logits, axis=1, keepdims=True)
+            e = jnp.exp(logits - m)
+            prob = e / jnp.sum(e, axis=1, keepdims=True)
+            pred = jnp.argmax(prob, axis=1).astype(jnp.float32)
+            if _bin:
+                raw = jnp.stack([logits[:, 0] - logits[:, 1],
+                                 logits[:, 1] - logits[:, 0]], axis=1)
+            else:
+                raw = logits
+            return {"rawPrediction": raw, "probability": prob, _p: pred}
+
+        return FZ.DeviceOp(
+            self, reads=[feat],
+            writes=["rawPrediction", "probability", pred_col],
+            fn=fn, make_consts=make_consts,
+            out_fields={"rawPrediction": Field("rawPrediction", VECTOR),
+                        "probability": Field("probability", VECTOR),
+                        pred_col: Field(pred_col, F64)},
+            out_dtypes={"rawPrediction": np.float64,
+                        "probability": np.float64,
+                        pred_col: np.float64})
+
     def drift_monitor(self):
         """A ``core.metrics.DriftMonitor`` seeded with this model's
         FIT-TIME feature statistics (mu/sd) — hand it to
@@ -374,6 +436,12 @@ class TPULinearRegression(Estimator, HasFeaturesCol, HasLabelCol,
     regParam = FloatParam("L2 regularization", default=1e-4)
     stepSize = FloatParam("learning rate", default=0.1)
 
+    def reads_columns(self, schema):
+        return [self.get_features_col(), self.get_label_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_prediction_col()]
+
     def fit(self, table: DataTable) -> "TPULinearRegressionModel":
         X = _features_matrix(table, self.get_features_col())
         y = np.asarray(table[self.get_label_col()], dtype=np.float64)
@@ -426,6 +494,43 @@ class TPULinearRegression(Estimator, HasFeaturesCol, HasLabelCol,
 
 class TPULinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("w/b/mu/sd arrays", default=None)
+
+    def reads_columns(self, schema):
+        return [self.get_features_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_prediction_col()]
+
+    def device_op(self, schema):
+        """Fusion hook: standardize + dot + un-standardize in f32 (see
+        ``TPULogisticRegressionModel.device_op``)."""
+        from mmlspark_tpu.core import fusion as FZ
+        w = self.get("weights")
+        if w is None:
+            return None
+        feat = self.get_features_col()
+        pred_col = self.get_prediction_col()
+
+        def make_consts():
+            ww = self.get("weights")
+            return {"w": np.asarray(ww["w"], np.float32),
+                    "b": np.asarray(ww["b"], np.float32),
+                    "mu": np.asarray(ww["mu"], np.float32),
+                    "sd": np.asarray(ww["sd"], np.float32),
+                    "y_mu": np.float32(ww["y_mu"]),
+                    "y_sd": np.float32(ww["y_sd"])}
+
+        def fn(consts, env, _f=feat, _p=pred_col):
+            Xs = (env[_f] - consts["mu"]) / consts["sd"]
+            pred = (Xs @ consts["w"] + consts["b"]) * consts["y_sd"] \
+                + consts["y_mu"]
+            return {_p: pred}
+
+        return FZ.DeviceOp(
+            self, reads=[feat], writes=[pred_col], fn=fn,
+            make_consts=make_consts,
+            out_fields={pred_col: Field(pred_col, F64)},
+            out_dtypes={pred_col: np.float64})
 
     def drift_monitor(self):
         """Fit-time feature-stat DriftMonitor (see

@@ -258,6 +258,66 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         out["jit_cache_misses"] = self.jit_cache_misses
         return out
 
+    # -- fusion hook ---------------------------------------------------------
+
+    def reads_columns(self, schema):
+        return list(self._feeds().values())
+
+    def writes_columns(self, schema):
+        return list(self._fetches().keys())
+
+    def device_op(self, schema):
+        """Fusion hook (core/fusion.py): the forward becomes one op in a
+        fused pipeline program — upstream featurization flows into it
+        on-device, its own minibatch/bucket machinery is bypassed (the
+        fused plan owns batching). Integer-token models feed through an
+        i32 Feed so ids never round-trip through float."""
+        from mmlspark_tpu.core import fusion as FZ
+        feeds_map = self._feeds()
+        fetches = self._fetches()
+        model_fn = self.get("modelFn")
+        if model_fn is None:
+            return None
+        bf16 = self.get("computeDtype") == "bfloat16"
+        int_input = bool(getattr(model_fn, "int_input", False))
+        reads: List[str] = []
+        op_feeds: List[Any] = []
+        env_key: Dict[str, str] = {}
+        for model_in, col in feeds_map.items():
+            if int_input:
+                name = f"{self.uid}:{col}:i32"
+                op_feeds.append(FZ.Feed(
+                    name, lambda t, _c=col: _column_to_array(
+                        t[_c], t.schema.get(_c), np.int32)))
+                env_key[model_in] = name
+            else:
+                reads.append(col)
+                env_key[model_in] = col
+
+        def fn(consts, env, _keys=tuple(env_key.items()),
+               _fetch=tuple(fetches.items()), _bf16=bf16,
+               _int=int_input):
+            inputs = {}
+            for model_in, key in _keys:
+                x = env[key]
+                if _bf16 and not _int:
+                    x = x.astype(jnp.bfloat16)
+                inputs[model_in] = x
+            out = model_fn(consts, inputs)
+            if not isinstance(out, dict):
+                out = {"output": out}
+            res = {}
+            for out_col, model_out in _fetch:
+                val = out[model_out]
+                if val.dtype == jnp.bfloat16:
+                    val = val.astype(jnp.float32)
+                res[out_col] = val
+            return res
+
+        return FZ.DeviceOp(
+            self, reads=reads, writes=list(fetches.keys()), fn=fn,
+            make_consts=lambda: self.get("weights"), feeds=op_feeds)
+
     # -- transform ----------------------------------------------------------
 
     def transform(self, table: DataTable) -> DataTable:

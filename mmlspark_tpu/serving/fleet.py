@@ -64,7 +64,8 @@ class ServingUnavailable(RuntimeError):
 
 def json_scoring_pipeline(model, field: str = "features",
                           reply_field: str = "prediction",
-                          drift_monitor=None):
+                          drift_monitor=None, reply_col: str = None,
+                          batch_size: int = 256):
     """The standard model-behind-HTTP pipeline: decode JSON request
     bodies ``{field: [floats]}``, score the micro-batch through
     ``model`` (a TPUModel whose inputCol is ``field``), reply
@@ -72,6 +73,18 @@ def json_scoring_pipeline(model, field: str = "features",
     serving bench, the throughput floor test, and user deployments —
     the serving-side analog of ServingImplicits' request parsing
     (ref: ServingImplicits.scala).
+
+    ``model`` may also be a fitted **PipelineModel** (or an already-
+    compiled ``FusedPipelineModel``): request bodies are then RAW ROW
+    objects ``{col: value, ...}`` — strings and token lists included —
+    and the whole pipeline scores end-to-end through the fused XLA
+    program (core/fusion.py): host featurization kernels run on the
+    batcher thread (``prepare_batch``), the fused device program plus
+    reply build run on a worker (``execute_prepared``), micro-batches
+    pad to the pow-2 shape buckets, and ``warmup``/
+    ``jit_cache_misses``/``bucket_for`` keep the lifecycle swap and
+    tracing contracts identical to the single-model path. See
+    ``_FusedPipelineScorer``.
 
     The returned stage exposes the ServingEngine two-stage split:
     ``prepare_batch`` (JSON decode + stack — pure host work the batcher
@@ -88,7 +101,23 @@ def json_scoring_pipeline(model, field: str = "features",
     the lifecycle swap protocol can pre-compile every serving bucket
     off the hot path."""
     import numpy as np
+    from mmlspark_tpu.core.fusion import FusedPipelineModel
+    from mmlspark_tpu.core.stage import PipelineModel
     from mmlspark_tpu.stages.basic import Lambda
+
+    if isinstance(model, (PipelineModel, FusedPipelineModel)):
+        if drift_monitor is not None:
+            # losing drift detection silently on the pipeline path
+            # would be worse than refusing: the fused plan fetches only
+            # the reply column, so there is no assembled feature matrix
+            # to observe. Attach monitoring to a pipeline stage instead.
+            raise ValueError(
+                "drift_monitor is not supported for pipeline scoring "
+                "(the fused plan never materializes the feature "
+                "matrix); observe drift inside the pipeline instead")
+        return _FusedPipelineScorer(
+            model, reply_field=reply_field, reply_col=reply_col,
+            batch_size=batch_size).stage()
 
     def decode(table: DataTable) -> "np.ndarray":
         return np.stack([
@@ -176,6 +205,243 @@ def json_row_scoring_pipeline(pipeline, reply_col: str = "prediction"):
                       for v in vals])
 
     return Lambda.apply(handle)
+
+
+class _FusedPipelineScorer:
+    """Serve a fitted pipeline end-to-end through its fused XLA program
+    (the pipeline branch of ``json_scoring_pipeline``).
+
+    Request bodies are raw row objects; the two-stage engine split maps
+    onto the fusion plan: ``prepare_batch`` (batcher thread) decodes
+    JSON, runs the plan's host-stage prefix and the first fused
+    segment's host Feed kernels (string codes / token hashing — the
+    PR 4 columnar paths), and edge-pads every feed up to the pow-2
+    shape bucket; ``execute_prepared`` (worker thread) dispatches the
+    fused program with DONATED input buffers and does exactly one D2H
+    fetch of the reply column. The plan is pruned to the reply column
+    (``final_needed``), so nothing else is ever fetched.
+
+    Serving contracts forwarded to the engine: ``warmup`` compiles
+    every bucket's program off the hot path (lifecycle swap),
+    ``jit_cache_miss_count`` is the recompile guard the device spans
+    annotate, ``bucket_for`` labels spans, and ``metrics`` exposes the
+    fusion plan + DeviceTable stats on /healthz."""
+
+    def __init__(self, pipeline, reply_field: str = "prediction",
+                 reply_col: str = None, batch_size: int = 256):
+        import numpy as np
+        from mmlspark_tpu.core.fusion import FusedPipelineModel
+        self.np = np
+        self.fused = pipeline if isinstance(pipeline, FusedPipelineModel) \
+            else pipeline.fused(batch_size=batch_size)
+        self.reply_field = reply_field
+        self.reply_col = reply_col or self._default_reply_col()
+        self._row_names: List[str] = []
+        self._names_lock = threading.Lock()
+        # D2H fetches per scored batch (the "at most one device round
+        # trip" guarantee, asserted by tests): bumped once per fetch
+        self.device_roundtrips = 0
+        self.batches_scored = 0
+
+    def _default_reply_col(self) -> str:
+        for stage in reversed(self.fused.get_stages()):
+            get_pred = getattr(stage, "get_prediction_col", None)
+            if callable(get_pred):
+                return get_pred()
+        return "prediction"
+
+    # -- decode --------------------------------------------------------------
+
+    def _raw_table(self, table: DataTable) -> DataTable:
+        rows = [json.loads(r["entity"].decode())
+                for r in table["request"]]
+        # pinned column ORDER, growing set: first-seen order keeps the
+        # schema signature — and so the compiled fused programs — from
+        # churning with clients' JSON key ordering, while a key the
+        # first batch happened to omit is APPENDED when it first
+        # appears (one replan/compile, never a silently dropped field)
+        with self._names_lock:
+            known = set(self._row_names)
+            for r in rows:
+                for k in r:
+                    if k not in known:
+                        self._row_names.append(k)
+                        known.add(k)
+            names = list(self._row_names)
+        return DataTable({n: [r.get(n) for r in rows] for n in names})
+
+    def _pad(self, arr, bucket: int):
+        n = arr.shape[0]
+        if n >= bucket:
+            return arr
+        # edge-pad with copies of the last row: valid inputs, so
+        # normalization/log paths can't NaN-poison (TPUModel discipline)
+        reps = self.np.concatenate(
+            [arr, self.np.repeat(arr[-1:], bucket - n, axis=0)], axis=0)
+        return reps
+
+    # -- the two-stage split -------------------------------------------------
+
+    def prepare(self, table: DataTable):
+        from mmlspark_tpu.core.fusion import (
+            FusedSegment, load_column_f32, pipeline_histograms,
+        )
+        import time as _time
+        t0 = _time.perf_counter()
+        raw = self._raw_table(table)
+        plan = self.fused.plan_for(raw.schema,
+                                   final_needed={self.reply_col})
+        cur = raw
+        seg_idx = None
+        for i, step in enumerate(plan.steps):
+            if isinstance(step, FusedSegment):
+                seg_idx = i
+                break
+            cur = step.stage.transform(cur)
+        if seg_idx is None:
+            # no device segment anywhere: cur IS the scored table —
+            # execute() must only read the reply out of it
+            return ("host", plan, cur, len(raw))
+        seg = plan.steps[seg_idx]
+        n = len(cur)
+        bucket = self.fused.bucket_for(n)
+        has_tail = seg_idx + 1 < len(plan.steps)
+        if has_tail and n < bucket:
+            # multi-segment/trailing-stage plans: pad the TABLE itself
+            # (edge rows) so every downstream segment sees the bucket
+            # shape too — otherwise each distinct micro-batch size
+            # would retrace the tail segments on the hot path. The
+            # single-tail-segment hot path below pads only the feeds.
+            idx = self.np.concatenate(
+                [self.np.arange(n),
+                 self.np.full(bucket - n, n - 1, dtype=self.np.int64)])
+            cur = cur._take_indices(idx)
+        feeds: Dict[str, Any] = {}
+        for col in seg.external_reads:
+            feeds[col] = self._pad(load_column_f32(cur, col), bucket)
+        for feed in seg.feeds:
+            feeds[feed.name] = self._pad(feed.load(cur), bucket)
+        pipeline_histograms()["prepare"].observe(
+            (_time.perf_counter() - t0) * 1e3)
+        return ("fused", plan, cur, n, seg_idx, feeds)
+
+    def execute(self, table: DataTable, prepped) -> DataTable:
+        import time as _time
+        from mmlspark_tpu.core.fusion import pipeline_histograms
+        if prepped[0] == "host":
+            # prepare() already ran every (host) step — re-executing
+            # the plan would double-transform non-idempotent stages
+            _, plan, cur, n = prepped
+            self.batches_scored += 1
+            return self._reply(table, self.np.asarray(
+                cur[self.reply_col])[:n])
+        _, plan, cur, n, seg_idx, feeds = prepped
+        seg = plan.steps[seg_idx]
+        t0 = _time.perf_counter()
+        consts = seg.consts_list(plan.device_table)
+        # donated feeds: the padded batch is consumed exactly once, XLA
+        # may alias it for activations (accelerator backends)
+        out = seg.compiled(donate=True)(consts, feeds)
+        tail = plan.steps[seg_idx + 1:]
+        if not tail and self.reply_col in out:
+            # the hot path: ONE device round trip — fetch the reply
+            # column, slice the padding off
+            vals = self.np.asarray(out[self.reply_col])[:n]
+            self.device_roundtrips += 1
+            self.batches_scored += 1
+            pipeline_histograms()["device"].observe(
+                (_time.perf_counter() - t0) * 1e3)
+            return self._reply(table, vals)
+        # general tail (multi-segment / trailing host stages): fold the
+        # segment's live outputs back — at FULL bucket length, so the
+        # tail segments keep seeing padded shapes and never retrace per
+        # batch size (prepare() padded `cur` itself for this case) —
+        # and continue the plan; one round trip per remaining segment.
+        # The pad rows slice off at the reply, exactly once.
+        for col in seg.writes_live:
+            # len(cur) == bucket when prepare() padded the table (real
+            # tail), == n when the tail is empty (reply from cur)
+            val = self.np.asarray(out[col])[:len(cur)]
+            cast = seg.out_cast(col)
+            if cast is not None:
+                val = val.astype(cast)
+            cur = cur.with_column(col, val, seg.out_field(col, val))
+        self.device_roundtrips += 1
+        for step in tail:
+            from mmlspark_tpu.core.fusion import FusedSegment
+            if isinstance(step, FusedSegment):
+                env = step.build_env(cur, plan.device_table)
+                out2 = step.compiled(donate=False)(
+                    step.consts_list(plan.device_table), env)
+                cur = plan._materialize(cur, step, out2)
+                self.device_roundtrips += 1
+            else:
+                cur = step.stage.transform(cur)
+        self.batches_scored += 1
+        pipeline_histograms()["device"].observe(
+            (_time.perf_counter() - t0) * 1e3)
+        return self._reply(table,
+                           self.np.asarray(cur[self.reply_col])[:n])
+
+    def _reply(self, table: DataTable, vals) -> DataTable:
+        def jsonify(v):
+            if self.np.ndim(v) >= 1:
+                # vector reply columns (probability / rawPrediction)
+                return [float(x) for x in self.np.asarray(v).ravel()]
+            v = float(v)
+            return int(v) if v.is_integer() else v
+
+        out = [{self.reply_field: jsonify(v)} for v in vals]
+        return table.with_column("reply", out)
+
+    def transform(self, table: DataTable) -> DataTable:
+        """Single-stage fallback (per-row poison retry, embeddings)."""
+        return self.execute(table, self.prepare(table))
+
+    # -- serving hooks -------------------------------------------------------
+
+    def warmup(self, example, sizes: Optional[List[int]] = None) -> int:
+        """Compile every bucket's fused program through the EXACT
+        serving path (prepare/execute with bucket padding + donation),
+        so a lifecycle swap reaches the hot path fully warm."""
+        from mmlspark_tpu.io.http import _jsonable
+        table = example if isinstance(example, DataTable) \
+            else DataTable(dict(example))
+        if len(table) == 0:
+            raise ValueError("warmup needs at least one example row")
+        before = self.fused.jit_cache_misses
+        body = [json.dumps({k: _jsonable(v) for k, v in row.items()}
+                           ).encode() for row in table.rows()]
+        for b in (sizes or self.fused.bucket_sizes()):
+            reqs = [{"entity": body[i % len(body)]} for i in range(b)]
+            req_table = DataTable({"id": [str(i) for i in range(b)],
+                                   "request": reqs})
+            self.execute(req_table, self.prepare(req_table))
+        return self.fused.jit_cache_misses - before
+
+    def jit_cache_miss_count(self) -> int:
+        return self.fused.jit_cache_misses
+
+    def bucket_for(self, rows: int) -> int:
+        return self.fused.bucket_for(rows)
+
+    def metrics(self) -> Dict[str, Any]:
+        return self.fused.metrics()
+
+    def stage(self):
+        """Package as the Lambda stage the ServingEngine consumes, with
+        the duck-typed two-stage split + lifecycle/observability hooks
+        attached (the same contract the TPUModel path exposes)."""
+        from mmlspark_tpu.stages.basic import Lambda
+        lam = Lambda.apply(self.transform)
+        lam.prepare_batch = self.prepare
+        lam.execute_prepared = self.execute
+        lam.warmup = self.warmup
+        lam.metrics = self.metrics
+        lam.jit_cache_miss_count = self.jit_cache_miss_count
+        lam.bucket_for = self.bucket_for
+        lam.scorer = self
+        return lam
 
 
 # engine-reported statuses worth failing over for: overload/shedding

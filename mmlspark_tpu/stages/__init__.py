@@ -8,7 +8,8 @@ from mmlspark_tpu.stages.basic import (
 from mmlspark_tpu.stages.dataprep import (
     CleanMissingData, CleanMissingDataModel, DataConversion, EnsembleByKey,
     FastVectorAssembler, MultiColumnAdapter, MultiColumnAdapterModel,
-    PartitionSample, SummarizeData, ValueIndexer, ValueIndexerModel,
+    PartitionSample, StandardScaler, StandardScalerModel, SummarizeData,
+    ValueIndexer, ValueIndexerModel,
 )
 from mmlspark_tpu.stages.image import (
     ImageSetAugmenter, ImageTransformer, UnrollImage,
@@ -26,7 +27,8 @@ __all__ = [
     "UDFTransformer",
     "CleanMissingData", "CleanMissingDataModel", "DataConversion",
     "EnsembleByKey", "FastVectorAssembler", "MultiColumnAdapter",
-    "MultiColumnAdapterModel", "PartitionSample", "SummarizeData",
+    "MultiColumnAdapterModel", "PartitionSample", "StandardScaler",
+    "StandardScalerModel", "SummarizeData",
     "ValueIndexer", "ValueIndexerModel",
     "ImageSetAugmenter", "ImageTransformer", "UnrollImage",
     "ImageFeaturizer",

@@ -52,6 +52,15 @@ class DropColumns(Transformer):
     def transform_schema(self, schema: Schema) -> Schema:
         return schema.drop(*(self.get("cols") or []))
 
+    def reads_columns(self, schema):
+        return []
+
+    def writes_columns(self, schema):
+        return []
+
+    def removes_columns(self, schema):
+        return list(self.get("cols") or [])
+
 
 class SelectColumns(Transformer):
     """ref: SelectColumns.scala"""
@@ -66,6 +75,16 @@ class SelectColumns(Transformer):
     def transform_schema(self, schema: Schema) -> Schema:
         return schema.select(*(self.get("cols") or []))
 
+    def reads_columns(self, schema):
+        return list(self.get("cols") or [])
+
+    def writes_columns(self, schema):
+        return []
+
+    def removes_columns(self, schema):
+        keep = set(self.get("cols") or [])
+        return [n for n in schema.names if n not in keep]
+
 
 class RenameColumn(Transformer, HasInputCol, HasOutputCol):
     """ref: RenameColumn.scala"""
@@ -75,6 +94,15 @@ class RenameColumn(Transformer, HasInputCol, HasOutputCol):
 
     def transform_schema(self, schema: Schema) -> Schema:
         return schema.rename({self.get_input_col(): self.get_output_col()})
+
+    def reads_columns(self, schema):
+        return [self.get_input_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_output_col()]
+
+    def removes_columns(self, schema):
+        return [self.get_input_col()]
 
 
 class Repartition(Transformer):

@@ -35,6 +35,12 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
     categorical codes, recording levels in column metadata
     (ref: ValueIndexer.scala:54; Categoricals.scala metadata)."""
 
+    def reads_columns(self, schema):
+        return [self.get_input_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_output_col()]
+
     def fit(self, table: DataTable) -> "ValueIndexerModel":
         col = table[self.get_input_col()]
         levels = table.distinct_values(self.get_input_col())
@@ -52,6 +58,40 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
 
 class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
     levels = ListParam("ordered category levels", default=None)
+
+    def reads_columns(self, schema):
+        return [self.get_input_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_output_col()]
+
+    def device_op(self, schema):
+        """Fusion hook (core/fusion.py): the string->code lookup is host
+        work (a Feed running the arrow-dictionary kernel on the batcher
+        thread); the code column itself lands directly in the fused
+        program so a downstream assembler/model never materializes it."""
+        from mmlspark_tpu.core import fusion as FZ
+        import jax.numpy as jnp
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        levels = list(self.get("levels") or [])
+        f = schema.get(in_col)
+        if f is None or f.tag != STRING:
+            return None
+        feed_name = f"{self.uid}:{in_col}:codes"
+
+        def load(table, _c=in_col, _lv=levels):
+            return string_codes(table[_c], _lv).astype(np.int32)
+
+        def fn(consts, env, _k=feed_name, _o=out_col):
+            return {_o: env[_k].astype(jnp.float32)}
+
+        field = Field(out_col, F64, {"categorical": True, "levels": levels})
+        return FZ.DeviceOp(
+            self, reads=[], writes=[out_col], fn=fn,
+            make_consts=lambda: (),
+            feeds=[FZ.Feed(feed_name, load)],
+            out_fields={out_col: field},
+            out_dtypes={out_col: np.float64})
 
     def transform(self, table: DataTable) -> DataTable:
         levels = self.get("levels") or []
@@ -102,6 +142,12 @@ class CleanMissingData(Estimator):
                              "imputation mode", default="Mean")
     customValue = FloatParam("custom fill value", default=0.0)
 
+    def reads_columns(self, schema):
+        return list(self.get("inputCols") or [])
+
+    def writes_columns(self, schema):
+        return list(self.get("outputCols") or self.get("inputCols") or [])
+
     def fit(self, table: DataTable) -> "CleanMissingDataModel":
         in_cols = self.get("inputCols") or []
         out_cols = self.get("outputCols") or in_cols
@@ -125,6 +171,42 @@ class CleanMissingDataModel(Model):
     inputCols = ListParam("columns to clean", default=None)
     outputCols = ListParam("output columns", default=None)
     fillValues = DictParam("column -> fill value", default=None)
+
+    def reads_columns(self, schema):
+        return list(self.get("inputCols") or [])
+
+    def writes_columns(self, schema):
+        return list(self.get("outputCols") or self.get("inputCols") or [])
+
+    def device_op(self, schema):
+        """Fusion hook: the impute is one ``where(isfinite)`` select per
+        column — pure device work (f32 on the fused path; the host path
+        computes in f64, so fused values are f32-rounded)."""
+        from mmlspark_tpu.core import fusion as FZ
+        import jax.numpy as jnp
+        in_cols = list(self.get("inputCols") or [])
+        out_cols = list(self.get("outputCols") or in_cols)
+        fills = self.get("fillValues") or {}
+        if not in_cols or len(in_cols) != len(out_cols):
+            return None
+
+        def make_consts():
+            return {"fills": np.asarray(
+                [fills.get(c, 0.0) for c in in_cols], np.float32)}
+
+        def fn(consts, env, _in=tuple(in_cols), _out=tuple(out_cols)):
+            out = {}
+            for i, (c, oc) in enumerate(zip(_in, _out)):
+                x = env[c]
+                out[oc] = jnp.where(jnp.isfinite(x), x,
+                                    consts["fills"][i])
+            return out
+
+        return FZ.DeviceOp(
+            self, reads=in_cols, writes=out_cols, fn=fn,
+            make_consts=make_consts,
+            out_fields={oc: Field(oc, F64) for oc in out_cols},
+            out_dtypes={oc: np.float64 for oc in out_cols})
 
     def transform(self, table: DataTable) -> DataTable:
         fills = self.get("fillValues") or {}
@@ -433,3 +515,162 @@ class FastVectorAssembler(Transformer, HasOutputCol):
         for c in self.get("inputCols") or []:
             schema.require(c)
         return schema.add_or_replace(Field(self.get_output_col(), VECTOR))
+
+    def reads_columns(self, schema):
+        return list(self.get("inputCols") or [])
+
+    def writes_columns(self, schema):
+        return [self.get_output_col()]
+
+    def device_op(self, schema):
+        """Fusion hook: assembly is one ``concatenate`` on device — the
+        (N, D) matrix becomes an XLA intermediate feeding the next op
+        instead of a host materialization."""
+        from mmlspark_tpu.core import fusion as FZ
+        import jax.numpy as jnp
+        cols = self.get("inputCols")
+        if not cols:
+            return None
+        out_col = self.get_output_col()
+
+        def fn(consts, env, _cols=tuple(cols), _o=out_col):
+            parts = []
+            for c in _cols:
+                a = env[c]
+                if a.ndim == 1:
+                    a = a[:, None]
+                parts.append(a.astype(jnp.float32))
+            return {_o: jnp.concatenate(parts, axis=1)}
+
+        return FZ.DeviceOp(
+            self, reads=list(cols), writes=[out_col], fn=fn,
+            make_consts=lambda: (),
+            out_fields={out_col: Field(out_col, VECTOR)})
+
+
+class StandardScaler(Estimator, HasInputCol, HasOutputCol):
+    """Standardize a vector (or scalar numeric) column to zero mean /
+    unit variance with fit-time statistics — the explicit pipeline-stage
+    form of the ``_Standardizer`` every linear model folds into its fit
+    (SparkML StandardScaler parity). Near-constant features keep unit
+    scale (the 1e-12 floor), so standardization never divides by ~0.
+
+    The fitted model computes in float32 (the device-boundary dtype) on
+    BOTH the host and the fused path, so fused and staged outputs are
+    bit-identical for this stage."""
+
+    # redeclared with REAL defaults so the generated API docs match
+    # behavior (the mixin defaults of "input"/"output" never apply)
+    inputCol = ColParam("column to standardize", default="features")
+    outputCol = ColParam(
+        "output column; when not set, the input column is standardized "
+        "in place", default="features")
+    withMean = BoolParam("center to zero mean", default=True)
+    withStd = BoolParam("scale to unit variance", default=True)
+
+    def _on_param_change(self, name: str) -> None:
+        # in-place default: while the user has never named outputCol
+        # explicitly, it FOLLOWS inputCol (standardize in place) —
+        # constructor kwargs and later .set() calls behave identically
+        # (the param doc's contract). Direct map write: the triggering
+        # set() already bumped the epoch.
+        if name == "outputCol":
+            self._auto_output = False
+        elif name == "inputCol" and (
+                "outputCol" not in self._paramMap
+                or getattr(self, "_auto_output", False)):
+            self._paramMap["outputCol"] = self.get("inputCol")
+            self._auto_output = True
+
+    def reads_columns(self, schema):
+        return [self.get_input_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_output_col()]
+
+    def fit(self, table: DataTable) -> "StandardScalerModel":
+        from mmlspark_tpu.core.table import features_matrix
+        col = table[self.get_input_col()]
+        if isinstance(col, np.ndarray) and col.ndim == 1:
+            X = np.asarray(col, dtype=np.float64)[:, None]
+            scalar = True
+        else:
+            X = features_matrix(table, self.get_input_col())
+            scalar = False
+        mu = X.mean(axis=0)
+        sd = X.std(axis=0)
+        sd = np.where(sd < 1e-12, 1.0, sd)
+        if not self.get("withMean"):
+            mu = np.zeros_like(mu)
+        if not self.get("withStd"):
+            sd = np.ones_like(sd)
+        model = StandardScalerModel(
+            mu=mu.astype(np.float32), sd=sd.astype(np.float32),
+            scalarInput=scalar)
+        model.set("inputCol", self.get_input_col())
+        model.set("outputCol", self.get_output_col())
+        return model
+
+
+class StandardScalerModel(Model, HasInputCol, HasOutputCol):
+    from mmlspark_tpu.core.params import PyTreeParam as _PT
+    inputCol = ColParam("column to standardize", default="features")
+    outputCol = ColParam("output column (fit copies the estimator's "
+                         "setting)", default="features")
+    mu = _PT("fit-time per-feature means (float32)", default=None)
+    sd = _PT("fit-time per-feature stds (float32, 1.0 floor)",
+             default=None)
+    scalarInput = BoolParam("input was a scalar numeric column",
+                            default=False)
+
+    def reads_columns(self, schema):
+        return [self.get_input_col()]
+
+    def writes_columns(self, schema):
+        return [self.get_output_col()]
+
+    def _load(self, table: DataTable) -> np.ndarray:
+        col = table[self.get_input_col()]
+        if isinstance(col, np.ndarray):
+            return np.asarray(col, dtype=np.float32)
+        return np.stack([np.asarray(v, dtype=np.float32) for v in col])
+
+    def transform(self, table: DataTable) -> DataTable:
+        x = self._load(table)
+        mu = np.asarray(self.get("mu"), np.float32)
+        sd = np.asarray(self.get("sd"), np.float32)
+        if x.ndim == 1:
+            out = (x - mu[0]) / sd[0]
+            field = Field(self.get_output_col(), F32)
+        else:
+            out = (x - mu) / sd
+            field = Field(self.get_output_col(), VECTOR)
+        return table.with_column(self.get_output_col(), out, field)
+
+    def transform_schema(self, schema: Schema) -> Schema:
+        f = schema[self.get_input_col()]
+        tag = VECTOR if f.tag == VECTOR else F32
+        return schema.add_or_replace(Field(self.get_output_col(), tag))
+
+    def device_op(self, schema):
+        """Fusion hook: ``(x - mu) / sd`` — elementwise f32, bit-equal
+        to the host transform."""
+        from mmlspark_tpu.core import fusion as FZ
+        in_col, out_col = self.get_input_col(), self.get_output_col()
+        f = schema.get(in_col)
+        vector = f is not None and f.tag == VECTOR
+
+        def make_consts():
+            return {"mu": np.asarray(self.get("mu"), np.float32),
+                    "sd": np.asarray(self.get("sd"), np.float32)}
+
+        def fn(consts, env, _i=in_col, _o=out_col, _vec=vector):
+            x = env[_i]
+            if _vec:
+                return {_o: (x - consts["mu"]) / consts["sd"]}
+            return {_o: (x - consts["mu"][0]) / consts["sd"][0]}
+
+        field = Field(out_col, VECTOR if vector else F32)
+        return FZ.DeviceOp(
+            self, reads=[in_col], writes=[out_col], fn=fn,
+            make_consts=make_consts, out_fields={out_col: field})

@@ -1,0 +1,724 @@
+"""Whole-pipeline fusion (core/fusion.py): parity, liveness pruning,
+DeviceTable invalidation, serving integration, and the static
+no-host-round-trip kernel check.
+
+Parity contract under test (see docs/pipeline_fusion.md):
+
+- fused vs ``transform_staged`` (the same device kernels dispatched one
+  stage at a time with host round trips): BIT-IDENTICAL — XLA
+  elementwise ops and identically shaped dots are deterministic, so
+  fusing them into one program must not change a single bit;
+- fused vs the legacy host path (``PipelineModel.transform``): stages
+  whose math is exact in f32 (featurize's selects/compares/counts, the
+  scaler's elementwise standardize) are bit-identical too; matmul-
+  bearing model stages agree exactly on predictions and to f32
+  rounding on probabilities (the host path computes in numpy f64).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import fusion as FZ
+from mmlspark_tpu.core.stage import Pipeline, PipelineModel, Transformer
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.automl.featurize import Featurize
+from mmlspark_tpu.models.linear import (
+    TPULinearRegression, TPULogisticRegression,
+)
+from mmlspark_tpu.models.tpu_model import TPUModel
+from mmlspark_tpu.stages.basic import DropColumns, Lambda, SelectColumns
+from mmlspark_tpu.stages.dataprep import (
+    CleanMissingData, FastVectorAssembler, StandardScaler, ValueIndexer,
+)
+
+
+def _raw_table(n=300, seed=0, unseen=False):
+    """Raw-rows table: numerics (one with NaN/inf), 12-level string,
+    token lists, int column — the serving-shaped input mix."""
+    rng = np.random.default_rng(seed)
+    num2 = rng.normal(size=n)
+    num2[rng.random(n) < 0.15] = np.nan
+    num2[rng.random(n) < 0.03] = np.inf
+    cats = [f"lvl{int(i)}" for i in rng.integers(0, 12, n)]
+    if unseen:
+        cats[0] = "NEVER_SEEN"
+        cats[1] = None
+    return DataTable({
+        "num1": rng.normal(size=n),
+        "num2": num2,
+        "icol": rng.integers(-5, 5, n),
+        "cat": cats,
+        "toks": [[f"w{int(t)}" for t in rng.integers(0, 40, 5)]
+                 for _ in range(n)],
+        "label": (rng.random(n) > 0.5).astype(float),
+    })
+
+
+FEATURE_COLS = ["num1", "num2", "icol", "cat", "toks"]
+
+
+def _fit_logistic_pipeline(table, one_hot=False):
+    return Pipeline(stages=[
+        Featurize(featureColumns=FEATURE_COLS, numberOfFeatures=32,
+                  oneHotEncodeCategoricals=one_hot),
+        StandardScaler(inputCol="features", outputCol="features"),
+        TPULogisticRegression(featuresCol="features", labelCol="label",
+                              maxIter=25),
+    ]).fit(table)
+
+
+def _assert_tables_equal(a: DataTable, b: DataTable, cols=None,
+                         exact=True):
+    cols = cols or a.column_names
+    for c in cols:
+        x, y = np.asarray(a[c]), np.asarray(b[c])
+        assert x.dtype == y.dtype, f"{c}: {x.dtype} != {y.dtype}"
+        if exact:
+            assert np.array_equal(x, y, equal_nan=True), \
+                f"column {c} differs (max|d|=" \
+                f"{np.nanmax(np.abs(x - y)) if x.size else 0})"
+        else:
+            assert np.allclose(x, y, rtol=1e-5, atol=1e-6,
+                               equal_nan=True), f"column {c} differs"
+
+
+# ---------------------------------------------------------------------------
+# fused vs staged vs legacy parity
+# ---------------------------------------------------------------------------
+
+
+class TestFusedParity:
+    def test_featurize_fused_bit_identical_to_host(self):
+        """Featurize's kernels are exact in f32: the fused program must
+        reproduce the host columnar path BIT-IDENTICALLY — NaN/inf
+        imputation, unseen + None levels, int/float dtypes."""
+        table = _raw_table(seed=1)
+        fm = Featurize(featureColumns=FEATURE_COLS,
+                       numberOfFeatures=32).fit(table)
+        pm = PipelineModel(stages=[fm])
+        fused = pm.fused()
+        scoring = _raw_table(n=150, seed=2, unseen=True)
+        host = pm.transform(scoring)
+        dev = fused.transform(scoring)
+        _assert_tables_equal(host, dev, cols=["features"])
+
+    def test_featurize_onehot_fused_bit_identical(self):
+        table = _raw_table(seed=3)
+        fm = Featurize(featureColumns=FEATURE_COLS, numberOfFeatures=16,
+                       oneHotEncodeCategoricals=True).fit(table)
+        pm = PipelineModel(stages=[fm])
+        scoring = _raw_table(n=100, seed=4, unseen=True)
+        _assert_tables_equal(pm.transform(scoring),
+                             pm.fused().transform(scoring),
+                             cols=["features"])
+
+    def test_logistic_pipeline_fused_vs_staged_bit_identical(self):
+        """The acceptance invariant: one fused XLA program ==
+        stage-at-a-time device dispatch, bit for bit, across NaN/inf
+        rows, unseen levels, and mixed dtypes."""
+        table = _raw_table(seed=5)
+        pm = _fit_logistic_pipeline(table)
+        fused = pm.fused()
+        scoring = _raw_table(n=200, seed=6, unseen=True)
+        out_f = fused.transform(scoring)
+        out_s = fused.transform_staged(scoring)
+        _assert_tables_equal(
+            out_f, out_s,
+            cols=["features", "rawPrediction", "probability",
+                  "prediction"])
+
+    def test_logistic_pipeline_fused_vs_legacy(self):
+        """vs the legacy f64 host path: features bit-identical,
+        predictions exact, probabilities to f32 rounding."""
+        table = _raw_table(seed=7)
+        pm = _fit_logistic_pipeline(table)
+        scoring = _raw_table(n=200, seed=8, unseen=True)
+        legacy = pm.transform(scoring)
+        out = pm.fused().transform(scoring)
+        _assert_tables_equal(legacy, out, cols=["features"])
+        assert np.array_equal(np.asarray(legacy["prediction"]),
+                              np.asarray(out["prediction"]))
+        assert np.allclose(np.asarray(legacy["probability"]),
+                           np.asarray(out["probability"]), atol=1e-5)
+        # schema/dtype parity with the host path
+        assert out.schema["prediction"].tag == \
+            legacy.schema["prediction"].tag
+        assert np.asarray(out["probability"]).dtype == np.float64
+
+    def test_linear_regression_pipeline(self):
+        table = _raw_table(seed=9)
+        pm = Pipeline(stages=[
+            Featurize(featureColumns=["num1", "num2", "icol"],
+                      numberOfFeatures=8),
+            TPULinearRegression(featuresCol="features",
+                                labelCol="label", maxIter=25),
+        ]).fit(table)
+        fused = pm.fused()
+        scoring = _raw_table(n=120, seed=10)
+        out_f = fused.transform(scoring)
+        _assert_tables_equal(out_f, fused.transform_staged(scoring),
+                             cols=["features", "prediction"])
+        legacy = pm.transform(scoring)
+        assert np.allclose(np.asarray(legacy["prediction"]),
+                           np.asarray(out_f["prediction"]), atol=1e-4)
+
+    def test_gbdt_pipeline_fused_forest_traversal(self):
+        from mmlspark_tpu.gbdt.estimators import TPUBoostClassifier
+        table = _raw_table(seed=11)
+        pm = Pipeline(stages=[
+            Featurize(featureColumns=["num1", "num2", "icol"],
+                      numberOfFeatures=8),
+            TPUBoostClassifier(featuresCol="features", labelCol="label",
+                               numIterations=8, numLeaves=7,
+                               minDataInLeaf=4),
+        ]).fit(table)
+        fused = pm.fused()
+        scoring = _raw_table(n=150, seed=12)
+        plan = fused.plan_for(scoring.schema)
+        assert len(plan.segments) == 1, plan.describe()
+        out_f = fused.transform(scoring)
+        _assert_tables_equal(
+            out_f, fused.transform_staged(scoring),
+            cols=["rawPrediction", "probability", "prediction"])
+        legacy = pm.transform(scoring)
+        assert np.array_equal(np.asarray(legacy["prediction"]),
+                              np.asarray(out_f["prediction"]))
+        assert np.allclose(np.asarray(legacy["probability"]),
+                           np.asarray(out_f["probability"]), atol=1e-5)
+
+    def test_value_indexer_assembler_tpu_model_segment(self):
+        """ValueIndexer (host Feed) -> assembler -> TPUModel forward in
+        ONE segment; mixed host/device pipeline with a trailing host
+        stage still works."""
+        table = _raw_table(seed=13)
+        vi = ValueIndexer(inputCol="cat", outputCol="cat_ix").fit(table)
+        asm = FastVectorAssembler(inputCols=["num1", "cat_ix"],
+                                  outputCol="fv")
+        W = np.asarray([[1.0, -1.0], [0.5, 0.25]], np.float32)
+        tm = TPUModel.from_fn(
+            lambda w, ins: list(ins.values())[0] @ w["W"],
+            {"W": W}, inputCol="fv", outputCol="scores")
+        pm = PipelineModel(stages=[vi, asm, tm])
+        fused = pm.fused()
+        plan = fused.plan_for(table.schema)
+        assert len(plan.segments) == 1, plan.describe()
+        out_f = fused.transform(table)
+        legacy = pm.transform(table)
+        assert np.allclose(np.asarray(legacy["scores"]),
+                           np.asarray(out_f["scores"]), atol=1e-5)
+        _assert_tables_equal(out_f, fused.transform_staged(table),
+                             cols=["cat_ix", "fv", "scores"])
+
+    def test_clean_missing_fuses(self):
+        table = _raw_table(seed=14)
+        pm = Pipeline(stages=[
+            CleanMissingData(inputCols=["num2"], outputCols=["num2c"],
+                             cleaningMode="Mean"),
+            FastVectorAssembler(inputCols=["num1", "num2c"],
+                                outputCol="fv"),
+            StandardScaler(inputCol="fv", outputCol="fv"),
+        ]).fit(table)
+        fused = pm.fused()
+        plan = fused.plan_for(table.schema)
+        assert len(plan.segments) == 1
+        out_f = fused.transform(table)
+        _assert_tables_equal(out_f, fused.transform_staged(table),
+                             cols=["num2c", "fv"])
+        legacy = pm.transform(table)
+        assert np.allclose(np.asarray(legacy["fv"]),
+                           np.asarray(out_f["fv"]), atol=1e-6)
+
+    def test_host_only_stage_breaks_segment_but_output_matches(self):
+        """A Lambda between device stages forces two segments with a
+        host hop; outputs still match the legacy path."""
+        table = _raw_table(seed=15)
+
+        def bump(t):
+            return t.with_column(
+                "num1b", np.asarray(t["num1"], np.float64) + 1.0)
+
+        pm = Pipeline(stages=[
+            Lambda(transformFunc=bump),
+            Featurize(featureColumns=["num1b", "num2"],
+                      numberOfFeatures=8),
+            StandardScaler(inputCol="features", outputCol="features"),
+        ]).fit(table)
+        fused = pm.fused()
+        out_f = fused.transform(table)
+        legacy = pm.transform(table)
+        _assert_tables_equal(legacy, out_f, cols=["features"])
+
+
+# ---------------------------------------------------------------------------
+# column liveness + pruning
+# ---------------------------------------------------------------------------
+
+
+class _SpyStage(Transformer):
+    """Records the column set it receives; declares its column flow so
+    pruning may act across it."""
+
+    def _post_init(self):
+        self.seen_columns = None
+
+    def transform(self, table):
+        self.seen_columns = list(table.column_names)
+        return table
+
+    def reads_columns(self, schema):
+        return ["features"]
+
+    def writes_columns(self, schema):
+        return []
+
+
+class TestColumnPruning:
+    def test_liveness_basic(self):
+        table = _raw_table(n=20)
+        fm = Featurize(featureColumns=FEATURE_COLS,
+                       numberOfFeatures=8).fit(table)
+        lr = TPULogisticRegression(featuresCol="features",
+                                   labelCol="label", maxIter=2)
+        model = lr.fit(fm.transform(table))
+        stages = [fm, model, SelectColumns(cols=["prediction"])]
+        needed = FZ.column_liveness(stages, table.schema)
+        # entering the model: only features (+passthrough prediction
+        # target) survive the Select
+        assert needed[1] is not None
+        assert "toks" not in needed[1]
+        assert "features" in needed[1]
+        # entering Select: just prediction
+        assert needed[2] == {"prediction"}
+
+    def test_transform_prunes_dead_intermediates_with_parity(self):
+        """The satellite: intermediate columns nothing downstream reads
+        are dropped mid-pipeline; final output is IDENTICAL."""
+        table = _raw_table(n=80, seed=20)
+        fm = Featurize(featureColumns=FEATURE_COLS,
+                       numberOfFeatures=8).fit(table)
+        lr_model = TPULogisticRegression(
+            featuresCol="features", labelCol="label",
+            maxIter=5).fit(fm.transform(table))
+        spy = _SpyStage()
+        pm = PipelineModel(stages=[
+            fm, lr_model, spy,
+            SelectColumns(cols=["prediction", "probability"])])
+        out = pm.transform(table)
+        assert out.column_names == ["prediction", "probability"]
+        # the wide hashed 'features' matrix was consumed by the model
+        # and nothing after the spy reads it except the spy's declared
+        # 'features' read; raw inputs (toks/cat/nums) were pruned
+        assert "toks" not in spy.seen_columns
+        assert "cat" not in spy.seen_columns
+        assert "features" in spy.seen_columns
+        # parity vs the unpruned stage-at-a-time walk
+        ref = table
+        for st in pm.get_stages():
+            ref = st.transform(ref)
+        _assert_tables_equal(ref, out,
+                             cols=["prediction", "probability"])
+
+    def test_unknown_stage_disables_pruning(self):
+        """A Lambda (unknown column flow) must keep every column
+        flowing — even ones its transform_schema doesn't mention."""
+        table = _raw_table(n=40, seed=21)
+
+        def adds_col(t):
+            return t.with_column("invented",
+                                 np.arange(len(t), dtype=np.float64))
+
+        picked = {}
+
+        def check(t):
+            picked["cols"] = list(t.column_names)
+            return t
+
+        pm = PipelineModel(stages=[
+            Lambda(transformFunc=adds_col),
+            Lambda(transformFunc=check),
+            DropColumns(cols=["num1"])])
+        out = pm.transform(table)
+        assert "invented" in picked["cols"]
+        assert "invented" in out.column_names
+
+    def test_fit_with_unknown_tail_keeps_estimator_outputs(self):
+        """Regression: an Estimator whose transform_schema is the
+        identity (Featurize) makes the forward schema walk blind to its
+        model's output column; with an unknown stage downstream the
+        liveness recovery branch must NOT trust that walk and prune
+        'features' away before the Lambda that reads it."""
+        table = _raw_table(n=60, seed=24)
+        seen = {}
+
+        def probe(t):
+            seen["cols"] = list(t.column_names)
+            assert "features" in t.column_names
+            return t
+
+        pm = Pipeline(stages=[
+            Featurize(featureColumns=["num1", "num2"],
+                      numberOfFeatures=4),
+            DropColumns(cols=["icol"]),       # declared stage between
+            Lambda(transformFunc=probe),      # unknown: reads features
+            DropColumns(cols=["label"]),      # Lambda not last, so fit
+        ]).fit(table)                         # actually runs the probe
+        assert "features" in seen["cols"]
+        out = pm.transform(table)
+        assert "features" in out.column_names
+
+    def test_fit_prunes_but_models_identical(self):
+        table = _raw_table(n=120, seed=22)
+        pipe = Pipeline(stages=[
+            Featurize(featureColumns=FEATURE_COLS, numberOfFeatures=8),
+            TPULogisticRegression(featuresCol="features",
+                                  labelCol="label", maxIter=5)])
+        pm = pipe.fit(table)
+        scoring = _raw_table(n=50, seed=23)
+        out = pm.transform(scoring)
+        # refit through the raw (pre-pruning) loop for parity
+        fm = pipe.get_stages()[0].fit(table)
+        lr = pipe.get_stages()[1].fit(fm.transform(table))
+        ref = lr.transform(fm.transform(scoring))
+        _assert_tables_equal(ref, out,
+                             cols=["features", "prediction",
+                                   "probability"])
+
+
+# ---------------------------------------------------------------------------
+# DeviceTable
+# ---------------------------------------------------------------------------
+
+
+class TestDeviceTable:
+    def test_columns_ship_once_across_transforms(self):
+        table = _raw_table(n=60, seed=30)
+        pm = _fit_logistic_pipeline(table)
+        fused = pm.fused()
+        fused.transform(table)
+        plan = fused.plan_for(table.schema)
+        ships1 = plan.device_table.stats()["column_ships"]
+        fused.transform(table)
+        stats = plan.device_table.stats()
+        assert stats["column_ships"] == ships1, \
+            "same table re-shipped columns"
+        assert stats["column_hits"] > 0
+
+    def test_consts_invalidate_on_stage_mutation(self):
+        """The keyed-invalidation contract: mutating a stage param
+        re-ships exactly that stage's consts and the new values take
+        effect; an unchanged stage's consts stay cached."""
+        table = _raw_table(n=60, seed=31)
+        pm = _fit_logistic_pipeline(table)
+        fused = pm.fused()
+        out1 = fused.transform(table)
+        scaler_model = pm.get_stages()[1]
+        lr_model = pm.get_stages()[2]
+        w = {k: np.array(v) for k, v in lr_model.get("weights").items()}
+        w["b"] = np.array(w["b"])
+        w["b"][1] += 5.0   # shift ONE class bias -> probabilities move
+        lr_model.set("weights", w)
+        out2 = fused.transform(table)
+        assert not np.allclose(np.asarray(out1["probability"]),
+                               np.asarray(out2["probability"]))
+        # legacy host path agrees with the refreshed consts
+        legacy = pm.transform(table)
+        assert np.array_equal(np.asarray(legacy["prediction"]),
+                              np.asarray(out2["prediction"]))
+        # and the epoch key changed only for the mutated stage
+        assert FZ.stage_epoch(lr_model) > 0
+        ep_before = FZ.stage_epoch(scaler_model)
+        fused.transform(table)
+        assert FZ.stage_epoch(scaler_model) == ep_before
+
+    def test_zero_steady_state_recompiles(self):
+        table = _raw_table(n=60, seed=32)
+        pm = _fit_logistic_pipeline(table)
+        fused = pm.fused()
+        fused.transform(table)
+        misses = fused.jit_cache_misses
+        for _ in range(3):
+            fused.transform(table)
+        assert fused.jit_cache_misses == misses
+        # a different row count is a new shape -> one new compile, then
+        # flat again
+        small = table.slice(0, 32)
+        fused.transform(small)
+        misses2 = fused.jit_cache_misses
+        assert misses2 == misses + 1
+        fused.transform(small)
+        assert fused.jit_cache_misses == misses2
+
+
+# ---------------------------------------------------------------------------
+# serving integration
+# ---------------------------------------------------------------------------
+
+
+def _post(address, payload, timeout=15):
+    req = urllib.request.Request(
+        address, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class TestFusedServing:
+    def test_pipeline_scoring_end_to_end(self):
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        from mmlspark_tpu.serving.server import serve_model
+        table = _raw_table(n=200, seed=40)
+        pm = _fit_logistic_pipeline(table)
+        scorer = json_scoring_pipeline(pm, batch_size=32)
+        example = {"num1": [0.1], "num2": [1.0], "icol": [2],
+                   "cat": ["lvl3"], "toks": [["w1", "w2"]]}
+        compiles = scorer.warmup(example)
+        assert compiles == len(scorer.scorer.fused.bucket_sizes())
+        assert scorer.warmup(example) == 0   # idempotent: fully warm
+        m0 = scorer.jit_cache_miss_count()
+        rt0 = scorer.scorer.device_roundtrips
+        engine = serve_model(scorer, port=19410, batch_size=32,
+                             workers=2)
+        try:
+            payload = {"num1": 0.4, "num2": float("nan"), "icol": 1,
+                       "cat": "lvl7", "toks": ["w3", "w9"]}
+            replies = [_post(engine.source.address, payload)
+                       for _ in range(6)]
+            assert all("prediction" in r for r in replies)
+            # the raw-row reply matches the batch-transform verdict
+            row = DataTable({k: [v] for k, v in payload.items()})
+            expect = float(np.asarray(
+                pm.fused().transform(row)["prediction"])[0])
+            assert float(replies[0]["prediction"]) == expect
+        finally:
+            engine.stop()
+        assert scorer.jit_cache_miss_count() == m0, \
+            "steady-state serving recompiled a fused program"
+        scored = scorer.scorer.batches_scored - 0
+        trips = scorer.scorer.device_roundtrips - rt0
+        assert trips <= scored - 0 or trips <= scored, \
+            (trips, scored)
+        # at most one device round trip per scored batch
+        assert scorer.scorer.device_roundtrips - rt0 <= \
+            scorer.scorer.batches_scored
+
+    def test_swap_fused_pipeline_zero_recompiles(self):
+        """Lifecycle swap of a fused pipeline: the incoming pipeline
+        warms every bucket off the hot path; steady-state traffic never
+        compiles — through and after the cutover."""
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        from mmlspark_tpu.serving.lifecycle import CanaryPolicy
+        from mmlspark_tpu.serving.server import serve_model
+        table = _raw_table(n=200, seed=41)
+        pm1 = _fit_logistic_pipeline(table)
+        pm2 = _fit_logistic_pipeline(_raw_table(n=200, seed=42))
+        s1 = json_scoring_pipeline(pm1, batch_size=32)
+        s2 = json_scoring_pipeline(pm2, batch_size=32)
+        example = {"num1": [0.1], "num2": [1.0], "icol": [2],
+                   "cat": ["lvl3"], "toks": [["w1", "w2"]]}
+        s1.warmup(example)
+        engine = serve_model(s1, port=19420, batch_size=32, workers=2,
+                             version="v1")
+        try:
+            payload = {"num1": 0.4, "num2": 0.2, "icol": 1,
+                       "cat": "lvl7", "toks": ["w3"]}
+            for _ in range(4):
+                _post(engine.source.address, payload)
+            m1 = s1.jit_cache_miss_count()
+            # steady background load so the canary sees batches
+            import threading
+            stop = threading.Event()
+
+            def pump():
+                while not stop.is_set():
+                    try:
+                        _post(engine.source.address, payload, timeout=5)
+                    except Exception:  # noqa: BLE001 — load only
+                        pass
+
+            pumps = [threading.Thread(target=pump, daemon=True)
+                     for _ in range(3)]
+            for t in pumps:
+                t.start()
+            try:
+                res = engine.swap(
+                    s2, "v2", warmup_example=example,
+                    policy=CanaryPolicy(fraction=0.5, min_batches=2,
+                                        decision_timeout_s=20))
+            finally:
+                stop.set()
+                for t in pumps:
+                    t.join(timeout=5)
+            assert res.completed, res.reason
+            warm = len(s2.scorer.fused.bucket_sizes())
+            m2_after_swap = s2.jit_cache_miss_count()
+            assert m2_after_swap == warm, \
+                "swap warmup did not cover every bucket exactly once"
+            for _ in range(6):
+                r = _post(engine.source.address, payload)
+                assert "prediction" in r
+            assert s1.jit_cache_miss_count() == m1
+            assert s2.jit_cache_miss_count() == m2_after_swap, \
+                "post-cutover traffic recompiled the fused pipeline"
+            assert engine.model_version == "v2"
+        finally:
+            engine.stop()
+
+
+class TestFusedScorerEdges:
+    """Regressions from review: host-only plans must not double-run,
+    late-appearing JSON keys must not be dropped, multi-segment tails
+    must not retrace per batch size, vector reply columns must encode."""
+
+    def _req_table(self, payloads):
+        reqs = [{"entity": json.dumps(p).encode()} for p in payloads]
+        return DataTable({"id": [str(i) for i in range(len(reqs))],
+                          "request": reqs})
+
+    def test_host_only_pipeline_single_run(self):
+        """A pipeline with no fused segment (Lambda-wrapped scoring):
+        prepare() runs it once; execute() must NOT run it again."""
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        calls = {"n": 0}
+
+        def score(t):
+            calls["n"] += 1
+            return t.with_column(
+                "prediction",
+                np.asarray(t["x"], np.float64) * 2.0)
+
+        pm = PipelineModel(stages=[Lambda(transformFunc=score)])
+        scorer = json_scoring_pipeline(pm, batch_size=16)
+        out = scorer.scorer.transform(self._req_table([{"x": 3.0}]))
+        assert out["reply"][0] == {"prediction": 6}
+        assert calls["n"] == 1, "host-only pipeline ran twice per batch"
+
+    def test_late_json_key_is_not_dropped(self):
+        """A field the first batch omitted must still reach the
+        pipeline when later requests supply it."""
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        table = _raw_table(n=100, seed=50)
+        pm = Pipeline(stages=[
+            CleanMissingData(inputCols=["num2"], outputCols=["num2"]),
+            FastVectorAssembler(inputCols=["num1", "num2"],
+                                outputCol="fv"),
+            TPULinearRegression(featuresCol="fv", labelCol="label",
+                                maxIter=5),
+        ]).fit(table)
+        scorer = json_scoring_pipeline(pm, batch_size=16)
+        sc = scorer.scorer
+        # first batch omits num2 entirely -> pinned names lack it (the
+        # request itself fails: a required field is absent — in
+        # production the engine turns that into per-row 500s)
+        with pytest.raises(Exception):
+            sc.transform(self._req_table([{"num1": 1.0}]))
+        # later batch supplies num2: its value must flow (two requests
+        # differing only in num2 must score differently)
+        o1 = sc.transform(self._req_table([{"num1": 1.0, "num2": 0.0}]))
+        o2 = sc.transform(self._req_table([{"num1": 1.0, "num2": 9.0}]))
+        v1 = o1["reply"][0]["prediction"]
+        v2 = o2["reply"][0]["prediction"]
+        assert v1 != v2, "late-appearing JSON key was silently dropped"
+
+    def test_multi_segment_tail_zero_steady_state_recompiles(self):
+        """A host Lambda between two device runs: the tail segment must
+        see bucket-padded shapes too, so ragged micro-batch sizes never
+        retrace on the hot path."""
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        table = _raw_table(n=150, seed=51)
+
+        def rename(t):
+            return t.with_column(
+                "fx", np.asarray(t["features"], np.float32))
+
+        pm = Pipeline(stages=[
+            Featurize(featureColumns=["num1", "num2"],
+                      numberOfFeatures=8),
+            Lambda(transformFunc=rename),          # host hop
+            StandardScaler(inputCol="fx", outputCol="fx"),
+            TPULogisticRegression(featuresCol="fx", labelCol="label",
+                                  maxIter=5),
+        ]).fit(table)
+        scorer = json_scoring_pipeline(pm, batch_size=16)
+        sc = scorer.scorer
+        plan = None
+        # warm, then hammer ragged sizes: misses must stay flat
+        scorer.warmup({"num1": [0.1], "num2": [0.2]})
+        m0 = scorer.jit_cache_miss_count()
+        for size in (1, 3, 5, 7, 2, 6):
+            rows = [{"num1": 0.1 * i, "num2": 0.2} for i in range(size)]
+            out = sc.transform(self._req_table(rows))
+            assert len(out["reply"]) == size
+        assert scorer.jit_cache_miss_count() == m0, \
+            "ragged batch sizes retraced a tail segment"
+
+    def test_vector_reply_column(self):
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        table = _raw_table(n=100, seed=52)
+        pm = _fit_logistic_pipeline(table)
+        scorer = json_scoring_pipeline(pm, batch_size=16,
+                                       reply_col="probability",
+                                       reply_field="probs")
+        row = {"num1": 0.3, "num2": 0.1, "icol": 1, "cat": "lvl2",
+               "toks": ["w1"]}
+        out = scorer.scorer.transform(self._req_table([row]))
+        probs = out["reply"][0]["probs"]
+        assert isinstance(probs, list) and len(probs) == 2
+        assert abs(sum(probs) - 1.0) < 1e-5
+
+    def test_drift_monitor_rejected_for_pipelines(self):
+        from mmlspark_tpu.core.metrics import DriftMonitor
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        table = _raw_table(n=60, seed=53)
+        pm = _fit_logistic_pipeline(table)
+        dm = DriftMonitor(np.zeros(3), np.ones(3))
+        with pytest.raises(ValueError, match="drift_monitor"):
+            json_scoring_pipeline(pm, drift_monitor=dm)
+
+
+# ---------------------------------------------------------------------------
+# the static kernel check (CI guard for the no-host-round-trip invariant)
+# ---------------------------------------------------------------------------
+
+
+def _bad_kernel(consts, env):
+    x = env["a"]
+    return {"out": np.asarray(x) + 1}
+
+
+def _ok_kernel(consts, env):
+    return {"out": env["a"] + consts["b"]}
+
+
+class TestKernelStaticCheck:
+    def test_shipped_kernels_are_clean(self):
+        import sys, os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import check_fusion_kernels as chk
+        n = chk.register_representative_pipelines()
+        n += chk.register_known_callees()
+        assert n >= 12, "expected every fusable stage family + the " \
+            "known kernel callees (forest walk, objectives) registered"
+        violations = chk.check_registered_kernels()
+        assert violations == [], "\n".join(violations)
+
+    def test_checker_catches_host_roundtrip(self):
+        import sys, os
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import inspect
+        import check_fusion_kernels as chk
+        lines, first = inspect.getsourcelines(_bad_kernel)
+        import textwrap
+        bad = chk._check_source("bad", textwrap.dedent("".join(lines)),
+                                first, lines)
+        assert bad, "checker missed an np.asarray host round trip"
+        lines, first = inspect.getsourcelines(_ok_kernel)
+        ok = chk._check_source("ok", textwrap.dedent("".join(lines)),
+                               first, lines)
+        assert ok == []

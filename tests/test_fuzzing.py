@@ -184,6 +184,10 @@ def _register_all():
     reg(lambda: TestObject(
         CleanMissingData(inputCols=["num"], outputCols=["numc"]),
         fit_table=_num_table()))
+    from mmlspark_tpu.stages import StandardScaler
+    reg(lambda: TestObject(
+        StandardScaler(inputCol="features", outputCol="features_std"),
+        fit_table=_num_table()))
     reg(lambda: TestObject(DataConversion(cols=["num"],
                                           convertTo="float"),
                            transform_table=_num_table()))

@@ -1,0 +1,230 @@
+"""Static no-host-round-trip check for fused-segment kernel code.
+
+Fused pipeline programs (core/fusion.py) promise that everything between
+a segment's H2D ship and its single D2H fetch stays on device. That
+invariant is easy to regress silently: one `np.asarray(...)` inside a
+DeviceOp ``fn`` turns the fused program into a trace-time host sync (or
+a per-call constant re-ship) and the "one round trip per batch"
+guarantee quietly dies while every test still passes.
+
+This checker audits the SOURCE of every registered device kernel
+(``core.fusion.KERNEL_REGISTRY`` — populated when ``device_op()`` builds
+its DeviceOp) for host-round-trip constructs:
+
+- ``np.*`` / ``numpy.*`` calls or attribute reads (host arrays inside a
+  traced function force host<->device syncs or retrace-time constants),
+- ``jax.device_get`` / ``device_get``,
+- ``.block_until_ready()``,
+- ``.item()`` / ``float(x)`` / ``int(x)`` on traced values are caught by
+  the np/device_get rules' sibling: explicit ``.item(`` match.
+
+A line may be whitelisted with a trailing ``# fusion:host-ok`` comment
+(for genuinely trace-time-only host work, e.g. reading a static shape).
+
+Run from the repo root::
+
+    python tools/check_fusion_kernels.py
+
+Exit status 1 + a violation listing when any kernel touches the host.
+The tier-1 test ``tests/test_fusion.py::TestKernelStaticCheck`` builds
+one representative pipeline of every fusable stage family and runs this
+check against the registered kernels, so CI holds the invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import os
+import sys
+import textwrap
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# names whose attribute access / call inside kernel code means a host
+# round trip
+_FORBIDDEN_ROOTS = {"np", "numpy"}
+_FORBIDDEN_ATTRS = {"device_get", "block_until_ready", "item",
+                    "to_py", "tolist"}
+_WHITELIST_MARK = "# fusion:host-ok"
+
+
+def _kernel_sources() -> List[Tuple[str, str, int, List[str]]]:
+    """(name, source, firstlineno, lines) per registered kernel."""
+    from mmlspark_tpu.core.fusion import KERNEL_REGISTRY
+    out = []
+    seen = set()
+    for code, name in KERNEL_REGISTRY.items():
+        key = (code.co_filename, code.co_firstlineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        try:
+            lines, first = inspect.getsourcelines(code)
+        except OSError:
+            continue   # dynamically built (tests); nothing to audit
+        out.append((name, textwrap.dedent("".join(lines)), first, lines))
+    return out
+
+
+def _check_source(name: str, src: str, first: int,
+                  lines: List[str]) -> List[str]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return [f"{name}: unparseable kernel source"]
+    violations: List[str] = []
+
+    def line_ok(lineno: int) -> bool:
+        idx = lineno - 1
+        if 0 <= idx < len(lines):
+            return _WHITELIST_MARK in lines[idx]
+        return False
+
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, ast.Attribute):
+            root = node.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in _FORBIDDEN_ROOTS:
+                bad = f"{root.id}.{node.attr}"
+            elif node.attr in _FORBIDDEN_ATTRS:
+                bad = f".{node.attr}"
+        elif isinstance(node, ast.Name) and node.id in _FORBIDDEN_ROOTS:
+            bad = node.id
+        if bad is not None and not line_ok(node.lineno):
+            violations.append(
+                f"{name} (line {first + node.lineno - 1}): host "
+                f"round-trip construct {bad!r} inside a fused kernel")
+    return violations
+
+
+def check_registered_kernels() -> List[str]:
+    """All violations across registered kernels (empty = clean)."""
+    violations: List[str] = []
+    for name, src, first, lines in _kernel_sources():
+        violations.extend(_check_source(name, src, first, lines))
+    return violations
+
+
+def register_known_callees() -> int:
+    """Register the same-repo functions fused kernels CALL (the
+    audit's transitive reach): the jitted forest walk and every GBDT
+    objective's ``transform``. The top-level kernel fns are closures
+    built by ``device_op()``; these callees are where a host sync
+    could otherwise hide. (User-supplied ``modelFn``s of TPUModel are
+    out of scope by construction — they are the user's code.)"""
+    from mmlspark_tpu.core.fusion import register_kernel
+    from mmlspark_tpu.gbdt import objectives as OBJ
+    from mmlspark_tpu.gbdt import tree as TREE
+    walk = getattr(TREE.predict_trees, "__wrapped__", TREE.predict_trees)
+    register_kernel(walk, "gbdt.tree.predict_trees")
+    count = 1
+
+    def subclasses(cls):
+        for sub in cls.__subclasses__():
+            yield sub
+            yield from subclasses(sub)
+
+    for cls in {OBJ.Objective, *subclasses(OBJ.Objective)}:
+        fn = cls.__dict__.get("transform")
+        if fn is not None:
+            register_kernel(fn, f"gbdt.objectives.{cls.__name__}.transform")
+            count += 1
+    return count
+
+
+def register_representative_pipelines() -> int:
+    """Build one fitted pipeline per fusable stage family and plan it,
+    so KERNEL_REGISTRY holds every shipped kernel. Returns the number
+    of registered kernel code objects."""
+    import numpy as np
+    from mmlspark_tpu.core.fusion import KERNEL_REGISTRY, fuse
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.core.stage import Pipeline
+    from mmlspark_tpu.automl.featurize import Featurize
+    from mmlspark_tpu.stages.dataprep import (
+        CleanMissingData, FastVectorAssembler, StandardScaler,
+        ValueIndexer,
+    )
+    from mmlspark_tpu.models.linear import (
+        TPULinearRegression, TPULogisticRegression,
+    )
+    from mmlspark_tpu.gbdt.estimators import (
+        TPUBoostClassifier, TPUBoostRegressor,
+    )
+    from mmlspark_tpu.models.tpu_model import TPUModel
+
+    rng = np.random.default_rng(0)
+    n = 64
+    table = DataTable({
+        "a": rng.normal(size=n).astype(np.float32),
+        "b": np.where(rng.random(n) < 0.2, np.nan, rng.normal(size=n)),
+        "cat": [f"l{int(i)}" for i in rng.integers(0, 4, n)],
+        "toks": [[f"w{int(t)}" for t in rng.integers(0, 9, 3)]
+                 for _ in range(n)],
+        "label": rng.integers(0, 2, n).astype(np.float64),
+    })
+    pm = Pipeline(stages=[
+        CleanMissingData(inputCols=["b"], outputCols=["b"]),
+        ValueIndexer(inputCol="cat", outputCol="cat_ix"),
+        Featurize(featureColumns=["a", "b", "toks"],
+                  numberOfFeatures=8),
+        FastVectorAssembler(inputCols=["features", "cat_ix"],
+                            outputCol="fv"),
+        StandardScaler(inputCol="fv", outputCol="fv"),
+        TPULogisticRegression(featuresCol="fv", labelCol="label",
+                              maxIter=3),
+    ]).fit(table)
+    fuse(pm).plan_for(table.schema)
+
+    # (N,1) feature matrix via assembler keeps the fit happy
+    lin = Pipeline(stages=[
+        FastVectorAssembler(inputCols=["a"], outputCol="fv2"),
+        TPULinearRegression(featuresCol="fv2", labelCol="label",
+                            maxIter=3)]).fit(table)
+    fuse(lin).plan_for(table.schema)
+
+    gb = Pipeline(stages=[
+        FastVectorAssembler(inputCols=["a", "b"], outputCol="fv3"),
+        TPUBoostClassifier(featuresCol="fv3", labelCol="label",
+                           numIterations=3, numLeaves=4,
+                           minDataInLeaf=2)]).fit(table)
+    fuse(gb).plan_for(table.schema)
+    gr = Pipeline(stages=[
+        FastVectorAssembler(inputCols=["a", "b"], outputCol="fv4"),
+        TPUBoostRegressor(featuresCol="fv4", labelCol="label",
+                          numIterations=3, numLeaves=4,
+                          minDataInLeaf=2)]).fit(table)
+    fuse(gr).plan_for(table.schema)
+
+    tm = TPUModel.from_fn(
+        lambda w, ins: list(ins.values())[0] @ w["W"],
+        {"W": np.eye(2, dtype=np.float32)},
+        inputCol="fv5", outputCol="scores")
+    asm = FastVectorAssembler(inputCols=["a", "b"], outputCol="fv5")
+    from mmlspark_tpu.core.stage import PipelineModel
+    fuse(PipelineModel(stages=[asm, tm])).plan_for(table.schema)
+
+    return len(KERNEL_REGISTRY)
+
+
+def main() -> int:
+    n = register_representative_pipelines()
+    n += register_known_callees()
+    violations = check_registered_kernels()
+    if violations:
+        print(f"{len(violations)} fused-kernel host-round-trip "
+              f"violation(s) across {n} registered kernels:")
+        for v in violations:
+            print("  -", v)
+        return 1
+    print(f"OK: {n} registered fused kernels, no host round trips")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
