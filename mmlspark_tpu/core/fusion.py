@@ -396,6 +396,23 @@ class FusedSegment:
         self._op_jitted: Dict[int, Callable] = {}
         self._lock = threading.Lock()
         self.trace_count = 0      # one per XLA compile of the fused fn
+        # AOT-loaded executables keyed by input signature
+        # (serving/aot.py installs them): a signature hit calls the
+        # pre-compiled program directly — no jit, no trace, no count
+        self._aot: Dict[Tuple, Callable] = {}
+
+    @staticmethod
+    def env_signature(env: Dict[str, jnp.ndarray]) -> Tuple:
+        """The shape/dtype signature an AOT program is keyed by."""
+        return tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in env.items()))
+
+    def install_aot(self, programs: Dict[Tuple, Callable]) -> None:
+        """Install pre-compiled (bucket) programs; subsequent
+        ``compiled()`` calls dispatch by signature and only fall back to
+        jit (counting the trace) for shapes the artifact never saw."""
+        with self._lock:
+            self._aot.update(programs)
 
     # -- program construction ----------------------------------------------
 
@@ -431,7 +448,17 @@ class FusedSegment:
                     fn = jax.jit(self._make_fn(count_traces=True),
                                  donate_argnums=(1,) if donate else ())
                     self._jitted[donate] = fn
-        return fn
+        if not self._aot:
+            return fn
+        aot, seg = self._aot, self
+
+        def dispatch(consts, env):
+            prog = aot.get(seg.env_signature(env))
+            if prog is not None:
+                return prog(consts, env)
+            return fn(consts, env)   # unseen shape: jit path, counted
+
+        return dispatch
 
     def op_compiled(self, i: int) -> Callable:
         """Per-op jit — the stage-at-a-time baseline (one dispatch per
@@ -699,6 +726,10 @@ class FusedPipelineModel:
                  batch_size: int = 256):
         self.stages = list(stages)
         self.batch_size = int(batch_size)
+        # True when rebuilt from an AOT artifact with pre-compiled
+        # segment programs installed (serving/aot.py); the
+        # serving_model_info 'aot' label
+        self.aot = False
         self._plans: Dict[Tuple, FusionPlan] = {}
         self._plan_lock = threading.Lock()
         # trace counts of evicted (stale-epoch) plans: folded into
@@ -798,24 +829,62 @@ class FusedPipelineModel:
 
     def warmup(self, example, sizes: Optional[List[int]] = None) -> int:
         """Pre-compile every serving bucket's fused programs (tile the
-        example rows up to each bucket and transform) — the lifecycle
-        swap protocol's off-hot-path compile hook. Returns compiles
-        triggered (0 = already warm)."""
-        table = example if isinstance(example, DataTable) \
-            else DataTable(dict(example))
+        example rows up to each bucket and transform; core/warmup.py —
+        per-bucket compile wall lands in the ``model_warmup_ms``
+        histogram) — the lifecycle swap protocol's off-hot-path compile
+        hook. Returns compiles triggered (0 = already warm)."""
+        from mmlspark_tpu.core.warmup import warmup_transform
+        return warmup_transform(self, example, sizes)
+
+    # -- post-training quantization -------------------------------------------
+
+    @property
+    def precision(self) -> str:
+        """'int8' when any stage carries quantized weights, else 'f32'
+        (the serving_model_info precision label)."""
+        from mmlspark_tpu.core.quantize import stage_precision
+        if any(stage_precision(s) == "int8" for s in self.stages):
+            return "int8"
+        return "f32"
+
+    def quantize(self, calib: DataTable,
+                 percentile: float = 100.0) -> "FusedPipelineModel":
+        """Int8-quantize the model segments of this pipeline: walk the
+        fitted stage list with the ``calib`` rows flowing through the
+        f32 path, hand each quantizable stage (linear models, TPUModel
+        — the duck-typed ``quantize(calib_table)`` hook) ITS OWN input
+        table, and return a NEW ``FusedPipelineModel`` over the
+        quantized clones. Featurization/scaler stages pass through
+        unchanged (they are bandwidth-bound; the matmuls are what
+        quantization buys). This model stays the f32 oracle."""
+        from mmlspark_tpu.core.quantize import quantize_stage
+        table = calib if isinstance(calib, DataTable) \
+            else DataTable(dict(calib))
         if len(table) == 0:
-            raise ValueError("warmup needs at least one example row")
-        before = self.jit_cache_misses
-        for b in (sizes or self.bucket_sizes()):
-            idx = np.resize(np.arange(len(table)), b)
-            self.transform(table._take_indices(idx))
-        return self.jit_cache_misses - before
+            raise ValueError("quantize needs at least one calibration row")
+        stages: List[Any] = []
+        quantized = 0
+        cur = table
+        for i, stage in enumerate(self.stages):
+            q, did = quantize_stage(stage, cur, percentile=percentile)
+            stages.append(q)
+            quantized += int(did)
+            if i + 1 < len(self.stages):
+                # f32 path feeds the NEXT stage's calibration; the last
+                # stage's output feeds nothing — skip its forward
+                cur = stage.transform(cur)
+        if quantized == 0:
+            raise ValueError(
+                "no quantizable stage in the pipeline (nothing exposes "
+                "a quantize(calib) hook)")
+        return FusedPipelineModel(stages, batch_size=self.batch_size)
 
     def metrics(self) -> Dict[str, Any]:
         plans = list(self._plans.values())
         out: Dict[str, Any] = {
             "jit_cache_misses": self.jit_cache_misses,
             "plans": len(plans),
+            "precision": self.precision,
         }
         if plans:
             # aggregate DeviceTable stats across plans (batch + serving

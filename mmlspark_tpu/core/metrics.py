@@ -230,6 +230,25 @@ def automl_histograms() -> Dict[str, LatencyHistogram]:
 
 
 # ---------------------------------------------------------------------------
+# serving warmup histogram
+# ---------------------------------------------------------------------------
+
+# per-bucket compile wall milliseconds of every serving-model warmup in
+# this process (core/warmup.py — the ONE bucket-compile loop behind
+# TPUModel.warmup / FusedPipelineModel.warmup / the fused serving
+# scorer). A trace-at-startup replica lands log2(batchSize) samples in
+# the 100ms-10s decades; an AOT-loaded replica (serving/aot.py) lands
+# the same count near zero — the cold-start story, live on /metrics.
+_WARMUP_HISTS: Dict[str, LatencyHistogram] = histogram_set(
+    "model_warmup_ms")
+
+
+def warmup_histograms() -> Dict[str, LatencyHistogram]:
+    """The process-wide serving-warmup histogram family."""
+    return _WARMUP_HISTS
+
+
+# ---------------------------------------------------------------------------
 # fused-pipeline phase histograms
 # ---------------------------------------------------------------------------
 

@@ -147,6 +147,10 @@ def process_families(r: PromRenderer, tracer: Any = None) -> None:
         r.histogram("pipeline_fusion_phase_ms",
                     "fused-pipeline per-phase wall milliseconds "
                     "(core/fusion.py)", hist, {"phase": phase})
+    for name, hist in MC.warmup_histograms().items():
+        r.histogram(f"serving_{name}",
+                    "per-bucket serving warmup compile wall "
+                    "(near-zero when AOT-loaded — serving/aot.py)", hist)
     if tracer is None:
         from mmlspark_tpu.core.trace import get_tracer
         tracer = get_tracer()

@@ -1,0 +1,346 @@
+"""Int8 post-training quantization for the serving hot path.
+
+Integer-arithmetic-only inference (Jacob et al., CVPR 2018): weights
+quantize to int8 with **per-output-channel symmetric scales** computed
+from the fitted weights, activations quantize **per-tensor** with a clip
+range calibrated on a held-out batch, and every quantized matmul lowers
+as an int8 x int8 -> int32 ``lax.dot_general`` (via
+``preferred_element_type``) with a float32 dequantization epilogue:
+
+    y = (q(x) . q(W)) * (s_x * s_w) + b        # accumulate in i32,
+                                               # dequant + bias in f32
+
+On MXU-class hardware the int8 systolic path doubles effective batch
+throughput per chip vs f32; on backends without an integer-matmul
+advantage (this repo's CPU CI container included) the bench reports the
+measured ratio with the backend labeled instead of asserting a win the
+hardware cannot show.
+
+What quantizes and what stays f32 (docs/quantized_inference.md):
+
+- **Dense / matmul weights** (flax ``nn.Dense`` layers, the linear-model
+  ``W``) quantize per-channel. These are the MXU-bound FLOPs.
+- **Biases, LayerNorm/BatchNorm params, embeddings, conv kernels, LSTM
+  cells** stay f32 — they are bandwidth- or latency-bound, not
+  MXU-bound, and quantizing them buys noise for no throughput.
+- **Softmax / argmax / standardization epilogues** stay f32 (the dequant
+  epilogue contract; the static kernel audit additionally forbids silent
+  f64 upcasts there — tools/check_fusion_kernels.py).
+
+The f32 model is never mutated: ``quantize`` hooks return NEW stages, so
+the original model remains the accuracy oracle and the rollback target
+for the serving swap protocol.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# int8 symmetric range: +-127 (not -128) so negation stays exact and the
+# zero point is exactly 0 — the standard symmetric-PTQ choice
+QMAX = 127.0
+
+# floor below which a scale is clamped: a dead channel (all-zero weights
+# or a constant-zero activation) must not divide by zero
+_SCALE_FLOOR = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# scale computation (host, at quantization time)
+# ---------------------------------------------------------------------------
+
+
+def per_channel_scales(w: np.ndarray, axis: int = -1) -> np.ndarray:
+    """Symmetric per-output-channel scales for a weight matrix: one
+    scale per slice along ``axis`` (the output-channel axis; -1 for the
+    (D, K) layout every Dense/linear weight here uses), computed as
+    max|w| / 127 over the remaining axes."""
+    w = np.asarray(w, dtype=np.float64)
+    reduce_axes = tuple(i for i in range(w.ndim)
+                        if i != (axis % w.ndim))
+    amax = np.abs(w).max(axis=reduce_axes)
+    return np.maximum(amax / QMAX, _SCALE_FLOOR).astype(np.float32)
+
+
+def quantize_weight(w: np.ndarray, axis: int = -1
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """Weight -> (int8 values, f32 per-channel scales). Round-to-nearest
+    -even (numpy/XLA agree), clipped to the symmetric +-127 range."""
+    scale = per_channel_scales(w, axis=axis)
+    shape = [1] * np.ndim(w)
+    shape[axis % np.ndim(w)] = -1
+    q = np.clip(np.round(np.asarray(w, np.float64)
+                         / scale.reshape(shape)), -QMAX, QMAX)
+    return q.astype(np.int8), scale
+
+
+def act_scale(amax: float) -> np.float32:
+    """Per-tensor activation scale from a calibrated |x| clip value."""
+    return np.float32(max(float(amax), _SCALE_FLOOR) / QMAX)
+
+
+class ActivationCalibrator:
+    """Running per-tensor |x| statistics over calibration batches.
+
+    ``percentile=100`` (default) clips at the observed absolute max —
+    exact range, sensitive to outliers. Lower percentiles (e.g. 99.9)
+    trade a little saturation on the tail for finer resolution of the
+    bulk; the clip is the max over batches of the per-batch percentile,
+    so one calibration batch is enough and more batches only widen it.
+    Thread-safe (serving-path calibration can be concurrent)."""
+
+    def __init__(self, percentile: float = 100.0):
+        if not 0.0 < percentile <= 100.0:
+            raise ValueError(f"percentile must be in (0, 100]: "
+                             f"{percentile}")
+        self.percentile = float(percentile)
+        self._amax: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, key: str, x) -> None:
+        x = np.abs(np.asarray(x, dtype=np.float64))
+        if x.size == 0:
+            return
+        a = float(x.max()) if self.percentile >= 100.0 \
+            else float(np.percentile(x, self.percentile))
+        with self._lock:
+            if key not in self._amax or a > self._amax[key]:
+                self._amax[key] = a
+
+    def amax(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._amax)
+
+    def scale(self, key: str) -> np.float32:
+        with self._lock:
+            if key not in self._amax:
+                raise KeyError(
+                    f"no calibration observed for {key!r}; "
+                    f"have {sorted(self._amax)}")
+            return act_scale(self._amax[key])
+
+
+# ---------------------------------------------------------------------------
+# the device kernels (pure JAX; audited by tools/check_fusion_kernels.py)
+# ---------------------------------------------------------------------------
+
+
+def quantize_act(x: jnp.ndarray, x_scale) -> jnp.ndarray:
+    """On-device per-tensor activation quantization: scale (in f32 —
+    the host mirror divides in f32 too, so the same input bits always
+    quantize to the same int8 value), round to nearest (ties to even —
+    XLA's and numpy's shared convention), saturate to the symmetric
+    int8 range. NaN inputs saturate arbitrarily here; ``int8_matmul``
+    re-injects the NaN in its epilogue."""
+    q = x.astype(jnp.float32) / jnp.float32(x_scale)
+    return jnp.clip(jnp.round(q), -QMAX, QMAX).astype(jnp.int8)
+
+
+def int8_matmul(x: jnp.ndarray, wq: jnp.ndarray, x_scale,
+                w_scale: jnp.ndarray) -> jnp.ndarray:
+    """The quantized matmul: quantize ``x`` per-tensor on device,
+    contract its last axis against int8 weights ``wq`` (D, K) with an
+    int32 accumulator (``preferred_element_type`` — the MXU int8 path),
+    then dequantize in float32: ``acc * (s_x * s_w)``. The epilogue is
+    f32 BY CONTRACT — no f64 anywhere (audited). NaN rows propagate:
+    an integer accumulator cannot carry NaN, so the epilogue re-injects
+    it wherever the f32 oracle would have produced one — a quantized
+    model must never turn a NaN feature into a confident finite score."""
+    xq = quantize_act(x, x_scale)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (
+        jnp.float32(x_scale) * w_scale.astype(jnp.float32))
+    nan_row = jnp.isnan(x).any(axis=-1, keepdims=True)
+    return jnp.where(nan_row, jnp.float32(jnp.nan), out)
+
+
+def int8_matmul_host(x: np.ndarray, wq: np.ndarray, x_scale,
+                     w_scale: np.ndarray) -> np.ndarray:
+    """Numpy mirror of ``int8_matmul``: the activation quotient is
+    computed in f32 exactly like the device kernel (so identical input
+    bits quantize identically) and integer accumulation is exact, so
+    host and device agree bit-for-bit on the i32 accumulator GIVEN the
+    same f32 inputs; the f32 dequant multiply matches XLA's elementwise
+    f32 semantics. (The linear models' host path standardizes in f64
+    vs the fused kernel's f32 — the same predictions-exact /
+    probabilities-to-f32-rounding contract as the f32 path.) Used by
+    the quantized linear models' host ``transform``."""
+    x = np.asarray(x)
+    q = (x.astype(np.float32) / np.float32(x_scale)).astype(np.float32)
+    with np.errstate(invalid="ignore"):
+        xq = np.clip(np.round(q), -QMAX, QMAX)
+    xq = np.nan_to_num(xq, nan=0.0).astype(np.int8)
+    acc = xq.astype(np.int32) @ wq.astype(np.int32)
+    out = acc.astype(np.float32) * (
+        np.float32(x_scale) * np.asarray(w_scale, np.float32))
+    nan_row = np.isnan(x.astype(np.float32)).any(axis=-1, keepdims=True)
+    return np.where(nan_row, np.float32(np.nan), out)
+
+
+def _register_audit_kernels() -> None:
+    """Put the quantized compute kernels into the fused-kernel registry
+    so the static no-host-round-trip / no-f64-upcast audit
+    (tools/check_fusion_kernels.py) covers them as known callees."""
+    from mmlspark_tpu.core.fusion import register_kernel
+    register_kernel(quantize_act, "quantize.quantize_act")
+    register_kernel(int8_matmul, "quantize.int8_matmul")
+
+
+# ---------------------------------------------------------------------------
+# flax network quantization (the TPUModel zoo path)
+# ---------------------------------------------------------------------------
+
+# key under which the quantized tensors ride in the TPUModel weights
+# pytree, next to the untouched f32 variables (the oracle/rollback copy)
+QUANT_KEY = "__quant__"
+
+
+def _walk_dense_paths(params: Dict[str, Any],
+                      prefix: Tuple[str, ...] = ()) -> List[Tuple[str, Any]]:
+    """(path, kernel) for every 2-D ``kernel`` leaf — flax ``nn.Dense``
+    layers. Conv kernels (4-D) and everything else stay f32 (see module
+    docstring)."""
+    out: List[Tuple[str, Any]] = []
+    for k, v in params.items():
+        if isinstance(v, dict):
+            out.extend(_walk_dense_paths(v, prefix + (k,)))
+        elif k == "kernel" and np.ndim(v) == 2:
+            out.append(("/".join(prefix), v))
+    return out
+
+
+class QuantizedFlaxApply:
+    """Picklable quantized apply wrapper for a flax module.
+
+    Runs ``module.apply`` under a ``nn.intercept_methods`` interceptor
+    that replaces each calibrated ``nn.Dense.__call__`` with the int8
+    matmul (+ the layer's f32 bias); uncalibrated/unquantized layers run
+    their normal f32 path. The quantized tensors travel in the weights
+    pytree under ``__quant__`` so they are device-resident exactly like
+    ordinary weights (TPUModel ships the tree once)."""
+
+    def __init__(self, module, method=None):
+        self.module = module
+        self.method = method
+        self.int_input = bool(getattr(module, "int_input", False))
+
+    def __call__(self, weights: Dict[str, Any],
+                 inputs: Dict[str, jnp.ndarray]):
+        import flax.linen as nn
+        quant = weights[QUANT_KEY]
+        variables = {k: v for k, v in weights.items() if k != QUANT_KEY}
+        args = list(inputs.values())
+
+        def interceptor(next_fun, f_args, f_kwargs, context):
+            mod = context.module
+            if (isinstance(mod, nn.Dense)
+                    and context.method_name == "__call__"):
+                q = quant.get("/".join(mod.path))
+                if q is not None:
+                    x = f_args[0].astype(jnp.float32)
+                    y = int8_matmul(x, q["wq"], q["x_scale"],
+                                    q["w_scale"])
+                    if mod.use_bias:
+                        y = y + mod.variables["params"]["bias"
+                                                        ].astype(jnp.float32)
+                    return y
+            return next_fun(*f_args, **f_kwargs)
+
+        with nn.intercept_methods(interceptor):
+            if self.method is not None:
+                return self.module.apply(variables, *args,
+                                         method=self.method)
+            return self.module.apply(variables, *args)
+
+
+def calibrate_flax(module, variables: Dict[str, Any],
+                   calib_args: Sequence[Any], method=None,
+                   percentile: float = 100.0) -> ActivationCalibrator:
+    """Run calibration inputs through the f32 module once, capturing
+    every Dense layer's input |x| range (per-tensor). ``calib_args`` is
+    the positional-args list one forward takes (TPUModel passes its
+    decoded feed arrays)."""
+    import flax.linen as nn
+    calib = ActivationCalibrator(percentile=percentile)
+
+    def interceptor(next_fun, f_args, f_kwargs, context):
+        mod = context.module
+        if isinstance(mod, nn.Dense) and context.method_name == "__call__":
+            calib.observe("/".join(mod.path), f_args[0])
+        return next_fun(*f_args, **f_kwargs)
+
+    with nn.intercept_methods(interceptor):
+        if method is not None:
+            module.apply(variables, *calib_args, method=method)
+        else:
+            module.apply(variables, *calib_args)
+    return calib
+
+
+def quantize_flax(module, variables: Dict[str, Any],
+                  calib_args: Sequence[Any], method=None,
+                  percentile: float = 100.0
+                  ) -> Tuple[QuantizedFlaxApply, Dict[str, Any]]:
+    """Post-training-quantize a flax module: calibrate activation
+    ranges on ``calib_args``, quantize every Dense kernel per-channel,
+    and return ``(quantized apply fn, weights pytree)`` where the
+    pytree is the ORIGINAL variables plus the ``__quant__`` subtree
+    (f32 weights stay — they are the oracle and the biases' home)."""
+    calib = calibrate_flax(module, variables, calib_args, method=method,
+                           percentile=percentile)
+    amax = calib.amax()
+    params = variables.get("params", variables)
+    quant: Dict[str, Dict[str, Any]] = {}
+    for path, kernel in _walk_dense_paths(params):
+        if path not in amax:
+            continue   # layer never saw calibration traffic: stays f32
+        wq, w_scale = quantize_weight(np.asarray(kernel), axis=-1)
+        quant[path] = {"wq": wq, "w_scale": w_scale,
+                       "x_scale": act_scale(amax[path])}
+    if not quant:
+        raise ValueError(
+            "nothing to quantize: no calibrated 2-D Dense kernels found "
+            "(conv/LSTM/embedding layers stay f32 by design)")
+    weights = dict(variables)
+    weights[QUANT_KEY] = quant
+    return QuantizedFlaxApply(module, method), weights
+
+
+# ---------------------------------------------------------------------------
+# generic stage quantization (the FusedPipelineModel path)
+# ---------------------------------------------------------------------------
+
+
+def quantize_stage(stage, calib_table,
+                   percentile: float = 100.0) -> Tuple[Any, bool]:
+    """Quantize one fitted stage if it supports it: returns
+    ``(stage_or_quantized_clone, was_quantized)``. Stages advertise
+    support through a duck-typed ``quantize(calib_table, percentile=)``
+    hook that must return a NEW stage (the f32 original stays the
+    oracle)."""
+    hook = getattr(stage, "quantize", None)
+    if not callable(hook):
+        return stage, False
+    return hook(calib_table, percentile=percentile), True
+
+
+def stage_precision(stage) -> str:
+    """A stage's serving precision label: 'int8' when the stage carries
+    quantized weights, else 'f32'."""
+    get = getattr(stage, "get", None)
+    if callable(get):
+        try:
+            p = get("precision")
+            if p:
+                return str(p)
+        except Exception:  # noqa: BLE001 — stages without the param
+            pass
+    return str(getattr(stage, "precision", "f32"))

@@ -1,0 +1,57 @@
+"""The shared bucket-warmup loop of the serving models.
+
+``TPUModel.warmup``, ``FusedPipelineModel.warmup``, and the fused
+serving scorer all pre-compile every pow-2 shape bucket before traffic;
+this module is the ONE implementation of that loop, and it records each
+bucket's compile wall into the process-wide ``model_warmup_ms``
+histogram (exported on ``/metrics``) — so a cold-start win is visible in
+the exposition, not just asserted in a bench JSON. An AOT-loaded model
+(serving/aot.py) runs the same loop and lands near-zero samples: the
+histogram IS the trace-at-startup vs load-compiled comparison, live.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from mmlspark_tpu.core import metrics as MC
+
+
+def warmup_buckets(run_bucket: Callable[[int], None],
+                   sizes: List[int],
+                   miss_count: Callable[[], int]) -> int:
+    """Drive ``run_bucket(b)`` for every serving bucket size, timing
+    each into ``model_warmup_ms``. Returns the number of compiles
+    triggered (``miss_count`` delta; 0 = everything was already warm —
+    the AOT-loaded case)."""
+    hist = MC.warmup_histograms()["model_warmup_ms"]
+    before = miss_count()
+    for b in sizes:
+        t0 = time.perf_counter()
+        run_bucket(b)
+        hist.observe((time.perf_counter() - t0) * 1e3)
+    return miss_count() - before
+
+
+def warmup_transform(model, example, sizes: Optional[List[int]] = None
+                     ) -> int:
+    """The table-tiling warmup shared by ``TPUModel`` and
+    ``FusedPipelineModel``: ``example`` (a DataTable or column->array
+    dict with >= 1 representative row) tiles up to each bucket size and
+    pushes through ``model.transform``; the model's
+    ``jit_cache_misses`` counter is the compile probe."""
+    from mmlspark_tpu.core.table import DataTable
+    table = example if isinstance(example, DataTable) \
+        else DataTable(dict(example))
+    if len(table) == 0:
+        raise ValueError("warmup needs at least one example row")
+
+    def run_bucket(b: int) -> None:
+        idx = np.resize(np.arange(len(table)), b)
+        model.transform(table._take_indices(idx))
+
+    return warmup_buckets(run_bucket, sizes or model.bucket_sizes(),
+                          lambda: model.jit_cache_misses)

@@ -20,8 +20,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from mmlspark_tpu.core.params import (
-    FloatParam, HasFeaturesCol, HasLabelCol, HasPredictionCol, IntParam,
-    PyTreeParam, range_domain,
+    EnumParam, FloatParam, HasFeaturesCol, HasLabelCol, HasPredictionCol,
+    IntParam, PyTreeParam, range_domain,
 )
 from mmlspark_tpu.core.schema import Field, Schema, F64, VECTOR
 from mmlspark_tpu.core.stage import Estimator, Model
@@ -316,6 +316,12 @@ class TPULogisticRegression(Estimator, HasFeaturesCol, HasLabelCol,
 
 class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("W/b/mu/sd arrays", default=None)
+    # 'int8' models carry per-channel-quantized W (wq/w_scale) and a
+    # calibrated per-tensor activation scale (x_scale) next to the f32
+    # arrays; set by quantize(), surfaced as the serving precision label
+    precision = EnumParam(["f32", "int8"],
+                          "inference precision (set by quantize())",
+                          default="f32")
 
     def reads_columns(self, schema):
         return [self.get_features_col()]
@@ -323,6 +329,39 @@ class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     def writes_columns(self, schema):
         return ["rawPrediction", "probability",
                 self.get_prediction_col()]
+
+    def quantize(self, calib: DataTable, percentile: float = 100.0
+                 ) -> "TPULogisticRegressionModel":
+        """Int8 post-training quantization (core/quantize.py): W gets
+        per-class-channel symmetric scales, the standardized feature
+        activations get a per-tensor clip calibrated on ``calib``'s
+        feature rows, and the returned NEW model scores through an
+        int8xint8->i32 matmul with an f32 dequant epilogue on both the
+        host and the fused device path. This (f32) model is untouched —
+        the accuracy oracle and swap-rollback target."""
+        from mmlspark_tpu.core import quantize as QZ
+        w = self.get("weights")
+        if w is None or "mu" not in w:
+            raise ValueError(
+                "quantize requires a dense-featured model (sparse models "
+                "score through the host CSR path and carry no "
+                "standardization stats to calibrate against)")
+        table = calib if isinstance(calib, DataTable) \
+            else DataTable(dict(calib))
+        X = _features_matrix(table, self.get_features_col())
+        if X.shape[0] == 0:
+            raise ValueError("quantize needs at least one calibration row")
+        Xs = (X - w["mu"]) / w["sd"]
+        wq, w_scale = QZ.quantize_weight(np.asarray(w["W"]), axis=-1)
+        cal = QZ.ActivationCalibrator(percentile=percentile)
+        cal.observe("x", Xs)
+        qweights = {k: np.asarray(v) for k, v in w.items()}
+        qweights.update(wq=wq, w_scale=w_scale, x_scale=cal.scale("x"))
+        out = TPULogisticRegressionModel(weights=qweights,
+                                         precision="int8")
+        out.set("featuresCol", self.get_features_col())
+        out.set("predictionCol", self.get_prediction_col())
+        return out
 
     def device_op(self, schema):
         """Fusion hook (core/fusion.py): standardize + logits + softmax
@@ -332,24 +371,41 @@ class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
         rounding; ``transform_staged`` (the same kernel dispatched
         stage-at-a-time) is bit-identical."""
         from mmlspark_tpu.core import fusion as FZ
+        from mmlspark_tpu.core import quantize as QZ
         w = self.get("weights")
         if w is None or "mu" not in w:
             return None    # sparse-featured models score on host
         feat = self.get_features_col()
         pred_col = self.get_prediction_col()
         binary = int(np.asarray(w["W"]).shape[1]) == 2
+        int8 = self.get("precision") == "int8"
 
         def make_consts():
             ww = self.get("weights")
-            return {"W": np.asarray(ww["W"], np.float32),
-                    "b": np.asarray(ww["b"], np.float32),
-                    "mu": np.asarray(ww["mu"], np.float32),
-                    "sd": np.asarray(ww["sd"], np.float32)}
+            consts = {"b": np.asarray(ww["b"], np.float32),
+                      "mu": np.asarray(ww["mu"], np.float32),
+                      "sd": np.asarray(ww["sd"], np.float32)}
+            if int8:
+                consts.update(
+                    wq=np.asarray(ww["wq"], np.int8),
+                    w_scale=np.asarray(ww["w_scale"], np.float32),
+                    x_scale=np.float32(ww["x_scale"]))
+            else:
+                consts["W"] = np.asarray(ww["W"], np.float32)
+            return consts
 
-        def fn(consts, env, _f=feat, _p=pred_col, _bin=binary):
+        def fn(consts, env, _f=feat, _p=pred_col, _bin=binary,
+               _int8=int8):
             X = env[_f]
             Xs = (X - consts["mu"]) / consts["sd"]
-            logits = Xs @ consts["W"] + consts["b"]
+            if _int8:
+                # int8 MXU path + f32 dequant epilogue (no f64 anywhere
+                # — the audited quantization contract)
+                logits = QZ.int8_matmul(
+                    Xs, consts["wq"], consts["x_scale"],
+                    consts["w_scale"]) + consts["b"]
+            else:
+                logits = Xs @ consts["W"] + consts["b"]
             m = jnp.max(logits, axis=1, keepdims=True)
             e = jnp.exp(logits - m)
             prob = e / jnp.sum(e, axis=1, keepdims=True)
@@ -370,7 +426,11 @@ class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
                         pred_col: Field(pred_col, F64)},
             out_dtypes={"rawPrediction": np.float64,
                         "probability": np.float64,
-                        pred_col: np.float64})
+                        pred_col: np.float64},
+            # :int8 suffix scopes the checker's no-f64-upcast audit to
+            # quantized kernels (tools/check_fusion_kernels.py)
+            name=(f"{type(self).__name__}:{self.uid}:int8"
+                  if int8 else None))
 
     def drift_monitor(self):
         """A ``core.metrics.DriftMonitor`` seeded with this model's
@@ -400,9 +460,17 @@ class TPULogisticRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
         """``transform`` with the dense (N, D) extraction hoisted by the
         caller — the CV hot path scores every candidate against ONE
         cached fold matrix instead of re-extracting it per candidate."""
+        from mmlspark_tpu.core.quantize import int8_matmul_host
         w = self.get("weights")
         if "mu" in w:
             X = (X - w["mu"]) / w["sd"]
+        if self.get("precision") == "int8":
+            # integer accumulation is exact, so the host path agrees
+            # with the fused device kernel bit-for-bit on the i32
+            # accumulator; the f32 dequant mirrors XLA's epilogue
+            logits = int8_matmul_host(X, w["wq"], w["x_scale"],
+                                      w["w_scale"]) + w["b"]
+            return self._attach_scores(table, logits)
         return self._attach_scores(table, X @ w["W"] + w["b"])
 
     def _attach_scores(self, table: DataTable,
@@ -494,6 +562,9 @@ class TPULinearRegression(Estimator, HasFeaturesCol, HasLabelCol,
 
 class TPULinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     weights = PyTreeParam("w/b/mu/sd arrays", default=None)
+    precision = EnumParam(["f32", "int8"],
+                          "inference precision (set by quantize())",
+                          default="f32")
 
     def reads_columns(self, schema):
         return [self.get_features_col()]
@@ -501,28 +572,73 @@ class TPULinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
     def writes_columns(self, schema):
         return [self.get_prediction_col()]
 
+    def quantize(self, calib: DataTable, percentile: float = 100.0
+                 ) -> "TPULinearRegressionModel":
+        """Int8 PTQ of the regression weight vector (treated as a
+        (D, 1) matmul — one output channel, one weight scale) with the
+        standardized-feature activation clip calibrated on ``calib``.
+        See ``TPULogisticRegressionModel.quantize``."""
+        from mmlspark_tpu.core import quantize as QZ
+        w = self.get("weights")
+        if w is None:
+            raise ValueError("quantize requires a fitted model "
+                             "(weights is None)")
+        table = calib if isinstance(calib, DataTable) \
+            else DataTable(dict(calib))
+        X = _features_matrix(table, self.get_features_col())
+        if X.shape[0] == 0:
+            raise ValueError("quantize needs at least one calibration row")
+        Xs = (X - w["mu"]) / w["sd"]
+        wq, w_scale = QZ.quantize_weight(
+            np.asarray(w["w"]).reshape(-1, 1), axis=-1)
+        cal = QZ.ActivationCalibrator(percentile=percentile)
+        cal.observe("x", Xs)
+        qweights = {k: np.asarray(v) for k, v in w.items()}
+        qweights.update(wq=wq, w_scale=w_scale, x_scale=cal.scale("x"))
+        out = TPULinearRegressionModel(weights=qweights,
+                                       precision="int8")
+        out.set("featuresCol", self.get_features_col())
+        out.set("predictionCol", self.get_prediction_col())
+        return out
+
     def device_op(self, schema):
         """Fusion hook: standardize + dot + un-standardize in f32 (see
-        ``TPULogisticRegressionModel.device_op``)."""
+        ``TPULogisticRegressionModel.device_op``); int8 models route the
+        dot through the quantized matmul with its f32 dequant epilogue."""
         from mmlspark_tpu.core import fusion as FZ
+        from mmlspark_tpu.core import quantize as QZ
         w = self.get("weights")
         if w is None:
             return None
         feat = self.get_features_col()
         pred_col = self.get_prediction_col()
+        int8 = self.get("precision") == "int8"
 
         def make_consts():
             ww = self.get("weights")
-            return {"w": np.asarray(ww["w"], np.float32),
-                    "b": np.asarray(ww["b"], np.float32),
-                    "mu": np.asarray(ww["mu"], np.float32),
-                    "sd": np.asarray(ww["sd"], np.float32),
-                    "y_mu": np.float32(ww["y_mu"]),
-                    "y_sd": np.float32(ww["y_sd"])}
+            consts = {"b": np.asarray(ww["b"], np.float32),
+                      "mu": np.asarray(ww["mu"], np.float32),
+                      "sd": np.asarray(ww["sd"], np.float32),
+                      "y_mu": np.float32(ww["y_mu"]),
+                      "y_sd": np.float32(ww["y_sd"])}
+            if int8:
+                consts.update(
+                    wq=np.asarray(ww["wq"], np.int8),
+                    w_scale=np.asarray(ww["w_scale"], np.float32),
+                    x_scale=np.float32(ww["x_scale"]))
+            else:
+                consts["w"] = np.asarray(ww["w"], np.float32)
+            return consts
 
-        def fn(consts, env, _f=feat, _p=pred_col):
+        def fn(consts, env, _f=feat, _p=pred_col, _int8=int8):
             Xs = (env[_f] - consts["mu"]) / consts["sd"]
-            pred = (Xs @ consts["w"] + consts["b"]) * consts["y_sd"] \
+            if _int8:
+                dot = QZ.int8_matmul(Xs, consts["wq"],
+                                     consts["x_scale"],
+                                     consts["w_scale"])[:, 0]
+            else:
+                dot = Xs @ consts["w"]
+            pred = (dot + consts["b"]) * consts["y_sd"] \
                 + consts["y_mu"]
             return {_p: pred}
 
@@ -530,7 +646,9 @@ class TPULinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
             self, reads=[feat], writes=[pred_col], fn=fn,
             make_consts=make_consts,
             out_fields={pred_col: Field(pred_col, F64)},
-            out_dtypes={pred_col: np.float64})
+            out_dtypes={pred_col: np.float64},
+            name=(f"{type(self).__name__}:{self.uid}:int8"
+                  if int8 else None))
 
     def drift_monitor(self):
         """Fit-time feature-stat DriftMonitor (see
@@ -547,9 +665,15 @@ class TPULinearRegressionModel(Model, HasFeaturesCol, HasPredictionCol):
                               X: np.ndarray) -> DataTable:
         """``transform`` with the (N, D) extraction hoisted by the
         caller (see TPULogisticRegressionModel.transform_from_matrix)."""
+        from mmlspark_tpu.core.quantize import int8_matmul_host
         w = self.get("weights")
         Xs = (X - w["mu"]) / w["sd"]
-        pred = (Xs @ w["w"] + w["b"]) * w["y_sd"] + w["y_mu"]
+        if self.get("precision") == "int8":
+            dot = int8_matmul_host(Xs, w["wq"], w["x_scale"],
+                                   w["w_scale"])[:, 0]
+        else:
+            dot = Xs @ w["w"]
+        pred = (dot + w["b"]) * w["y_sd"] + w["y_mu"]
         return table.with_column(self.get_prediction_col(),
                                  np.asarray(pred, dtype=np.float64),
                                  Field(self.get_prediction_col(), F64))

@@ -76,9 +76,19 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
     # global jax_enable_x64 flag is on, which we don't silently toggle
     computeDtype = EnumParam(["float32", "bfloat16"],
                              "on-device compute dtype", default="float32")
+    # serving precision label: 'int8' models carry per-channel-quantized
+    # Dense weights + calibrated activation scales in the weights tree
+    # (core/quantize.py) and run int8xint8->i32 matmuls with f32 dequant
+    # epilogues. Set by quantize(), surfaced on /healthz + /metrics.
+    precision = EnumParam(["f32", "int8"],
+                          "inference precision (set by quantize())",
+                          default="f32")
 
     def _post_init(self):
         self._mesh: Optional[Mesh] = None
+        # True on models rebuilt from an AOT artifact (serving/aot.py);
+        # exported as the serving_model_info 'aot' label
+        self.aot = False
         self._jitted: Dict[Tuple, Callable] = {}
         self._device_weights = None
         # lazy init is shared mutable state; concurrent first calls
@@ -221,17 +231,12 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         ``example`` is a DataTable, or a dict of column -> array,
         holding at least one representative row for every feed column.
         Rows are tiled up to each bucket size and pushed through
-        ``transform``. Returns the number of compiles triggered (0 when
-        everything was already warm)."""
-        table = example if isinstance(example, DataTable) \
-            else DataTable(dict(example))
-        if len(table) == 0:
-            raise ValueError("warmup needs at least one example row")
-        before = self.jit_cache_misses
-        for b in (sizes or self.bucket_sizes()):
-            idx = np.resize(np.arange(len(table)), b)
-            self.transform(table._take_indices(idx))
-        return self.jit_cache_misses - before
+        ``transform`` (core/warmup.py — each bucket's compile wall
+        lands in the ``model_warmup_ms`` histogram on /metrics).
+        Returns the number of compiles triggered (0 when everything was
+        already warm)."""
+        from mmlspark_tpu.core.warmup import warmup_transform
+        return warmup_transform(self, example, sizes)
 
     def bucket_for(self, rows: int) -> int:
         """The padded bucket a ``rows``-row micro-batch compiles/runs
@@ -256,7 +261,64 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         out: Dict[str, Any] = {k: h.summary()
                                for k, h in self._hists.items()}
         out["jit_cache_misses"] = self.jit_cache_misses
+        out["precision"] = self.get("precision")
+        out["aot"] = bool(self.aot)
         return out
+
+    # -- post-training quantization -----------------------------------------
+
+    def quantize(self, calib, percentile: float = 100.0) -> "TPUModel":
+        """Int8 post-training quantization (core/quantize.py): calibrate
+        per-tensor activation clip ranges on the ``calib`` rows (a
+        DataTable or column->array dict holding a held-out batch for
+        every feed column), quantize every Dense kernel per-channel, and
+        return a NEW ``TPUModel`` whose forward runs int8xint8->i32
+        matmuls with f32 dequant epilogues. This model (the f32 path) is
+        untouched — it stays the accuracy oracle and the swap-rollback
+        target. The returned model keeps the full serving discipline
+        (pow-2 buckets, ``warmup()``, ``jit_cache_misses``, donation)
+        and labels itself ``precision='int8'`` on /healthz.
+
+        Requires a flax-module model (``from_flax`` or any modelFn
+        exposing ``.module``): quantization intercepts ``nn.Dense``
+        calls; conv/LSTM/embedding layers stay f32 by design."""
+        from mmlspark_tpu.core import quantize as QZ
+        model_fn = self.get("modelFn")
+        module = getattr(model_fn, "module", None)
+        if module is None:
+            raise ValueError(
+                "quantize() needs a flax-module model (TPUModel.from_flax"
+                " or a modelFn exposing .module); arbitrary callables "
+                "cannot be post-training quantized")
+        table = calib if isinstance(calib, DataTable) \
+            else DataTable(dict(calib))
+        if len(table) == 0:
+            raise ValueError("quantize needs at least one calibration row")
+        int_input = bool(getattr(model_fn, "int_input", False))
+        host_dtype = np.int32 if int_input else np.float32
+        args = []
+        for _model_in, col in self._feeds().items():
+            args.append(_column_to_array(table[col],
+                                         table.schema.get(col),
+                                         host_dtype))
+        variables = self.get("weights")
+        if not (isinstance(variables, dict) and "params" in variables):
+            variables = {"params": variables}
+        qfn, qweights = QZ.quantize_flax(
+            module, variables, args,
+            method=getattr(model_fn, "method", None),
+            percentile=percentile)
+        # computeDtype pins to float32: the dequant epilogue contract is
+        # f32, and routing int8 dequant through bf16 would stack a
+        # second rounding on top of the quantization error
+        return TPUModel(modelFn=qfn, weights=qweights,
+                        feedDict=self.get("feedDict"),
+                        fetchDict=self.get("fetchDict"),
+                        batchSize=self.get("batchSize"),
+                        computeDtype="float32",
+                        inputCol=self.get("inputCol"),
+                        outputCol=self.get("outputCol"),
+                        precision="int8")
 
     # -- fusion hook ---------------------------------------------------------
 
@@ -316,7 +378,9 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
 
         return FZ.DeviceOp(
             self, reads=reads, writes=list(fetches.keys()), fn=fn,
-            make_consts=lambda: self.get("weights"), feeds=op_feeds)
+            make_consts=lambda: self.get("weights"), feeds=op_feeds,
+            name=(f"{type(self).__name__}:{self.uid}:int8"
+                  if self.get("precision") == "int8" else None))
 
     # -- transform ----------------------------------------------------------
 

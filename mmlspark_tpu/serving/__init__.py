@@ -5,6 +5,9 @@ DistributedHTTPSource.scala, ServingImplicits.scala,
 PartitionConsolidator.scala).
 """
 
+from mmlspark_tpu.serving.aot import (
+    export_model, load_model, read_manifest,
+)
 from mmlspark_tpu.serving.fleet import (
     PartitionConsolidator, ServingFleet, ServingUnavailable,
     json_row_scoring_pipeline, json_scoring_pipeline,
@@ -21,5 +24,6 @@ __all__ = ["CanaryPolicy", "HTTPSource", "ModelRegistry",
            "PartitionConsolidator", "PipelineHandle", "ServingEngine",
            "ServingFleet", "ServingUnavailable", "SharedSingleton",
            "SharedVariable", "SwapEvent", "SwapInProgress", "SwapResult",
-           "json_row_scoring_pipeline", "json_scoring_pipeline",
+           "export_model", "json_row_scoring_pipeline",
+           "json_scoring_pipeline", "load_model", "read_manifest",
            "serve_model"]

@@ -175,6 +175,12 @@ def json_scoring_pipeline(model, field: str = "features",
         lam.bucket_for = model_bucket
     if drift_monitor is not None:
         lam.drift_monitor = drift_monitor
+    # precision/aot labels ride the stage into the PipelineHandle so
+    # healthz/serving_model_info/SwapEvent can audit a quantized or
+    # AOT-loaded rollout (see serving/lifecycle.py)
+    from mmlspark_tpu.core.quantize import stage_precision
+    lam.precision = stage_precision(model)
+    lam.aot = bool(getattr(model, "aot", False))
     return lam
 
 
@@ -403,21 +409,28 @@ class _FusedPipelineScorer:
     def warmup(self, example, sizes: Optional[List[int]] = None) -> int:
         """Compile every bucket's fused program through the EXACT
         serving path (prepare/execute with bucket padding + donation),
-        so a lifecycle swap reaches the hot path fully warm."""
+        so a lifecycle swap reaches the hot path fully warm. Runs
+        through the shared bucket loop (core/warmup.py), so each
+        bucket's compile wall lands in the ``model_warmup_ms``
+        histogram on /metrics — near-zero for AOT-loaded pipelines."""
+        from mmlspark_tpu.core.warmup import warmup_buckets
         from mmlspark_tpu.io.http import _jsonable
         table = example if isinstance(example, DataTable) \
             else DataTable(dict(example))
         if len(table) == 0:
             raise ValueError("warmup needs at least one example row")
-        before = self.fused.jit_cache_misses
         body = [json.dumps({k: _jsonable(v) for k, v in row.items()}
                            ).encode() for row in table.rows()]
-        for b in (sizes or self.fused.bucket_sizes()):
+
+        def run_bucket(b: int) -> None:
             reqs = [{"entity": body[i % len(body)]} for i in range(b)]
             req_table = DataTable({"id": [str(i) for i in range(b)],
                                    "request": reqs})
             self.execute(req_table, self.prepare(req_table))
-        return self.fused.jit_cache_misses - before
+
+        return warmup_buckets(run_bucket,
+                              sizes or self.fused.bucket_sizes(),
+                              lambda: self.fused.jit_cache_misses)
 
     def jit_cache_miss_count(self) -> int:
         return self.fused.jit_cache_misses
@@ -440,6 +453,8 @@ class _FusedPipelineScorer:
         lam.metrics = self.metrics
         lam.jit_cache_miss_count = self.jit_cache_miss_count
         lam.bucket_for = self.bucket_for
+        lam.precision = self.fused.precision
+        lam.aot = bool(self.fused.aot)
         lam.scorer = self
         return lam
 
@@ -925,6 +940,9 @@ class ServingFleet:
         # swap counters (the ops view of a rolling upgrade in flight)
         aggregate["model_versions"] = [
             m.get("model_version") for m in per_engine]
+        aggregate["precisions"] = [
+            m.get("precision") for m in per_engine]
+        aggregate["aot"] = [m.get("aot") for m in per_engine]
         aggregate["swap_states"] = [
             m.get("swap_state") for m in per_engine]
         aggregate["swaps_completed"] = sum(
@@ -983,8 +1001,11 @@ class ServingFleet:
                       "model swaps rolled back",
                       snap["swaps_rolled_back"], labels)
             r.info("serving_model_info",
-                   "active model version and swap state per engine",
+                   "active model version, precision, aot, swap state "
+                   "per engine",
                    {**labels, "version": snap["model_version"],
+                    "precision": snap["precision"],
+                    "aot": "true" if snap["aot"] else "false",
                     "swap_state": snap["swap_state"]})
         if self.engines:
             for key in self.engines[0].hists:

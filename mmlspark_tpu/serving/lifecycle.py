@@ -66,19 +66,36 @@ class SwapInProgress(RuntimeError):
 
 
 class SwapEvent:
-    """Typed lifecycle event: one completed or rolled-back swap."""
+    """Typed lifecycle event: one completed or rolled-back swap.
+
+    Carries each side's serving precision (f32/int8) and the incoming
+    model's AOT flag, so a rollout to a quantized or AOT-loaded model
+    is auditable from the event log alone — and a canary that compared
+    int8 against f32 is visible as exactly that."""
 
     def __init__(self, kind: str, from_version: str, to_version: str,
-                 reason: str = "", stats: Optional[Dict[str, Any]] = None):
+                 reason: str = "", stats: Optional[Dict[str, Any]] = None,
+                 from_precision: str = "f32", to_precision: str = "f32",
+                 from_aot: bool = False, to_aot: bool = False):
         self.kind = kind                    # 'completed' | 'rolled_back'
         self.from_version = from_version
         self.to_version = to_version
         self.reason = reason
         self.stats = dict(stats or {})
+        self.from_precision = str(from_precision)
+        self.to_precision = str(to_precision)
+        self.from_aot = bool(from_aot)
+        self.to_aot = bool(to_aot)
         self.at = time.time()
 
     def __repr__(self) -> str:
         extra = f", reason={self.reason!r}" if self.reason else ""
+        if (self.from_precision != self.to_precision
+                or self.from_aot != self.to_aot):
+            extra += (f", {self.from_precision}"
+                      f"{'+aot' if self.from_aot else ''} -> "
+                      f"{self.to_precision}"
+                      f"{'+aot' if self.to_aot else ''}")
         return (f"SwapEvent({self.kind}, {self.from_version!r} -> "
                 f"{self.to_version!r}{extra})")
 
@@ -158,12 +175,19 @@ class ModelRegistry:
 
     def register(self, version: str, pipeline: Any,
                  metadata: Optional[Dict[str, Any]] = None) -> None:
+        from mmlspark_tpu.core.quantize import stage_precision
+        meta = dict(metadata or {})
+        # precision/aot recorded at registration (explicit metadata
+        # wins): the registry is the audit trail a quantized/AOT
+        # rollout is traced back through
+        meta.setdefault("precision", stage_precision(pipeline))
+        meta.setdefault("aot", bool(getattr(pipeline, "aot", False)))
         with self._lock:
             if version in self._versions:
                 raise ValueError(f"version {version!r} already registered")
             self._versions[version] = pipeline
             self._order.append(version)
-            self._meta[version] = dict(metadata or {})
+            self._meta[version] = meta
 
     def get(self, version: str) -> Any:
         with self._lock:
@@ -383,6 +407,11 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
     try:
         old = engine._active
         from_version = old.version
+        from mmlspark_tpu.core.quantize import stage_precision
+        precisions = {"from_precision": old.precision,
+                      "to_precision": stage_precision(pipeline),
+                      "from_aot": old.aot,
+                      "to_aot": bool(getattr(pipeline, "aot", False))}
 
         def rolled_back(reason: str,
                         stats: Optional[Dict[str, Any]] = None
@@ -395,7 +424,7 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
                 engine.swap_state = ROLLED_BACK
                 engine.swaps_rolled_back += 1
             event = SwapEvent("rolled_back", from_version, version,
-                              reason=reason, stats=stats)
+                              reason=reason, stats=stats, **precisions)
             engine.swap_events.append(event)
             if registry is not None:
                 registry.record_event(event)
@@ -452,7 +481,8 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
         with engine._stats_lock:
             engine.swap_state = IDLE
             engine.swaps_completed += 1
-        event = SwapEvent("completed", from_version, version, stats=stats)
+        event = SwapEvent("completed", from_version, version, stats=stats,
+                          **precisions)
         engine.swap_events.append(event)
         if registry is not None:
             registry.record_event(event)

@@ -526,13 +526,22 @@ class PipelineHandle:
     ``rescue_to`` (the stable handle) so clients never eat a canary's
     faults."""
 
-    __slots__ = ("pipeline", "version", "prepare", "execute", "is_canary",
-                 "controller", "rescue_to", "_outstanding", "_lock")
+    __slots__ = ("pipeline", "version", "precision", "aot", "prepare",
+                 "execute", "is_canary", "controller", "rescue_to",
+                 "_outstanding", "_lock")
 
     def __init__(self, pipeline: Transformer, version: str,
                  is_canary: bool = False):
+        from mmlspark_tpu.core.quantize import stage_precision
         self.pipeline = pipeline
         self.version = str(version)
+        # serving-precision + AOT labels, captured ONCE at handle build
+        # (json_scoring_pipeline forwards them from the model): every
+        # healthz/metrics/swap-audit surface reads the handle, so a
+        # rolling swap to a quantized or AOT-loaded model is auditable
+        # and the canary comparison is visibly like-for-like (or not)
+        self.precision = stage_precision(pipeline)
+        self.aot = bool(getattr(pipeline, "aot", False))
         # optional two-stage split (duck-typed; absent on plain stages)
         self.prepare = getattr(pipeline, "prepare_batch", None)
         self.execute = getattr(pipeline, "execute_prepared", None)
@@ -1196,6 +1205,8 @@ class ServingEngine:
                 "batches_processed": self.batches_processed,
                 "workers_restarted": self.workers_restarted,
                 "model_version": active.version,
+                "precision": active.precision,
+                "aot": active.aot,
                 "swap_state": self.swap_state,
                 "swaps_completed": self.swaps_completed,
                 "swaps_rolled_back": self.swaps_rolled_back,
@@ -1266,8 +1277,10 @@ class ServingEngine:
         r.counter("serving_swaps_rolled_back_total",
                   "model swaps rolled back", snap["swaps_rolled_back"])
         r.info("serving_model_info",
-               "active model version and swap state (labels)",
+               "active model version, precision, aot, swap state (labels)",
                {"version": snap["model_version"],
+                "precision": snap["precision"],
+                "aot": "true" if snap["aot"] else "false",
                 "swap_state": snap["swap_state"]})
         for name, hist in self.hists.items():
             r.histogram(f"serving_{name}",

@@ -90,7 +90,10 @@ class TestModelRegistry:
         assert reg.latest() == "v2"
         assert reg.previous("v2") == "v1"
         assert reg.previous("v1") is None
-        assert reg.metadata("v1") == {"auc": 0.9}
+        # explicit metadata survives; precision/aot auto-recorded at
+        # registration (the quantized/AOT rollout audit trail)
+        assert reg.metadata("v1") == {"auc": 0.9, "precision": "f32",
+                                      "aot": False}
 
     def test_duplicate_and_unknown_version(self):
         reg = ModelRegistry()

@@ -381,6 +381,125 @@ class TestAutoMLFloor:
         assert tuned2.get("bestParams") == tuned.get("bestParams")
 
 
+class TestQuantThroughputFloor:
+    """The int8 throughput claim, floor-pinned ONLY where the hardware
+    can show it: integer matmul doubles effective MXU batch throughput
+    on TPU-class chips, but this CI container's CPU backend has no
+    int8 systolic path (XLA's CPU int8 dot measures ~0.2x of its
+    oneDNN f32 gemm — BENCH_r10.json records that honestly, backend
+    labeled). Skipped off-TPU rather than asserted into fiction; the
+    backend-independent accuracy floors live in tests/test_quantize.py."""
+
+    def test_int8_batch_throughput_on_mxu_backends(self):
+        import jax
+        if jax.default_backend() != "tpu":
+            pytest.skip("int8 matmul advantage is an MXU-class claim; "
+                        f"backend is {jax.default_backend()}")
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        module = build_network({"type": "mlp", "features": [512, 256],
+                                "num_classes": 16})
+        dim, n = 256, 262_144
+        rng = np.random.default_rng(0)
+        x0 = np.zeros((1, dim), np.float32)
+        model = TPUModel.from_flax(
+            module, module.init(jax.random.PRNGKey(0), x0),
+            inputCol="features", outputCol="scores", batchSize=4096)
+        X = rng.normal(size=(n, dim)).astype(np.float32)
+        q = model.quantize({"features": X[:4096]})
+        t = DataTable({"features": X})
+        model.transform(DataTable({"features": X[:8192]}))
+        q.transform(DataTable({"features": X[:8192]}))
+
+        def best(fn, reps=3):
+            w = 1e18
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                fn()
+                w = min(w, time.perf_counter() - t0)
+            return w
+
+        f32_s = best(lambda: model.transform(t))
+        int8_s = best(lambda: q.transform(t))
+        # 2x is the theoretical MXU win; 1.3x floor leaves room for the
+        # f32 epilogue + host walls this batch path carries
+        assert f32_s / int8_s >= 1.3, (
+            f"int8 floor on TPU: {f32_s / int8_s:.2f}x "
+            f"(f32 {f32_s:.3f}s vs int8 {int8_s:.3f}s)")
+
+
+class TestColdStartFloor:
+    """AOT-compiled serving executables (serving/aot.py) vs
+    trace-at-startup, measured as fresh replica processes: the AOT path
+    must reach its first HTTP 200 >= 3x faster AND serve with zero JIT
+    traces — at load, warmup, and request time. The subject model is a
+    compile-bound transformer classifier (the model class cold-start
+    actually hurts on; a 2-layer MLP's compile is noise next to the
+    interpreter+jax import both modes pay). Idle-host calibration:
+    trace ~6.5 s, aot ~1.5 s => 4.4x; best-of-2 per mode rides out
+    shared-host noise above the 3x pin (BENCH_r10.json records the
+    measured numbers)."""
+
+    def test_aot_cold_start_3x_and_zero_request_traces(self, tmp_path):
+        import json
+        import os
+        import subprocess
+        import sys
+
+        import jax
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving import aot
+
+        module = build_network(
+            {"type": "transformer", "vocab_size": 2000, "dim": 128,
+             "depth": 4, "heads": 4, "max_len": 64, "num_classes": 8})
+        x0 = np.zeros((1, 64), np.int32)
+        m = TPUModel.from_flax(
+            module, module.init(jax.random.PRNGKey(0), x0),
+            inputCol="features", outputCol="scores", batchSize=64)
+        art = str(tmp_path / "lm_v1")
+        manifest = aot.export_model(m, {"features": x0}, art,
+                                    version="v1")
+        if manifest["format"] != "jax_export":
+            pytest.skip("jax.export unavailable: trace_cache artifacts "
+                        "re-trace at load (seeded-cache compiles only), "
+                        "so the zero-trace floor doesn't apply")
+        assert manifest["programs"] == len(manifest["buckets"])
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def run(mode: str, port: int):
+            proc = subprocess.run(
+                [sys.executable, "-m", "mmlspark_tpu.serving.aot", art,
+                 "--mode", mode, "--port", str(port)],
+                capture_output=True, text=True, cwd=repo,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=600)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+
+        best = {"trace": float("inf"), "aot": float("inf")}
+        last = {}
+        port = 19860
+        for _ in range(2):           # interleaved best-of-2 per mode
+            for mode in ("trace", "aot"):
+                r = run(mode, port)
+                port += 3
+                assert r["ok"], r
+                best[mode] = min(best[mode],
+                                 r["cold_start_to_first_200_ms"])
+                last[mode] = r
+        # the trace-at-startup replica really traced; the AOT replica
+        # NEVER did — not at load, not at warmup, not at request time
+        assert last["trace"]["jit_traces_total"] > 0
+        assert last["aot"]["jit_traces_total"] == 0, last["aot"]
+        assert last["aot"]["jit_traces_at_request_time"] == 0
+        ratio = best["trace"] / best["aot"]
+        assert ratio >= 3.0, (
+            f"AOT cold-start floor: {ratio:.2f}x "
+            f"(trace {best['trace']:.0f} ms vs aot {best['aot']:.0f} ms)")
+
+
 class TestPipelineFusionFloor:
     def test_fused_pipeline_speedup_floor(self):
         """Whole-pipeline fusion (core/fusion.py) vs the legacy

@@ -18,6 +18,14 @@ its DeviceOp) for host-round-trip constructs:
 - ``.item()`` / ``float(x)`` / ``int(x)`` on traced values are caught by
   the np/device_get rules' sibling: explicit ``.item(`` match.
 
+**Quantized kernels** (registered names containing ``:int8`` or under
+the ``quantize.`` prefix — core/quantize.py and the int8 device ops)
+additionally forbid any ``float64`` reference: the int8 contract is an
+i32 accumulator with an **f32** dequant epilogue, and a silent f64
+upcast there (an ``astype(jnp.float64)``, a f64 dtype literal) would
+halve MXU throughput and quietly change serving numerics vs the
+exported AOT programs.
+
 A line may be whitelisted with a trailing ``# fusion:host-ok`` comment
 (for genuinely trace-time-only host work, e.g. reading a static shape).
 
@@ -69,6 +77,12 @@ def _kernel_sources() -> List[Tuple[str, str, int, List[str]]]:
     return out
 
 
+def is_quantized_kernel(name: str) -> bool:
+    """Whether the f64-upcast rule applies: quantize.py helpers and the
+    int8 variants of the stage device ops."""
+    return ":int8" in name or name.startswith("quantize.")
+
+
 def _check_source(name: str, src: str, first: int,
                   lines: List[str]) -> List[str]:
     try:
@@ -76,6 +90,7 @@ def _check_source(name: str, src: str, first: int,
     except SyntaxError:
         return [f"{name}: unparseable kernel source"]
     violations: List[str] = []
+    check_f64 = is_quantized_kernel(name)
 
     def line_ok(lineno: int) -> bool:
         idx = lineno - 1
@@ -85,6 +100,7 @@ def _check_source(name: str, src: str, first: int,
 
     for node in ast.walk(tree):
         bad = None
+        f64 = None
         if isinstance(node, ast.Attribute):
             root = node.value
             while isinstance(root, ast.Attribute):
@@ -93,12 +109,25 @@ def _check_source(name: str, src: str, first: int,
                 bad = f"{root.id}.{node.attr}"
             elif node.attr in _FORBIDDEN_ATTRS:
                 bad = f".{node.attr}"
+            elif check_f64 and node.attr == "float64":
+                f64 = f".{node.attr}"
         elif isinstance(node, ast.Name) and node.id in _FORBIDDEN_ROOTS:
             bad = node.id
+        elif check_f64 and isinstance(node, ast.Name) \
+                and node.id == "float64":
+            f64 = node.id
+        elif check_f64 and isinstance(node, ast.Constant) \
+                and node.value == "float64":
+            f64 = "'float64'"
         if bad is not None and not line_ok(node.lineno):
             violations.append(
                 f"{name} (line {first + node.lineno - 1}): host "
                 f"round-trip construct {bad!r} inside a fused kernel")
+        if f64 is not None and not line_ok(node.lineno):
+            violations.append(
+                f"{name} (line {first + node.lineno - 1}): silent f64 "
+                f"upcast {f64!r} inside a quantized kernel (dequant "
+                f"epilogues are f32 by contract)")
     return violations
 
 
@@ -134,7 +163,14 @@ def register_known_callees() -> int:
         if fn is not None:
             register_kernel(fn, f"gbdt.objectives.{cls.__name__}.transform")
             count += 1
-    return count
+    # the int8 compute kernels every quantized device op calls, plus
+    # the flax interception wrapper (core/quantize.py) — these get the
+    # additional no-f64-upcast rule
+    from mmlspark_tpu.core import quantize as QZ
+    QZ._register_audit_kernels()
+    register_kernel(QZ.QuantizedFlaxApply.__call__,
+                    "quantize.QuantizedFlaxApply.__call__")
+    return count + 3
 
 
 def register_representative_pipelines() -> int:
@@ -208,6 +244,23 @@ def register_representative_pipelines() -> int:
     asm = FastVectorAssembler(inputCols=["a", "b"], outputCol="fv5")
     from mmlspark_tpu.core.stage import PipelineModel
     fuse(PipelineModel(stages=[asm, tm])).plan_for(table.schema)
+
+    # quantized variants: the int8 device ops of both linear families
+    # (":int8"-named kernels — the no-f64-upcast rule applies) and a
+    # quantized flax TPUModel forward
+    fuse(pm.fused().quantize(table)).plan_for(table.schema)
+    fuse(lin.fused().quantize(table)).plan_for(table.schema)
+    from mmlspark_tpu.models.networks import build_network
+    import jax as _jax
+    module = build_network({"type": "mlp", "features": [8],
+                            "num_classes": 2})
+    x8 = rng.normal(size=(n, 8)).astype(np.float32)
+    qtm = TPUModel.from_flax(
+        module, module.init(_jax.random.PRNGKey(0), x8[:1]),
+        inputCol="qfeat", outputCol="qscores",
+    ).quantize({"qfeat": x8})
+    qtable = table.with_column("qfeat", x8)
+    fuse(PipelineModel(stages=[qtm])).plan_for(qtable.schema)
 
     return len(KERNEL_REGISTRY)
 
