@@ -616,6 +616,206 @@ def bench_serving() -> dict:
     }
 
 
+INGRESS_ROWS = 1_000_000
+INGRESS_DIM = 16
+INGRESS_CHUNK = 1024
+INGRESS_SERVE_ROWS = 16_384
+INGRESS_ROWS_PER_REQ = 64
+
+
+def bench_ingress() -> dict:
+    """Columnar ingress vs the JSON oracle (io/columnar.py) — the
+    wire-to-device zero-copy scenario.
+
+    Two measurements, both on THIS container (backend-labeled):
+
+    1. **Codec microbench, 1M rows**: the server-side host work
+       (decode + batch assembly) of 1M feature rows arriving as
+       1024-row requests, per codec — JSON rows (the oracle's
+       ``json.loads`` + stack), msgpack-columns (zero-copy
+       ``np.frombuffer`` views), Arrow IPC. Pure ingress cost, no
+       model, no HTTP.
+
+    2. **Single-replica serving**: the same TPUModel MLP behind ONE
+       engine, sprayed by concurrent clients — JSON one-row requests
+       (the pre-existing protocol) vs msgpack-columns 64-row record
+       batches (the columnar client, ``fleet.post_columns``). Reports
+       rows/sec both ways, the speedup, the ingress phase breakdown
+       (negotiate/decode/assemble/pad p50s from /metrics), the host
+       fraction of request p50, and the steady-state recompile count
+       on the columnar path."""
+    import concurrent.futures
+
+    from mmlspark_tpu.core.metrics import (
+        ingress_decode_histograms, ingress_histograms,
+    )
+    from mmlspark_tpu.io import columnar as CIN
+    from mmlspark_tpu.models.networks import build_network
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    from mmlspark_tpu.serving.fleet import (
+        ServingFleet, json_scoring_pipeline,
+    )
+
+    import jax
+
+    rng = np.random.default_rng(7)
+
+    # -- 1. codec microbench at 1M rows ---------------------------------
+    n_chunks = INGRESS_ROWS // INGRESS_CHUNK
+    n_rows = n_chunks * INGRESS_CHUNK     # whole requests only
+    feats = rng.normal(size=(n_rows, INGRESS_DIM))
+    chunks = [feats[i * INGRESS_CHUNK:(i + 1) * INGRESS_CHUNK]
+              for i in range(n_chunks)]
+
+    def decode_json(bodies):
+        # the oracle's decode: one row object per request
+        return np.stack([
+            np.asarray(json.loads(b.decode())["features"],
+                       dtype=np.float32)
+            for b in bodies])
+
+    def decode_columnar(codec, bodies):
+        return np.concatenate([
+            np.asarray(CIN.decode_columnar(codec, b)
+                       .columns["features"], dtype=np.float32)
+            for b in bodies])
+
+    codec_results = {}
+    json_bodies = [json.dumps({"features": row.tolist()}).encode()
+                   for row in feats[:INGRESS_CHUNK]]  # 1 chunk as rows
+    t0 = time.perf_counter()
+    ref = decode_json(json_bodies)
+    json_row_wall = (time.perf_counter() - t0) * n_chunks  # scaled to 1M
+    codec_results["json_rows"] = {
+        "decode_assemble_s": round(json_row_wall, 2),
+        "rows_per_s": round(n_rows / json_row_wall),
+        "note": f"measured on {INGRESS_CHUNK} rows, scaled x{n_chunks}",
+    }
+    codecs = ["msgpack"] + (["arrow"] if CIN._pyarrow() else [])
+    for codec in codecs:
+        bodies = [CIN.encode_columns({"features": c}, codec=codec)[0]
+                  for c in chunks]
+        t0 = time.perf_counter()
+        out = decode_columnar(codec, bodies)
+        wall = time.perf_counter() - t0
+        assert out.shape == (n_rows, INGRESS_DIM)
+        np.testing.assert_array_equal(
+            out[:INGRESS_CHUNK], ref)   # bit parity with the oracle
+        codec_results[codec] = {
+            "decode_assemble_s": round(wall, 3),
+            "rows_per_s": round(n_rows / wall),
+            "speedup_vs_json": round(json_row_wall / wall, 1),
+        }
+    del feats, chunks
+
+    # -- 2. single-replica serving, JSON rows vs columnar batches -------
+    module = build_network({"type": "mlp", "features": [256, 128],
+                            "num_classes": 10})
+    x0 = np.zeros((1, SERVING_FEATURE_DIM), np.float32)
+    weights = {"params": module.init(
+        jax.random.PRNGKey(0), x0)["params"]}
+    model = TPUModel(modelFn=lambda w, ins: module.apply(
+        {"params": w["params"]}, list(ins.values())[0]),
+        weights=weights, inputCol="features", outputCol="scores",
+        batchSize=256, computeDtype="float32")
+    model.warmup({"features": x0})
+    fleet = ServingFleet(json_scoring_pipeline(model), n_engines=1,
+                         base_port=19000, batch_size=256, workers=2,
+                         max_wait_ms=SERVING_MAX_WAIT_MS)
+    x = rng.normal(size=(INGRESS_ROWS_PER_REQ, SERVING_FEATURE_DIM))
+    json_payload = json.dumps(
+        {"features": x[0].tolist()}).encode()
+    col_payload, col_ct = CIN.encode_columns({"features": x})
+
+    def run_side(post_one, n_requests, rows_per_req):
+        lat = []
+
+        def post(_i):
+            t0 = time.perf_counter()
+            body = post_one()
+            assert "prediction" in body, body
+            return (time.perf_counter() - t0) * 1e3
+
+        for _ in range(4):
+            post(0)     # warm the live path
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(SERVING_CLIENTS) as ex:
+            futs = [ex.submit(post, i) for i in range(n_requests)]
+            for f in concurrent.futures.as_completed(futs):
+                lat.append(f.result())
+        wall = time.perf_counter() - t0
+        lat = np.asarray(lat)
+        return {
+            "rows_per_s": round(n_requests * rows_per_req / wall, 1),
+            "qps": round(n_requests / wall, 1),
+            "p50_ms": round(float(np.percentile(lat, 50)), 2),
+            "p99_ms": round(float(np.percentile(lat, 99)), 2),
+        }
+
+    def _p50(hist):
+        return round(hist.summary().get("p50", 0.0), 4)
+
+    try:
+        json_side = run_side(
+            lambda: fleet.post(json_payload, timeout=60),
+            SERVING_REQUESTS, 1)
+        misses_before = model.jit_cache_misses
+        # the phase histograms are process-wide: RESET between sides
+        # so the columnar host-fraction is measured on the columnar
+        # workload alone, not diluted by the JSON side's samples
+        for h in ingress_histograms().values():
+            h.reset()
+        for h in ingress_decode_histograms().values():
+            h.reset()
+        model._hists["pad_ms"].reset()
+        # pre-encoded payload, like the JSON side: the server-side
+        # ingress is under test, not client-side encode CPU
+        col_side = run_side(
+            lambda: fleet.post(col_payload, timeout=60,
+                               content_type=col_ct),
+            INGRESS_SERVE_ROWS // INGRESS_ROWS_PER_REQ,
+            INGRESS_ROWS_PER_REQ)
+        recompiles = model.jit_cache_misses - misses_before
+        ih = ingress_histograms()
+        dh = ingress_decode_histograms()
+        phases = {
+            "negotiate": _p50(ih["negotiate"]),
+            "assemble": _p50(ih["assemble"]),
+            "decode": {c: _p50(h) for c, h in dh.items()},
+        }
+        stage = fleet.metrics()["aggregate"].get("pipeline_stage", {})
+        pad_p50 = stage.get("pad_ms", {}).get("p50", 0.0) or 0.0
+        phases["pad"] = round(pad_p50, 4)
+        host_ms = (phases["negotiate"] + phases["assemble"]
+                   + phases["decode"].get("msgpack", 0.0) + pad_p50)
+        host_fraction = (host_ms / col_side["p50_ms"]
+                         if col_side["p50_ms"] else 0.0)
+    finally:
+        fleet.stop_all()
+
+    return {
+        "metric": "columnar_ingress_rows_per_s",
+        "value": col_side["rows_per_s"],
+        "unit": "rows/sec (single replica, msgpack-columns, "
+                f"{INGRESS_ROWS_PER_REQ}-row requests)",
+        "codec_1m_rows": codec_results,
+        "serving_json_rows": json_side,
+        "serving_columnar": col_side,
+        "serving_speedup_rows_per_s": round(
+            col_side["rows_per_s"] / json_side["rows_per_s"], 2),
+        "ingress_phase_p50_ms": phases,
+        "host_fraction_of_p50": round(host_fraction, 4),
+        "steady_state_recompiles": recompiles,
+        "config": (f"codec bench {INGRESS_ROWS} rows x {INGRESS_DIM} f64"
+                   f" in {INGRESS_CHUNK}-row requests; serving 1 engine"
+                   f" x 2 workers, MLP-{SERVING_FEATURE_DIM}, "
+                   f"{SERVING_REQUESTS} JSON 1-row reqs vs "
+                   f"{INGRESS_SERVE_ROWS // INGRESS_ROWS_PER_REQ} "
+                   f"msgpack {INGRESS_ROWS_PER_REQ}-row reqs, "
+                   f"{SERVING_CLIENTS} clients"),
+    }
+
+
 OBS_REQUESTS = 400
 OBS_REPS = 2
 
@@ -1036,6 +1236,7 @@ SCENARIOS = {
                               bench_observability()),
     "quant": lambda: ("secondary_quant", bench_quant()),
     "coldstart": lambda: ("secondary_coldstart", bench_coldstart()),
+    "ingress": lambda: ("secondary_ingress", bench_ingress()),
 }
 
 
@@ -1045,8 +1246,8 @@ def main():
     ap.add_argument(
         "--scenarios", default="all",
         help="comma list from {cifar,resnet,lm,higgs,serving,swap,"
-             "automl,pipeline,observability,quant,coldstart} or 'all' "
-             "(the full flagship bench)")
+             "automl,pipeline,observability,quant,coldstart,ingress} "
+             "or 'all' (the full flagship bench)")
     args = ap.parse_args()
     if args.scenarios != "all":
         _enable_compile_cache()

@@ -270,6 +270,51 @@ def pipeline_histograms() -> Dict[str, LatencyHistogram]:
 
 
 # ---------------------------------------------------------------------------
+# serving-ingress phase histograms (columnar ingress — io/columnar.py)
+# ---------------------------------------------------------------------------
+
+# per-batch wall milliseconds of the serving ingress path: negotiate
+# (per-request Content-Type codec pick), assemble (column concatenation
+# + batch table build — no row dicts on the columnar path), pad (copy
+# into the reused per-bucket staging buffers). Decode is tracked
+# SEPARATELY per codec (the `codec` label on /metrics and the decode
+# trace spans) via ``ingress_decode_histogram`` so the columnar-vs-JSON
+# host-cost claim is auditable from one scrape. All together these are
+# the "host phases" of the <20%-of-p50 serving target (ROADMAP
+# wire-to-device zero-copy).
+INGRESS_PHASES = ("negotiate", "assemble", "pad")
+_INGRESS_HISTS: Dict[str, LatencyHistogram] = histogram_set(
+    *INGRESS_PHASES)
+_INGRESS_DECODE: Dict[str, LatencyHistogram] = {}
+_INGRESS_DECODE_LOCK = threading.Lock()
+
+
+def ingress_histograms() -> Dict[str, LatencyHistogram]:
+    """The process-wide serving-ingress phase histogram family
+    (negotiate/assemble/pad; decode is per-codec — see
+    ``ingress_decode_histograms``)."""
+    return _INGRESS_HISTS
+
+
+def ingress_decode_histogram(codec: str) -> LatencyHistogram:
+    """The decode histogram for one codec (``json``/``msgpack``/
+    ``arrow``), created on first use."""
+    hist = _INGRESS_DECODE.get(codec)
+    if hist is None:
+        with _INGRESS_DECODE_LOCK:
+            hist = _INGRESS_DECODE.get(codec)
+            if hist is None:
+                hist = _INGRESS_DECODE[codec] = LatencyHistogram()
+    return hist
+
+
+def ingress_decode_histograms() -> Dict[str, LatencyHistogram]:
+    """Snapshot of the per-codec decode histograms seen so far."""
+    with _INGRESS_DECODE_LOCK:
+        return dict(_INGRESS_DECODE)
+
+
+# ---------------------------------------------------------------------------
 # feature-drift counters (serving-time vs fit-time statistics)
 # ---------------------------------------------------------------------------
 

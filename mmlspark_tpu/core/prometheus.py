@@ -147,6 +147,16 @@ def process_families(r: PromRenderer, tracer: Any = None) -> None:
         r.histogram("pipeline_fusion_phase_ms",
                     "fused-pipeline per-phase wall milliseconds "
                     "(core/fusion.py)", hist, {"phase": phase})
+    for phase, hist in MC.ingress_histograms().items():
+        r.histogram("serving_ingress_phase_ms",
+                    "serving ingress per-phase wall milliseconds "
+                    "(io/columnar.py; decode carries a codec label)",
+                    hist, {"phase": phase})
+    for codec, hist in MC.ingress_decode_histograms().items():
+        r.histogram("serving_ingress_phase_ms",
+                    "serving ingress per-phase wall milliseconds "
+                    "(io/columnar.py; decode carries a codec label)",
+                    hist, {"phase": "decode", "codec": codec})
     for name, hist in MC.warmup_histograms().items():
         r.histogram(f"serving_{name}",
                     "per-bucket serving warmup compile wall "

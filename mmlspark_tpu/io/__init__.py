@@ -1,6 +1,13 @@
-"""IO readers (ref: src/io/src/main/scala/Readers.scala:14-46)."""
+"""IO readers (ref: src/io/src/main/scala/Readers.scala:14-46) and the
+columnar serving-ingress codecs (io/columnar.py)."""
 
 from mmlspark_tpu.io.binary import read_binary_files
+from mmlspark_tpu.io.columnar import (
+    CodecError, ColumnarBatch, StagingPool, decode_columnar,
+    encode_columns, negotiate,
+)
 from mmlspark_tpu.io.image import read_images, write_images
 
-__all__ = ["read_binary_files", "read_images", "write_images"]
+__all__ = ["CodecError", "ColumnarBatch", "StagingPool",
+           "decode_columnar", "encode_columns", "negotiate",
+           "read_binary_files", "read_images", "write_images"]

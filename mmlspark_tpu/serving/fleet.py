@@ -86,13 +86,22 @@ def json_scoring_pipeline(model, field: str = "features",
     tracing contracts identical to the single-model path. See
     ``_FusedPipelineScorer``.
 
+    Both paths speak the COLUMNAR ingress protocol alongside JSON
+    (io/columnar.py, docs/columnar_ingress.md): a request whose
+    Content-Type negotiates msgpack-columns or Arrow IPC carries typed
+    column buffers for ANY number of rows — decode is a zero-copy
+    buffer view, assembly concatenates columns without per-row Python
+    objects, and the reply carries one value per row. JSON stays the
+    bit-parity oracle; a body that fails its negotiated codec is 400d
+    alone while batch-mates proceed.
+
     The returned stage exposes the ServingEngine two-stage split:
-    ``prepare_batch`` (JSON decode + stack — pure host work the batcher
-    thread runs while the device executes the previous batch) and
-    ``execute_prepared`` (model forward + reply build, run by a
-    worker). ``transform`` remains the single-stage fallback — the
-    per-row poison-isolation retry and non-pipelined embeddings use
-    it.
+    ``prepare_batch`` (codec negotiate + decode + column assembly —
+    pure host work the batcher thread runs while the device executes
+    the previous batch) and ``execute_prepared`` (model forward +
+    reply build, run by a worker). ``transform`` remains the
+    single-stage fallback — the per-row poison-isolation retry and
+    non-pipelined embeddings use it.
 
     ``drift_monitor`` (a ``core.metrics.DriftMonitor``) makes the stage
     observe every decoded feature batch, so per-feature mean/var/null
@@ -119,13 +128,98 @@ def json_scoring_pipeline(model, field: str = "features",
             model, reply_field=reply_field, reply_col=reply_col,
             batch_size=batch_size).stage()
 
-    def decode(table: DataTable) -> "np.ndarray":
-        return np.stack([
-            np.asarray(json.loads(r["entity"].decode())[field],
-                       dtype=np.float32)
-            for r in table["request"]])
+    from mmlspark_tpu.core.metrics import (
+        ingress_decode_histogram, ingress_histograms,
+    )
+    from mmlspark_tpu.io import columnar as CIN
 
-    def execute(table: DataTable, feats) -> DataTable:
+    # feature dim confirmed by the last SUCCESSFUL score: columnar
+    # requests with a mismatching width 400 instead of poisoning the
+    # micro-batch. Learned only after success, so a bad first request
+    # can never teach the scorer the wrong width.
+    _state = {"dim": None}
+
+    def decode(table: DataTable) -> CIN.PreparedBatch:
+        """Per-request codec negotiation + decode + column assembly:
+        JSON bodies stay the bit-parity oracle (same parse, same f32
+        cast as always); columnar bodies become zero-copy (rows, dim)
+        views concatenated without any per-row Python object. Requests
+        that fail their negotiated codec land in ``rejects`` — the
+        engine 400s exactly those and dispatches the rest."""
+        reqs = table["request"]
+        ids = (list(table["id"]) if "id" in table.column_names
+               else [str(i) for i in range(len(reqs))])
+        hists = ingress_histograms()
+        t_neg = time.perf_counter()
+        codecs = [CIN.negotiate(r.get("headers")) for r in reqs]
+        hists["negotiate"].observe(
+            (time.perf_counter() - t_neg) * 1e3)
+        segs: List["np.ndarray"] = []
+        spans: List[tuple] = []
+        rejects: Dict[str, str] = {}
+        counts: Dict[str, int] = {}
+        pos = 0
+        ref_dim = _state["dim"]
+        for rid, r, codec in zip(ids, reqs, codecs):
+            t0 = time.perf_counter()
+            try:
+                if codec == "json":
+                    row = json.loads(r["entity"].decode())
+                    feat = np.asarray(row[field], dtype=np.float32)
+                    if feat.ndim != 1:
+                        raise CIN.CodecError(
+                            f"{field!r} must be a flat number list")
+                    seg = feat[None, :]
+                else:
+                    batch = CIN.decode_columnar(codec, r["entity"])
+                    col = batch.columns.get(field)
+                    if col is None:
+                        raise CIN.CodecError(
+                            f"missing column {field!r}")
+                    col = np.asarray(col)
+                    if col.ndim != 2:
+                        raise CIN.CodecError(
+                            f"{field!r} must be (rows, dim); "
+                            f"got shape {col.shape}")
+                    seg = np.asarray(col, dtype=np.float32)
+                d = seg.shape[1]
+                if ref_dim is None:
+                    ref_dim = d       # within-batch reference
+                elif d != ref_dim:
+                    raise CIN.CodecError(
+                        f"feature dim {d} != expected {ref_dim}")
+            except Exception as e:  # noqa: BLE001 — reject THIS request
+                rejects[rid] = f"{type(e).__name__}: {e}"
+                continue
+            ingress_decode_histogram(codec).observe(
+                (time.perf_counter() - t0) * 1e3)
+            if seg.shape[0]:
+                segs.append(seg)
+            spans.append((pos, pos + seg.shape[0], codec))
+            pos += seg.shape[0]
+            counts[codec] = counts.get(codec, 0) + 1
+        t_asm = time.perf_counter()
+        if not segs:
+            feats = np.zeros((0, ref_dim or 0), dtype=np.float32)
+        elif len(segs) == 1:
+            feats = segs[0]   # zero-copy: the request-body view itself
+        else:
+            feats = np.concatenate(segs, axis=0)
+        hists["assemble"].observe(
+            (time.perf_counter() - t_asm) * 1e3)
+        return CIN.PreparedBatch(feats, rejects, spans, counts)
+
+    def execute(table: DataTable, prepped) -> DataTable:
+        if isinstance(prepped, np.ndarray):
+            # legacy embedders handing a raw feature matrix
+            prepped = CIN.PreparedBatch(
+                prepped, spans=[(i, i + 1, "json")
+                                for i in range(prepped.shape[0])])
+        feats = prepped.payload
+        if feats.shape[0] == 0:
+            # every surviving request carried zero rows
+            return table.with_column(
+                "reply", [{reply_field: []} for _ in prepped.spans])
         scored = model.transform(DataTable({field: feats}))
         # drift counts SERVED batches, observed exactly once AFTER a
         # successful score: a failed batch re-runs through the per-row
@@ -135,11 +229,24 @@ def json_scoring_pipeline(model, field: str = "features",
         if drift_monitor is not None:
             drift_monitor.observe(feats)
         preds = np.asarray(scored[model.get("outputCol")]).argmax(-1)
-        return table.with_column(
-            "reply", [{reply_field: int(p)} for p in preds])
+        _state["dim"] = feats.shape[1]
+        replies = []
+        for s, e, codec in prepped.spans:
+            if codec == "json":
+                replies.append({reply_field: int(preds[s])})
+            else:
+                # columnar requests reply one value PER ROW they carried
+                replies.append(
+                    {reply_field: [int(p) for p in preds[s:e]]})
+        return table.with_column("reply", replies)
 
     def handle(table: DataTable) -> DataTable:
-        return execute(table, decode(table))
+        prepped = decode(table)
+        if prepped.rejects:
+            # single-stage callers (per-row retry, embedders) have no
+            # reject channel: surface the codec error as the row error
+            raise CIN.CodecError("; ".join(prepped.rejects.values()))
+        return execute(table, prepped)
 
     lam = Lambda.apply(handle)
     lam.prepare_batch = decode
@@ -237,13 +344,25 @@ class _FusedPipelineScorer:
                  reply_col: str = None, batch_size: int = 256):
         import numpy as np
         from mmlspark_tpu.core.fusion import FusedPipelineModel
+        from mmlspark_tpu.io import columnar as CIN
         self.np = np
+        self.cin = CIN
         self.fused = pipeline if isinstance(pipeline, FusedPipelineModel) \
             else pipeline.fused(batch_size=batch_size)
         self.reply_field = reply_field
         self.reply_col = reply_col or self._default_reply_col()
         self._row_names: List[str] = []
         self._names_lock = threading.Lock()
+        # pre-pinned, per-bucket reused host staging buffers for the
+        # edge-pad copy (io/columnar.py StagingPool); the padded buffer
+        # is handed to the donated fused dispatch
+        self._staging = CIN.StagingPool()
+        # per-column trailing shapes CONFIRMED by the last successful
+        # batch — the schema-mismatch guard's trusted reference, so a
+        # wrong-shaped request that happens to decode FIRST in a
+        # micro-batch cannot get its well-formed batch-mates rejected
+        # (only the very first batch ever falls back to first-seen)
+        self._confirmed_shapes: Dict[str, tuple] = {}
         # D2H fetches per scored batch (the "at most one device round
         # trip" guarantee, asserted by tests): bumped once per fetch
         self.device_roundtrips = 0
@@ -258,33 +377,96 @@ class _FusedPipelineScorer:
 
     # -- decode --------------------------------------------------------------
 
-    def _raw_table(self, table: DataTable) -> DataTable:
-        rows = [json.loads(r["entity"].decode())
-                for r in table["request"]]
-        # pinned column ORDER, growing set: first-seen order keeps the
-        # schema signature — and so the compiled fused programs — from
-        # churning with clients' JSON key ordering, while a key the
-        # first batch happened to omit is APPENDED when it first
-        # appears (one replan/compile, never a silently dropped field)
+    def _decode_requests(self, table: DataTable):
+        """Per-request negotiate + decode: JSON bodies parse to row
+        dicts (the oracle), columnar bodies decode to zero-copy
+        ``ColumnarBatch`` views. Returns ``(decoded, spans, rejects,
+        codec_counts)`` where ``decoded``/``spans`` cover only the
+        SURVIVING requests (rejects keyed by request id)."""
+        from mmlspark_tpu.core.metrics import (
+            ingress_decode_histogram, ingress_histograms,
+        )
+        import time as _time
+        CIN = self.cin
+        reqs = table["request"]
+        ids = (list(table["id"]) if "id" in table.column_names
+               else [str(i) for i in range(len(reqs))])
+        t_neg = _time.perf_counter()
+        codecs = [CIN.negotiate(r.get("headers")) for r in reqs]
+        ingress_histograms()["negotiate"].observe(
+            (_time.perf_counter() - t_neg) * 1e3)
+        decoded: List[Any] = []
+        spans: List[tuple] = []
+        rejects: Dict[str, str] = {}
+        counts: Dict[str, int] = {}
+        # trusted reference first (shapes the last SUCCESSFUL batch
+        # scored with); unseen columns fall back to first-seen within
+        # this batch
+        ref_shapes: Dict[str, tuple] = dict(self._confirmed_shapes)
+        pos = 0
+        for rid, r, codec in zip(ids, reqs, codecs):
+            t0 = _time.perf_counter()
+            try:
+                if codec == "json":
+                    item = json.loads(r["entity"].decode())
+                    if not isinstance(item, dict):
+                        raise CIN.CodecError(
+                            "JSON request body must be a row object")
+                    n = 1
+                else:
+                    item = CIN.decode_columnar(codec, r["entity"])
+                    n = item.n_rows
+                    # schema-mismatch isolation: a request whose column
+                    # widths disagree with its batch-mates 400s alone
+                    # instead of breaking the whole concatenation
+                    for name, col in item.columns.items():
+                        if not isinstance(col, self.np.ndarray):
+                            continue
+                        ref = ref_shapes.get(name)
+                        if ref is None:
+                            ref_shapes[name] = col.shape[1:]
+                        elif col.shape[1:] != ref:
+                            raise CIN.CodecError(
+                                f"column {name!r} shape {col.shape[1:]}"
+                                f" != batch shape {ref}")
+            except Exception as e:  # noqa: BLE001 — reject THIS request
+                rejects[rid] = f"{type(e).__name__}: {e}"
+                continue
+            ingress_decode_histogram(codec).observe(
+                (_time.perf_counter() - t0) * 1e3)
+            decoded.append(item)
+            spans.append((pos, pos + n, codec))
+            pos += n
+            counts[codec] = counts.get(codec, 0) + 1
+        return decoded, spans, rejects, counts, ref_shapes
+
+    def _assemble(self, decoded: List[Any], total_rows: int) -> DataTable:
+        """One batch table from per-request decoded items — columns
+        concatenate buffer views; NO per-row dicts are built for
+        columnar requests. Column ORDER is pinned, growing: first-seen
+        order keeps the schema signature — and so the compiled fused
+        programs — from churning with clients' key ordering, while a
+        key the first batch happened to omit is APPENDED when it first
+        appears (one replan/compile, never a silently dropped field)."""
+        CIN = self.cin
         with self._names_lock:
             known = set(self._row_names)
-            for r in rows:
-                for k in r:
+            for item in decoded:
+                keys = (item.columns if isinstance(item, CIN.ColumnarBatch)
+                        else item)
+                for k in keys:
                     if k not in known:
                         self._row_names.append(k)
                         known.add(k)
             names = list(self._row_names)
-        return DataTable({n: [r.get(n) for r in rows] for n in names})
+        return DataTable({n: CIN.assemble_column(decoded, n, total_rows)
+                          for n in names})
 
-    def _pad(self, arr, bucket: int):
-        n = arr.shape[0]
-        if n >= bucket:
-            return arr
-        # edge-pad with copies of the last row: valid inputs, so
-        # normalization/log paths can't NaN-poison (TPUModel discipline)
-        reps = self.np.concatenate(
-            [arr, self.np.repeat(arr[-1:], bucket - n, axis=0)], axis=0)
-        return reps
+    def _pad(self, name: str, arr, bucket: int):
+        # edge-pad with copies of the last row into the REUSED staging
+        # buffer: valid inputs, so normalization/log paths can't
+        # NaN-poison (TPUModel discipline); no per-batch allocation
+        return self._staging.pad(name, self.np.asarray(arr), bucket)
 
     # -- the two-stage split -------------------------------------------------
 
@@ -292,9 +474,22 @@ class _FusedPipelineScorer:
         from mmlspark_tpu.core.fusion import (
             FusedSegment, load_column_f32, pipeline_histograms,
         )
+        from mmlspark_tpu.core.metrics import ingress_histograms
         import time as _time
         t0 = _time.perf_counter()
-        raw = self._raw_table(table)
+        decoded, spans, rejects, codecs, shapes = \
+            self._decode_requests(table)
+        total = spans[-1][1] if spans else 0
+        if total == 0:
+            # nothing decodable (all rejected and/or zero-row batches)
+            return self.cin.PreparedBatch(("empty",), rejects, spans,
+                                          codecs)
+        t_asm = _time.perf_counter()
+        raw = self._assemble(decoded, total)
+        ingress_histograms()["assemble"].observe(
+            (_time.perf_counter() - t_asm) * 1e3)
+        envelope = self.cin.PreparedBatch(None, rejects, spans, codecs,
+                                          meta={"shapes": shapes})
         plan = self.fused.plan_for(raw.schema,
                                    final_needed={self.reply_col})
         cur = raw
@@ -307,7 +502,8 @@ class _FusedPipelineScorer:
         if seg_idx is None:
             # no device segment anywhere: cur IS the scored table —
             # execute() must only read the reply out of it
-            return ("host", plan, cur, len(raw))
+            envelope.payload = ("host", plan, cur, total)
+            return envelope
         seg = plan.steps[seg_idx]
         n = len(cur)
         bucket = self.fused.bucket_for(n)
@@ -322,26 +518,48 @@ class _FusedPipelineScorer:
                 [self.np.arange(n),
                  self.np.full(bucket - n, n - 1, dtype=self.np.int64)])
             cur = cur._take_indices(idx)
+        t_pad = _time.perf_counter()
         feeds: Dict[str, Any] = {}
         for col in seg.external_reads:
-            feeds[col] = self._pad(load_column_f32(cur, col), bucket)
+            feeds[col] = self._pad(col, load_column_f32(cur, col), bucket)
         for feed in seg.feeds:
-            feeds[feed.name] = self._pad(feed.load(cur), bucket)
+            feeds[feed.name] = self._pad(feed.name, feed.load(cur),
+                                         bucket)
+        ingress_histograms()["pad"].observe(
+            (_time.perf_counter() - t_pad) * 1e3)
         pipeline_histograms()["prepare"].observe(
             (_time.perf_counter() - t0) * 1e3)
-        return ("fused", plan, cur, n, seg_idx, feeds)
+        envelope.payload = ("fused", plan, cur, n, seg_idx, feeds)
+        return envelope
+
+    def _commit_shapes(self, prepped) -> None:
+        """Latch this batch's per-column shapes as the trusted
+        mismatch-guard reference — called only AFTER a successful
+        score, so a bad batch can never teach the guard wrong widths
+        (attribute store is atomic; last writer wins)."""
+        shapes = prepped.meta.get("shapes")
+        if shapes:
+            self._confirmed_shapes = shapes
 
     def execute(self, table: DataTable, prepped) -> DataTable:
         import time as _time
         from mmlspark_tpu.core.fusion import pipeline_histograms
-        if prepped[0] == "host":
+        spans = prepped.spans
+        payload = prepped.payload
+        if payload[0] == "empty":
+            # every surviving request carried zero rows
+            self.batches_scored += 1
+            return table.with_column(
+                "reply", [{self.reply_field: []} for _ in spans])
+        if payload[0] == "host":
             # prepare() already ran every (host) step — re-executing
             # the plan would double-transform non-idempotent stages
-            _, plan, cur, n = prepped
+            _, plan, cur, n = payload
             self.batches_scored += 1
+            self._commit_shapes(prepped)
             return self._reply(table, self.np.asarray(
-                cur[self.reply_col])[:n])
-        _, plan, cur, n, seg_idx, feeds = prepped
+                cur[self.reply_col])[:n], spans)
+        _, plan, cur, n, seg_idx, feeds = payload
         seg = plan.steps[seg_idx]
         t0 = _time.perf_counter()
         consts = seg.consts_list(plan.device_table)
@@ -357,7 +575,8 @@ class _FusedPipelineScorer:
             self.batches_scored += 1
             pipeline_histograms()["device"].observe(
                 (_time.perf_counter() - t0) * 1e3)
-            return self._reply(table, vals)
+            self._commit_shapes(prepped)
+            return self._reply(table, vals, spans)
         # general tail (multi-segment / trailing host stages): fold the
         # segment's live outputs back — at FULL bucket length, so the
         # tail segments keep seeing padded shapes and never retrace per
@@ -386,10 +605,12 @@ class _FusedPipelineScorer:
         self.batches_scored += 1
         pipeline_histograms()["device"].observe(
             (_time.perf_counter() - t0) * 1e3)
+        self._commit_shapes(prepped)
         return self._reply(table,
-                           self.np.asarray(cur[self.reply_col])[:n])
+                           self.np.asarray(cur[self.reply_col])[:n],
+                           spans)
 
-    def _reply(self, table: DataTable, vals) -> DataTable:
+    def _reply(self, table: DataTable, vals, spans) -> DataTable:
         def jsonify(v):
             if self.np.ndim(v) >= 1:
                 # vector reply columns (probability / rawPrediction)
@@ -397,12 +618,27 @@ class _FusedPipelineScorer:
             v = float(v)
             return int(v) if v.is_integer() else v
 
-        out = [{self.reply_field: jsonify(v)} for v in vals]
+        out = []
+        for s, e, codec in spans:
+            if codec == "json":
+                # the oracle shape: one scalar reply per request row
+                out.append({self.reply_field: jsonify(vals[s])})
+            else:
+                # columnar requests reply one value PER ROW they carried
+                out.append({self.reply_field:
+                            [jsonify(v) for v in vals[s:e]]})
         return table.with_column("reply", out)
 
     def transform(self, table: DataTable) -> DataTable:
         """Single-stage fallback (per-row poison retry, embeddings)."""
-        return self.execute(table, self.prepare(table))
+        prepped = self.prepare(table)
+        if prepped.rejects:
+            # single-stage callers have no reject channel: surface the
+            # codec error as the row error (the engine's main path 400s
+            # rejects before dispatch, so this only fires for embedders)
+            raise self.cin.CodecError(
+                "; ".join(prepped.rejects.values()))
+        return self.execute(table, prepped)
 
     # -- serving hooks -------------------------------------------------------
 
@@ -515,6 +751,19 @@ class ServingFleet:
         self.hedge_min_s = hedge_min_s
         self._latencies: "deque[float]" = deque(maxlen=256)
         self._probe_lock = threading.Lock()   # single-flight all-open probe
+        # columnar-ingress negotiation memory: flips False after a
+        # columnar POST was rejected AND its JSON retry succeeded (a
+        # JSON-only engine) so later post_columns calls skip the
+        # doomed columnar attempt (the stale-conn retry discipline:
+        # pay the discovery once, remember the verdict). The verdict
+        # is a COOLDOWN, not a life sentence — a transient 500 that
+        # happened to mimic a negotiation failure must not degrade
+        # the client to per-row JSON forever, so after
+        # ``columnar_retry_cooldown_s`` the next call re-probes the
+        # columnar path (and resets the flag on success).
+        self._columnar_ok = True
+        self.columnar_retry_cooldown_s = 60.0
+        self._columnar_retry_at = 0.0
         port = base_port
         try:
             for _ in range(n_engines):
@@ -587,8 +836,9 @@ class ServingFleet:
 
     @classmethod
     def _http_post(cls, addr: str, body: bytes, timeout: float,
-                   replayable: bool = True,
-                   pooled: bool = True) -> Dict[str, Any]:
+                   replayable: bool = True, pooled: bool = True,
+                   content_type: str = "application/json",
+                   ) -> Dict[str, Any]:
         """POST over a pooled keep-alive connection (HTTP/1.1): the
         serving hot path pays no TCP handshake and spawns no server
         thread per request. App-level statuses surface as
@@ -608,7 +858,7 @@ class ServingFleet:
         caller's failover policy decides."""
         import time as _time
         t0 = _time.perf_counter()
-        headers = {"Content-Type": "application/json"}
+        headers = {"Content-Type": content_type}
         for attempt in (0, 1):
             if pooled:
                 conn = cls._pooled_conn(addr, timeout)
@@ -714,7 +964,8 @@ class ServingFleet:
             breaker.record_failure()
 
     def _attempt(self, i: int, body: bytes, timeout: float, tried: set,
-                 allow_hedge: bool) -> Dict[str, Any]:
+                 allow_hedge: bool,
+                 content_type: str = "application/json") -> Dict[str, Any]:
         """One logical attempt against engine ``i``, hedged onto another
         replica if allowed and the reply is slower than the hedge
         threshold. ALL breaker recording happens here — for a hedged
@@ -731,7 +982,8 @@ class ServingFleet:
                 # idempotent requests may transparently replay a
                 # response-phase stale-connection failure
                 result = self._http_post(addr, body, timeout,
-                                         replayable=allow_hedge)
+                                         replayable=allow_hedge,
+                                         content_type=content_type)
             except Exception as e:
                 self._classify_and_record(breaker, e)
                 raise
@@ -743,7 +995,7 @@ class ServingFleet:
         # each call would strand a keep-alive conn in a dead thread's
         # local storage (hedging only runs for idempotent requests)
         f1 = self._submit(self._http_post, addr, body, timeout,
-                          True, False)
+                          True, False, content_type)
         f1.add_done_callback(
             lambda f: self._classify_and_record(breaker, f.exception()))
         try:
@@ -764,7 +1016,7 @@ class ServingFleet:
             self.hedged_requests += 1
         tried.add(j)   # the hedge consumed replica j for this request
         f2 = self._submit(self._http_post, self.addresses[j], body,
-                          timeout, True, False)
+                          timeout, True, False, content_type)
         f2.add_done_callback(
             lambda f: self._classify_and_record(self.breakers[j],
                                                 f.exception()))
@@ -790,7 +1042,8 @@ class ServingFleet:
     # -- the client --------------------------------------------------------
 
     def post(self, payload: Any, timeout: float = 30.0,
-             idempotent: bool = True) -> Dict[str, Any]:
+             idempotent: bool = True,
+             content_type: str = "application/json") -> Dict[str, Any]:
         """Failover-aware round-robin client — the stand-in for an
         external load balancer in tests/examples.
 
@@ -822,7 +1075,8 @@ class ServingFleet:
             try:
                 # _attempt owns ALL breaker recording (incl. hedge legs)
                 result = self._attempt(i, body, timeout, tried,
-                                       allow_hedge=idempotent)
+                                       allow_hedge=idempotent,
+                                       content_type=content_type)
             except urllib.error.HTTPError as e:
                 if e.code in _FAILOVER_CODES:
                     attempts.append(
@@ -856,18 +1110,20 @@ class ServingFleet:
                 raise ServingUnavailable(attempts)
             try:
                 return self._probe(order[0], body, timeout, attempts,
-                                   idempotent)
+                                   idempotent, content_type)
             finally:
                 self._probe_lock.release()
         raise ServingUnavailable(attempts)
 
     def _probe(self, i: int, body: bytes, timeout: float,
                attempts: List[Dict[str, Any]],
-               replayable: bool = True) -> Dict[str, Any]:
+               replayable: bool = True,
+               content_type: str = "application/json") -> Dict[str, Any]:
         """The all-circuits-open last-resort probe of engine ``i``."""
         try:
             result = self._http_post(self.addresses[i], body, timeout,
-                                     replayable=replayable)
+                                     replayable=replayable,
+                                     content_type=content_type)
         except urllib.error.HTTPError as e:
             if e.code not in _FAILOVER_CODES:
                 # engine alive and answering: the post() contract —
@@ -892,6 +1148,69 @@ class ServingFleet:
         self.breakers[i].reset()
         self._record_latency(result["latency"])
         return result["body"]
+
+    def post_columns(self, columns: Dict[str, Any],
+                     timeout: float = 30.0, codec: str = "msgpack",
+                     idempotent: bool = True) -> Dict[str, Any]:
+        """The pooled COLUMNAR client: typed columns (numpy arrays /
+        string lists / token lists, any row count) encode ONCE as a
+        columnar record batch and ride the same keep-alive pool,
+        failover, and hedging as ``post`` — fleet-internal hops use the
+        zero-copy ingress path end to end. The reply carries one value
+        per row: ``{"prediction": [...]}``.
+
+        Negotiation fallback: an old/JSON-only engine rejects the
+        columnar body (it cannot decode it); the client then replays
+        the SAME rows as JSON oracle requests, and — once that retry
+        succeeds — remembers the verdict so later calls skip the
+        doomed columnar attempt (the PR 2 stale-connection retry
+        discipline applied to content negotiation)."""
+        from mmlspark_tpu.io import columnar as CIN
+        try_columnar = (self._columnar_ok
+                        or time.monotonic() >= self._columnar_retry_at)
+        if try_columnar:
+            body, ct = CIN.encode_columns(columns, codec=codec)
+            try:
+                result = self.post(body, timeout=timeout,
+                                   idempotent=idempotent,
+                                   content_type=ct)
+                self._columnar_ok = True   # (re-)probe succeeded
+                return result
+            except urllib.error.HTTPError as e:
+                # 400: codec reject; 415: an explicit media-type no;
+                # 500: a pre-columnar engine whose JSON decode choked
+                # on the binary body. Anything else is not a
+                # negotiation problem — surface it.
+                if e.code not in (400, 415, 500):
+                    raise
+                log.warning("columnar POST rejected (HTTP %d); "
+                            "retrying as JSON", e.code)
+        out = self._post_columns_json(columns, timeout, idempotent)
+        if try_columnar:
+            # the JSON replay succeeded where columnar failed: treat
+            # the engine as JSON-only for a cooldown, then re-probe —
+            # a transient 500 must not pin the slow path forever
+            self._columnar_ok = False
+            self._columnar_retry_at = (time.monotonic()
+                                       + self.columnar_retry_cooldown_s)
+            log.warning("engine speaks JSON only; using the JSON "
+                        "fallback path for %.0fs before re-probing",
+                        self.columnar_retry_cooldown_s)
+        return out
+
+    def _post_columns_json(self, columns: Dict[str, Any],
+                           timeout: float,
+                           idempotent: bool) -> Dict[str, Any]:
+        """The negotiation fallback: replay the columns as per-row JSON
+        oracle requests, merging the scalar replies into the columnar
+        reply shape (one list per reply key)."""
+        from mmlspark_tpu.io.columnar import columns_to_rows
+        merged: Dict[str, List[Any]] = {}
+        for row in columns_to_rows(columns):
+            body = self.post(row, timeout=timeout, idempotent=idempotent)
+            for k, v in body.items():
+                merged.setdefault(k, []).append(v)
+        return merged
 
     # -- observability -----------------------------------------------------
 

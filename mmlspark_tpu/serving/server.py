@@ -1033,6 +1033,10 @@ class ServingEngine:
             try:
                 prepped = handle.prepare(table)
                 if dspan is not None:
+                    codecs = getattr(prepped, "codecs", None)
+                    if codecs:
+                        dspan.set("codec",
+                                  ",".join(sorted(codecs)))
                     dspan.finish()
                 self.hists["decode_ms"].observe(
                     (time.perf_counter() - t0) * 1e3)
@@ -1042,9 +1046,48 @@ class ServingEngine:
                 if dspan is not None:
                     dspan.error(e).finish()
                 prepped = None
+        # per-request codec rejects (columnar ingress, io/columnar.py):
+        # a malformed or schema-mismatched body 400s exactly ITS
+        # request — its trace finalizes as an error — while batch-mates
+        # proceed to dispatch
+        rejects = getattr(prepped, "rejects", None)
+        if rejects:
+            table, ids, tctx = self._apply_rejects(
+                parked, table, ids, rejects, tctx)
+            if not ids:
+                return None   # nothing survived decode — no dispatch
         if tctx is not None:
             tctx.dispatched_at = time.perf_counter()
         return table, ids, prepped, handle, tctx
+
+    def _apply_rejects(self, parked: List[_ParkedRequest],
+                       table: DataTable, ids: List[str],
+                       rejects: Dict[str, str], tctx):
+        """Answer 400 for every codec-rejected request (finalizing its
+        trace with error=true) and return the filtered (table, ids,
+        trace-context) the surviving batch dispatches with."""
+        kept: List[_ParkedRequest] = []
+        for p in parked:
+            msg = rejects.get(p.id)
+            if msg is None:
+                kept.append(p)
+                continue
+            if p.trace is not None:
+                p.trace.root.set("codec_error", msg)
+                p.trace.root.error()
+            self.source.respond(p.id, HTTPSchema.response(
+                400, "bad request",
+                json.dumps({"error": msg}).encode("utf-8"),
+                {"Content-Type": "application/json"}))
+        keep_idx = [i for i, rid in enumerate(ids) if rid not in rejects]
+        ids = [ids[i] for i in keep_idx]
+        table = table._take_indices(np.asarray(keep_idx, dtype=np.int64))
+        new_tctx = None
+        if self.tracer is not None and kept:
+            ctx = _BatchTraceCtx(self.tracer, kept)
+            if ctx.primary is not None:
+                new_tctx = ctx
+        return table, ids, new_tctx
 
     def _batcher_loop(self):
         """Stage 1 of the pipeline: adaptive collect + (optional) host
@@ -1110,6 +1153,10 @@ class ServingEngine:
                     for p in parked:
                         self.source.respond(p.id, HTTPSchema.response(
                             500, f"batch assembly error: {e}", None))
+                    continue
+                if item is None:
+                    # every request in the batch was codec-rejected
+                    # (each already answered 400); nothing to dispatch
                     continue
                 self._dispatch_q.put(item)   # unbounded: tokens bound it
                 handed_off = True

@@ -1,0 +1,776 @@
+"""Columnar serving ingress (io/columnar.py): codec round trips,
+bit-parity with the JSON oracle, per-request poison isolation,
+content-type negotiation fallback, the swap/recompile/roundtrip
+discipline on the columnar path, and the ingress static checker."""
+
+import json
+import sys
+import threading
+import urllib.error
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.io import columnar as C
+
+
+def make_request(body: bytes, codec: str = None) -> dict:
+    headers = ({"Content-Type": C.CODEC_CONTENT_TYPES[codec]}
+               if codec else {"Content-Type": "application/json"})
+    return {"requestLine": {"method": "POST", "uri": "/"},
+            "headers": headers, "entity": body}
+
+
+def request_table(items) -> DataTable:
+    """items: list of (body, codec|None) -> the engine's batch table."""
+    reqs = [make_request(b, c) for b, c in items]
+    return DataTable({"id": [f"r{i}" for i in range(len(items))],
+                      "request": reqs})
+
+
+def reply_of(out: DataTable, i: int):
+    return out["reply"][i]["prediction"]
+
+
+@pytest.fixture()
+def pyarrow_masked(monkeypatch):
+    """Simulate a container without pyarrow: the inline imports in
+    io/columnar.py must fall back (msgpack string loop) or raise a
+    clean CodecError (arrow codec)."""
+    monkeypatch.setitem(sys.modules, "pyarrow", None)
+    yield
+
+
+class TestCodecRoundTrip:
+    COLS = {
+        "f32": np.array([[1.5, -2.25], [np.nan, np.inf],
+                         [-np.inf, 0.0]], dtype=np.float32),
+        "f64": np.array([1.0, np.nan, -1e300]),
+        "i64": np.array([1, -2, 2**40], dtype=np.int64),
+        "i32": np.array([7, 8, 9], dtype=np.int32),
+        "flag": np.array([True, False, True]),
+        "s": ["héllo", None, "𝔘nicode\n\"quoted\""],
+        "toks": [["a", "bb"], [], ["𝔠", ""]],
+    }
+
+    @pytest.mark.parametrize("codec", ["msgpack", "arrow"])
+    def test_roundtrip_all_types(self, codec):
+        body, ct = C.encode_columns(self.COLS, codec=codec)
+        assert ct == C.CODEC_CONTENT_TYPES[codec]
+        b = C.decode_columnar(codec, body)
+        assert b.n_rows == 3
+        np.testing.assert_array_equal(b.columns["f32"], self.COLS["f32"])
+        assert b.columns["f32"].dtype == np.float32
+        np.testing.assert_array_equal(b.columns["f64"], self.COLS["f64"])
+        assert list(b.columns["i64"]) == list(self.COLS["i64"])
+        assert list(b.columns["i32"]) == [7, 8, 9]
+        assert list(np.asarray(b.columns["flag"], bool)) == \
+            [True, False, True]
+        assert b.columns["s"] == self.COLS["s"]
+        assert [list(t) for t in b.columns["toks"]] == self.COLS["toks"]
+
+    def test_zero_copy_numeric_view(self):
+        arr = np.arange(32, dtype=np.float32).reshape(4, 8)
+        body, _ = C.encode_columns({"f": arr})
+        dec = C.decode_columnar("msgpack", body).columns["f"]
+        # a view into the body buffer, not a copy
+        assert dec.base is not None
+        np.testing.assert_array_equal(dec, arr)
+
+    def test_roundtrip_fuzz(self):
+        rng = np.random.default_rng(0)
+        alphabet = ["w", "éé", "𝔴ord", "", "x" * 50]
+        for it in range(8):
+            n = int(rng.integers(1, 40))
+            cols = {
+                "a": rng.normal(size=n),
+                "b": rng.normal(size=(n, int(rng.integers(1, 9)))
+                                ).astype(np.float32),
+                "i": rng.integers(-1000, 1000, n),
+                "s": [None if rng.random() < 0.2
+                      else alphabet[int(rng.integers(len(alphabet)))]
+                      for _ in range(n)],
+                "t": [[alphabet[int(j)] for j in
+                       rng.integers(0, len(alphabet),
+                                    int(rng.integers(0, 5)))]
+                      for _ in range(n)],
+            }
+            for codec in ("msgpack", "arrow"):
+                b = C.decode_columnar(
+                    codec, C.encode_columns(cols, codec=codec)[0])
+                assert b.n_rows == n
+                np.testing.assert_array_equal(b.columns["a"], cols["a"])
+                np.testing.assert_array_equal(b.columns["b"], cols["b"])
+                assert list(b.columns["i"]) == list(cols["i"])
+                assert b.columns["s"] == cols["s"]
+                assert [list(x) for x in b.columns["t"]] == cols["t"]
+
+    def test_empty_batch_roundtrip(self):
+        body, _ = C.encode_columns({"f": np.zeros((0, 4))})
+        b = C.decode_columnar("msgpack", body)
+        assert b.n_rows == 0 and b.columns["f"].shape == (0, 4)
+
+    @pytest.mark.parametrize("bad", [
+        b"", b"garbage-not-a-frame", b"MCOL", b"MCOL\x01\xff\xff\xff\xff",
+    ])
+    def test_malformed_raises_codec_error(self, bad):
+        with pytest.raises(C.CodecError):
+            C.decode_columnar("msgpack", bad)
+
+    def test_truncated_buffer_raises(self):
+        body, _ = C.encode_columns({"f": np.ones((8, 4))})
+        with pytest.raises(C.CodecError):
+            C.decode_columnar("msgpack", body[:len(body) - 16])
+
+    def test_corrupt_string_offsets_raise(self):
+        # descending offsets must be rejected, not produce garbage
+        body, _ = C.encode_columns({"s": ["abc", "de"]})
+        mutated = bytearray(body)
+        # find the offsets buffer: int32 [0, 3, 5] in the payload
+        pat = np.array([0, 3, 5], np.int32).tobytes()
+        i = bytes(mutated).index(pat)
+        mutated[i:i + 12] = np.array([5, 3, 0], np.int32).tobytes()
+        with pytest.raises(C.CodecError):
+            C.decode_columnar("msgpack", bytes(mutated))
+
+    def test_negotiate(self):
+        assert C.negotiate(None) == "json"
+        assert C.negotiate({}) == "json"
+        assert C.negotiate({"Content-Type": "text/plain"}) == "json"
+        assert C.negotiate(
+            {"Content-Type": "application/json; charset=utf-8"}) == "json"
+        assert C.negotiate(
+            {"content-type": C.CT_MSGPACK_COLUMNS}) == "msgpack"
+        assert C.negotiate(
+            {"CONTENT-TYPE": C.CT_ARROW_STREAM + "; x=1"}) == "arrow"
+
+    def test_unknown_codec_rejected(self):
+        with pytest.raises(C.CodecError):
+            C.decode_columnar("nope", b"x")
+        with pytest.raises(C.CodecError):
+            C.encode_columns({"a": np.ones(2)}, codec="nope")
+
+    def test_msgpack_header_json_fallback(self, monkeypatch):
+        """Without msgpack installed the frame header serializes as
+        JSON (flag byte 0) and decodes identically."""
+        monkeypatch.setattr(C, "_msgpack", lambda: None)
+        cols = {"f": np.arange(6, dtype=np.float64).reshape(3, 2),
+                "s": ["a", None, "b"]}
+        body, _ = C.encode_columns(cols)
+        assert body[4] == 0    # JSON header flag
+        b = C.decode_columnar("msgpack", body)
+        np.testing.assert_array_equal(b.columns["f"], cols["f"])
+        assert b.columns["s"] == cols["s"]
+
+    def test_pyarrow_masked_fallbacks(self, pyarrow_masked):
+        cols = {"f": np.ones((3, 2), np.float32), "s": ["x", None, "z"],
+                "t": [["a"], [], ["b", "c"]]}
+        body, _ = C.encode_columns(cols)      # msgpack needs no pyarrow
+        b = C.decode_columnar("msgpack", body)
+        assert b.columns["s"] == cols["s"]    # fallback string loop
+        assert [list(t) for t in b.columns["t"]] == cols["t"]
+        np.testing.assert_array_equal(b.columns["f"], cols["f"])
+        with pytest.raises(C.CodecError):
+            C.encode_columns(cols, codec="arrow")
+        with pytest.raises(C.CodecError):
+            C.decode_columnar("arrow", b"ARROW1")
+
+    def test_staging_pool_ring_reuse(self):
+        pool = C.StagingPool(depth=3)
+        a = np.arange(8, dtype=np.float32).reshape(2, 4)
+        outs = [pool.pad("k", a, 8) for _ in range(4)]
+        assert all(o.shape == (8, 4) for o in outs)
+        for o in outs:
+            np.testing.assert_array_equal(o[:2], a)
+            np.testing.assert_array_equal(o[2:], np.tile(a[-1], (6, 1)))
+        assert outs[3] is outs[0]       # ring wrapped
+        assert outs[1] is not outs[0]
+        # full bucket passes through untouched (no copy)
+        full = np.ones((8, 4), np.float32)
+        assert pool.pad("k", full, 8) is full
+        with pytest.raises(ValueError):
+            pool.pad("k", a[:0], 8)     # nothing to edge-pad from
+
+    def test_assemble_column_fast_and_fallback(self):
+        b1 = C.ColumnarBatch({"x": np.arange(3.0)}, 3)
+        b2 = C.ColumnarBatch({"x": np.arange(2.0) + 10}, 2)
+        out = C.assemble_column([b1, b2], "x", 5)
+        assert isinstance(out, np.ndarray)
+        np.testing.assert_array_equal(out, [0, 1, 2, 10, 11])
+        # single request: the zero-copy view itself
+        assert C.assemble_column([b1], "x", 3) is b1.columns["x"]
+        # mixed with a JSON row dict -> list fallback, JSON semantics
+        out = C.assemble_column([b1, {"x": 7.0}], "x", 4)
+        assert out == [0.0, 1.0, 2.0, 7.0]
+        # a batch missing the column fills None (JSON .get semantics)
+        out = C.assemble_column([{"x": 1.0}, C.ColumnarBatch({}, 2)],
+                                "x", 3)
+        assert out == [1.0, None, None]
+        # per-request width mismatch is a CodecError, not a ValueError
+        w1 = C.ColumnarBatch({"x": np.ones((2, 3))}, 2)
+        w2 = C.ColumnarBatch({"x": np.ones((2, 4))}, 2)
+        with pytest.raises(C.CodecError):
+            C.assemble_column([w1, w2], "x", 4)
+
+    def test_object_dtype_numeric_list_refused_client_side(self):
+        # a None inside a numeric list would otherwise serialize raw
+        # CPython heap pointers (object-array tobytes) onto the wire —
+        # must refuse at encode time with an actionable message
+        with pytest.raises(C.CodecError, match="NaN"):
+            C.encode_columns({"x": [1.0, None, 2.0]})
+        with pytest.raises(C.CodecError):
+            C.encode_columns({"x": [[1.0, 2.0], [3.0]]})  # ragged
+
+    def test_columns_to_rows(self):
+        rows = C.columns_to_rows({"a": np.array([1.5, 2.5]),
+                                  "s": ["x", "y"],
+                                  "v": np.array([[1, 2], [3, 4]])})
+        assert rows == [{"a": 1.5, "s": "x", "v": [1, 2]},
+                        {"a": 2.5, "s": "y", "v": [3, 4]}]
+
+
+# ---------------------------------------------------------------------------
+# scoring-path parity (no HTTP: the scorer stages driven directly)
+# ---------------------------------------------------------------------------
+
+
+def _tpu_model(dim=8, classes=4):
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    rng = np.random.default_rng(3)
+    W = rng.normal(size=(dim, classes)).astype(np.float32)
+    return TPUModel.from_fn(
+        lambda w, ins: list(ins.values())[0] @ w["W"], {"W": W},
+        inputCol="features", outputCol="scores", batchSize=32)
+
+
+class TestTPUModelColumnarParity:
+    def test_bit_parity_json_vs_columnar(self):
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        model = _tpu_model()
+        stage = json_scoring_pipeline(model)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(6, 8))
+        x[0, 0] = np.nan
+        x[1, 1] = np.inf
+        x[2, 2] = -np.inf
+        json_out = stage.transform(request_table(
+            [(json.dumps({"features": list(map(float, row))}).encode(),
+              None) for row in x]))
+        json_preds = [reply_of(json_out, i) for i in range(6)]
+        for codec in ("msgpack", "arrow"):
+            body, _ = C.encode_columns({"features": x}, codec=codec)
+            out = stage.transform(request_table([(body, codec)]))
+            assert reply_of(out, 0) == json_preds, codec
+
+    def test_mixed_codec_batch(self):
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        stage = json_scoring_pipeline(_tpu_model())
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 8))
+        mp_body, _ = C.encode_columns({"features": x[:2]})
+        ar_body, _ = C.encode_columns({"features": x[2:3]},
+                                      codec="arrow")
+        js_body = json.dumps(
+            {"features": list(map(float, x[3]))}).encode()
+        out = stage.transform(request_table(
+            [(mp_body, "msgpack"), (ar_body, "arrow"), (js_body, None)]))
+        ref = stage.transform(request_table([(C.encode_columns(
+            {"features": x})[0], "msgpack")]))
+        flat = (reply_of(out, 0) + reply_of(out, 1)
+                + [reply_of(out, 2)])
+        assert flat == reply_of(ref, 0)
+
+    def test_zero_row_request(self):
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        stage = json_scoring_pipeline(_tpu_model())
+        body, _ = C.encode_columns(
+            {"features": np.zeros((0, 8), np.float64)})
+        out = stage.transform(request_table([(body, "msgpack")]))
+        assert reply_of(out, 0) == []
+
+    def test_prepare_rejects_malformed_and_mismatched(self):
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        stage = json_scoring_pipeline(_tpu_model())
+        rng = np.random.default_rng(2)
+        good = C.encode_columns({"features": rng.normal(size=(3, 8))})[0]
+        bad_frame = b"MCOL\x01\xff\xff\xff\xffgarbage"
+        wrong_dim = C.encode_columns(
+            {"features": rng.normal(size=(2, 5))})[0]
+        missing = C.encode_columns({"other": rng.normal(size=(2, 8))})[0]
+        prepped = stage.prepare_batch(request_table(
+            [(good, "msgpack"), (bad_frame, "msgpack"),
+             (wrong_dim, "msgpack"), (missing, "msgpack")]))
+        assert set(prepped.rejects) == {"r1", "r2", "r3"}
+        assert prepped.payload.shape == (3, 8)
+        assert prepped.spans == [(0, 3, "msgpack")]
+        # the engine dispatches the FILTERED table; execute must align
+        filtered = request_table([(good, "msgpack")])
+        out = stage.execute_prepared(filtered, prepped)
+        assert len(reply_of(out, 0)) == 3
+
+
+def _fused_fixture():
+    from mmlspark_tpu.core.stage import Pipeline
+    from mmlspark_tpu.automl.featurize import Featurize
+    from mmlspark_tpu.stages.dataprep import (
+        CleanMissingData, StandardScaler,
+    )
+    from mmlspark_tpu.models.linear import TPULogisticRegression
+    rng = np.random.default_rng(0)
+    n = 64
+    table = DataTable({
+        "a": rng.normal(size=n).astype(np.float64),
+        "b": np.where(rng.random(n) < 0.2, np.nan, rng.normal(size=n)),
+        "cat": [f"l{int(i)}" for i in rng.integers(0, 4, n)],
+        "toks": [[f"w{int(t)}" for t in rng.integers(0, 9, 3)]
+                 for _ in range(n)],
+        "label": rng.integers(0, 2, n).astype(np.float64),
+    })
+    pm = Pipeline(stages=[
+        CleanMissingData(inputCols=["b"], outputCols=["b"]),
+        Featurize(featureColumns=["a", "b", "cat", "toks"],
+                  numberOfFeatures=16),
+        StandardScaler(inputCol="features", outputCol="features"),
+        TPULogisticRegression(featuresCol="features", labelCol="label",
+                              maxIter=5),
+    ]).fit(table)
+    return pm, table
+
+
+ADVERSARIAL_ROWS = [
+    {"a": 0.5, "b": None, "cat": "l1", "toks": ["w1", "w2"]},
+    {"a": float("nan"), "b": 2.0, "cat": "zzz-unseen", "toks": []},
+    {"a": -1.0, "b": float("inf"), "cat": None, "toks": ["𝔘ni", "códe"]},
+    {"a": 3, "b": 1, "cat": "l0", "toks": ["w3"]},   # int-typed numerics
+]
+
+ADVERSARIAL_COLS = {
+    "a": np.array([0.5, np.nan, -1.0, 3.0]),
+    "b": np.array([np.nan, 2.0, np.inf, 1.0]),
+    "cat": ["l1", "zzz-unseen", None, "l0"],
+    "toks": [["w1", "w2"], [], ["𝔘ni", "códe"], ["w3"]],
+}
+
+
+class TestFusedColumnarParity:
+    @pytest.fixture(scope="class")
+    def fused_stage(self):
+        from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+        pm, table = _fused_fixture()
+        stage = json_scoring_pipeline(pm, batch_size=32)
+        stage.warmup(table.drop("label").take(2))
+        return stage
+
+    def test_bit_parity_adversarial_rows(self, fused_stage):
+        json_out = fused_stage.transform(request_table(
+            [(json.dumps(r).encode(), None) for r in ADVERSARIAL_ROWS]))
+        json_preds = [reply_of(json_out, i)
+                      for i in range(len(ADVERSARIAL_ROWS))]
+        for codec in ("msgpack", "arrow"):
+            body, _ = C.encode_columns(ADVERSARIAL_COLS, codec=codec)
+            out = fused_stage.transform(request_table([(body, codec)]))
+            assert reply_of(out, 0) == json_preds, codec
+
+    def test_int_vs_float_dtype_parity(self, fused_stage):
+        # i64 columns must score exactly like the f64 encoding of the
+        # same values (both cast to f32 at the device boundary)
+        base = {"a": np.array([1.0, 2.0]), "b": np.array([0.0, 3.0]),
+                "cat": ["l0", "l1"], "toks": [["w1"], ["w2"]]}
+        as_int = dict(base, a=np.array([1, 2], np.int64),
+                      b=np.array([0, 3], np.int64))
+        o1 = fused_stage.transform(request_table(
+            [(C.encode_columns(base)[0], "msgpack")]))
+        o2 = fused_stage.transform(request_table(
+            [(C.encode_columns(as_int)[0], "msgpack")]))
+        assert reply_of(o1, 0) == reply_of(o2, 0)
+
+    def test_zero_recompiles_and_one_roundtrip(self, fused_stage):
+        scorer = fused_stage.scorer
+        body, _ = C.encode_columns(ADVERSARIAL_COLS)
+        fused_stage.transform(request_table([(body, "msgpack")]))
+        misses0 = scorer.jit_cache_miss_count()
+        trips0, batches0 = scorer.device_roundtrips, scorer.batches_scored
+        for _ in range(5):
+            out = fused_stage.transform(request_table(
+                [(body, "msgpack")]))
+        assert scorer.jit_cache_miss_count() == misses0, \
+            "columnar steady state must not recompile"
+        db = scorer.batches_scored - batches0
+        assert scorer.device_roundtrips - trips0 <= db
+        assert db == 5
+
+    def test_first_bad_request_cannot_reject_batchmates(self, fused_stage):
+        """Mismatch-guard reference is the last SUCCESSFUL batch, not
+        whichever request decodes first: after any good batch, a
+        wrong-shaped request ordered FIRST in a micro-batch rejects
+        alone while its well-formed batch-mates score."""
+        scorer = fused_stage.scorer
+        good_body, _ = C.encode_columns(ADVERSARIAL_COLS)
+        fused_stage.transform(request_table([(good_body, "msgpack")]))
+        assert scorer._confirmed_shapes   # reference latched
+        bad_cols = dict(ADVERSARIAL_COLS,
+                        a=np.ones((4, 3)))   # wrong trailing shape
+        bad_body, _ = C.encode_columns(bad_cols)
+        prepped = fused_stage.prepare_batch(request_table(
+            [(bad_body, "msgpack"), (good_body, "msgpack")]))
+        assert set(prepped.rejects) == {"r0"}, prepped.rejects
+        assert prepped.spans == [(0, 4, "msgpack")]
+
+    def test_staging_buffers_reused(self, fused_stage):
+        scorer = fused_stage.scorer
+        body, _ = C.encode_columns(ADVERSARIAL_COLS)
+        for _ in range(scorer._staging.depth + 2):
+            fused_stage.transform(request_table([(body, "msgpack")]))
+        stats = scorer._staging.stats()
+        assert stats["reuses"] > 0, stats
+
+
+# ---------------------------------------------------------------------------
+# engine-level behaviors over real HTTP
+# ---------------------------------------------------------------------------
+
+
+class TestPoisonedColumnarRequest:
+    def test_poisoned_request_400s_alone_in_full_bucket(self):
+        from mmlspark_tpu.core.trace import Tracer
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        model = _tpu_model()
+        tracer = Tracer(enabled=True)
+        fleet = ServingFleet(json_scoring_pipeline(model), n_engines=1,
+                             base_port=19700, batch_size=8, workers=1,
+                             max_wait_ms=25.0, tracer=tracer)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 8))
+        good, ct = C.encode_columns({"features": x})
+        poison = b"MCOL\x01\x10\x00\x00\x00not-a-real-header"
+        results = {}
+
+        def post(i, body):
+            try:
+                results[i] = ("ok", fleet.post(body, timeout=30,
+                                               content_type=ct))
+            except urllib.error.HTTPError as e:
+                results[i] = ("http", e.code, json.loads(e.read()))
+            except Exception as e:  # noqa: BLE001
+                results[i] = ("err", repr(e))
+
+        try:
+            fleet.post(good, content_type=ct)   # warm the live path
+            # a full bucket: 7 good + the poison interleaved in the
+            # middle, posted concurrently so they share a micro-batch
+            threads = []
+            for i in range(8):
+                body = poison if i == 3 else good
+                t = threading.Thread(target=post, args=(i, body))
+                threads.append(t)
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert results[3][0] == "http" and results[3][1] == 400, \
+                results[3]
+            assert "error" in results[3][2]
+            for i in range(8):
+                if i == 3:
+                    continue
+                assert results[i][0] == "ok", (i, results[i])
+                assert "prediction" in results[i][1]
+            # the poisoned request's trace finalized as an ERROR with
+            # the codec message; batch-mates' traces are clean
+            err_traces = [t for t in tracer.buffer.traces()
+                          if t.root.attrs.get("codec_error")]
+            assert err_traces, "poisoned trace must be tail-kept"
+            assert all(t.root.status == "error" for t in err_traces)
+        finally:
+            fleet.stop_all()
+
+
+class TestNegotiationFallback:
+    def test_columnar_client_vs_json_only_engine(self):
+        from mmlspark_tpu.serving.fleet import ServingFleet
+        from mmlspark_tpu.stages.basic import Lambda
+
+        def old_handle(table):   # the pre-columnar protocol, verbatim
+            rows = [json.loads(r["entity"].decode())
+                    for r in table["request"]]
+            return table.with_column(
+                "reply", [{"prediction": float(sum(r["features"]))}
+                          for r in rows])
+
+        fleet = ServingFleet(Lambda.apply(old_handle), n_engines=1,
+                             base_port=19750, batch_size=8, workers=1)
+        try:
+            x = np.ones((3, 4))
+            out = fleet.post_columns({"features": x})
+            assert out["prediction"] == [4.0, 4.0, 4.0]
+            # verdict remembered: later calls skip the doomed attempt
+            assert fleet._columnar_ok is False
+            seen0 = fleet.engines[0].source.requests_seen
+            out = fleet.post_columns({"features": x})
+            assert out["prediction"] == [4.0, 4.0, 4.0]
+            # 3 JSON row requests, no wasted columnar POST
+            assert fleet.engines[0].source.requests_seen - seen0 == 3
+        finally:
+            fleet.stop_all()
+
+    def test_json_pin_is_a_cooldown_not_a_life_sentence(self):
+        """A transient failure that mimicked a negotiation reject must
+        not degrade the client to per-row JSON forever: after the
+        cooldown the next call re-probes columnar and un-pins."""
+        import time as _time
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        fleet = ServingFleet(json_scoring_pipeline(_tpu_model()),
+                             n_engines=1, base_port=19790,
+                             batch_size=8, workers=1)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 8))
+        try:
+            # simulate a mis-pin (e.g. a transient 500 + JSON success)
+            fleet._columnar_ok = False
+            fleet._columnar_retry_at = _time.monotonic() + 999
+            seen0 = fleet.engines[0].source.requests_seen
+            fleet.post_columns({"features": x})
+            # pinned: per-row JSON requests, no columnar attempt
+            assert fleet.engines[0].source.requests_seen - seen0 == 2
+            assert fleet._columnar_ok is False
+            # cooldown expired: the next call re-probes and un-pins
+            fleet._columnar_retry_at = 0.0
+            seen1 = fleet.engines[0].source.requests_seen
+            out = fleet.post_columns({"features": x})
+            assert len(out["prediction"]) == 2
+            assert fleet.engines[0].source.requests_seen - seen1 == 1
+            assert fleet._columnar_ok is True
+        finally:
+            fleet.stop_all()
+
+    def test_both_directions_on_columnar_engine(self):
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        model = _tpu_model()
+        fleet = ServingFleet(json_scoring_pipeline(model), n_engines=1,
+                             base_port=19780, batch_size=8, workers=1)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(3, 8))
+        try:
+            # direction 1: columnar client -> columnar engine fast path
+            out = fleet.post_columns({"features": x})
+            assert len(out["prediction"]) == 3
+            assert fleet._columnar_ok is True
+            # direction 2: a plain JSON client keeps working unchanged
+            body = fleet.post({"features": list(map(float, x[0]))})
+            assert body["prediction"] == out["prediction"][0]
+        finally:
+            fleet.stop_all()
+
+
+class TestColumnarSwapDiscipline:
+    def test_swap_under_columnar_load_zero_recompiles(self):
+        """A lifecycle swap on the columnar path: warmup compiles every
+        bucket off the hot path, steady-state columnar traffic through
+        the swap triggers ZERO recompiles on either version, and the
+        one-roundtrip-per-batch contract holds throughout."""
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        from mmlspark_tpu.serving.lifecycle import CanaryPolicy
+        pm, table = _fused_fixture()
+        stage_v1 = json_scoring_pipeline(pm, batch_size=32)
+        scorer_v1 = stage_v1.scorer
+        fleet = ServingFleet(stage_v1, n_engines=1, base_port=19800,
+                             batch_size=32, workers=1, version="v1")
+        engine = fleet.engines[0]
+        warm_example = table.drop("label").take(2)
+        body, ct = C.encode_columns(ADVERSARIAL_COLS)
+        try:
+            stage_v1.warmup(warm_example)
+            ref = fleet.post(body, content_type=ct)["prediction"]
+            misses_v1 = scorer_v1.jit_cache_miss_count()
+
+            stage_v2 = json_scoring_pipeline(
+                _fused_fixture()[0], batch_size=32)
+            scorer_v2 = stage_v2.scorer
+            stop = threading.Event()
+            errors = []
+
+            def load():
+                while not stop.is_set():
+                    try:
+                        out = fleet.post(body, timeout=30,
+                                         content_type=ct)
+                        assert len(out["prediction"]) == 4
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(repr(e))
+
+            t = threading.Thread(target=load)
+            t.start()
+            try:
+                res = engine.swap(
+                    stage_v2, "v2", warmup_example=warm_example,
+                    policy=CanaryPolicy(fraction=0.5, min_batches=2,
+                                        decision_timeout_s=30))
+            finally:
+                stop.set()
+                t.join(timeout=30)
+            assert res.completed, res.reason
+            misses_v2 = scorer_v2.jit_cache_miss_count()
+            # steady state AFTER the swap: both counters flat
+            for _ in range(4):
+                out = fleet.post(body, content_type=ct)
+                assert out["prediction"] == ref or \
+                    len(out["prediction"]) == 4
+            assert scorer_v1.jit_cache_miss_count() == misses_v1
+            assert scorer_v2.jit_cache_miss_count() == misses_v2, \
+                "post-swap columnar traffic must not recompile"
+            assert not errors, errors[:3]
+            for s in (scorer_v1, scorer_v2):
+                assert s.device_roundtrips <= s.batches_scored
+        finally:
+            fleet.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# the ingress static checker
+# ---------------------------------------------------------------------------
+
+
+class TestIngressChecker:
+    def _tools(self):
+        import importlib
+        import os
+        import sys as _sys
+        sys_path = os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools")
+        if sys_path not in _sys.path:
+            _sys.path.insert(0, sys_path)
+        return importlib.import_module("check_fusion_kernels")
+
+    def test_shipped_ingress_kernels_clean(self):
+        chk = self._tools()
+        assert C.INGRESS_REGISTRY, "decode kernels must be registered"
+        violations = chk.check_ingress_kernels()
+        assert violations == [], violations
+
+    def test_checker_catches_per_row_iteration(self):
+        chk = self._tools()
+
+        def bad_decode(body):
+            out = []
+            for i in range(len(body)):
+                out.append(float(body[i]))
+            return out
+
+        C.register_ingress_kernel(bad_decode, "test.bad_decode")
+        try:
+            violations = chk.check_ingress_kernels()
+            assert any("test.bad_decode" in v and "iteration" in v
+                       for v in violations), violations
+        finally:
+            C.INGRESS_REGISTRY.pop(bad_decode.__code__, None)
+
+    def test_checker_catches_boxing_and_honors_whitelist(self):
+        chk = self._tools()
+
+        def boxy(arr):
+            return arr.tolist()
+
+        def ok_loop(cols):
+            out = {}
+            for name in cols:  # ingress:row-ok — per-column
+                out[name] = cols[name]
+            return out
+
+        C.register_ingress_kernel(boxy, "test.boxy")
+        C.register_ingress_kernel(ok_loop, "test.ok_loop")
+        try:
+            violations = chk.check_ingress_kernels()
+            assert any("test.boxy" in v and "boxing" in v
+                       for v in violations), violations
+            assert not any("test.ok_loop" in v for v in violations), \
+                violations
+        finally:
+            C.INGRESS_REGISTRY.pop(boxy.__code__, None)
+            C.INGRESS_REGISTRY.pop(ok_loop.__code__, None)
+
+
+# ---------------------------------------------------------------------------
+# the throughput floor (slow: wall-clock on a contended host)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestColumnarIngressFloor:
+    def test_columnar_at_least_2x_json_rows_per_s(self):
+        """The acceptance floor: single-replica rows/sec >= 2x the JSON
+        oracle on the same engine, host ingress phases < 20% of request
+        p50, zero steady-state recompiles (BENCH_r11 measures ~60x on
+        this container; 2x is the pinned floor)."""
+        import concurrent.futures
+        from mmlspark_tpu.core.metrics import (
+            ingress_decode_histograms, ingress_histograms,
+        )
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        model = _tpu_model(dim=64, classes=8)
+        model.warmup({"features": np.zeros((1, 64), np.float32)})
+        fleet = ServingFleet(json_scoring_pipeline(model), n_engines=1,
+                             base_port=19850, batch_size=32, workers=2)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 64))
+        json_body = json.dumps(
+            {"features": list(map(float, x[0]))}).encode()
+        col_body, ct = C.encode_columns({"features": x})
+
+        def spray(body, content_type, n, rows_per_req):
+            lat = []
+
+            def post(_):
+                t0 = __import__("time").perf_counter()
+                out = fleet.post(body, timeout=30,
+                                 content_type=content_type)
+                assert "prediction" in out
+                return (__import__("time").perf_counter() - t0) * 1e3
+            post(0)
+            t0 = __import__("time").perf_counter()
+            with concurrent.futures.ThreadPoolExecutor(8) as ex:
+                for r in ex.map(post, range(n)):
+                    lat.append(r)
+            wall = __import__("time").perf_counter() - t0
+            return (n * rows_per_req / wall,
+                    float(np.percentile(lat, 50)))
+
+        try:
+            json_rps, _ = spray(json_body, "application/json", 160, 1)
+            misses0 = model.jit_cache_misses
+            # process-wide histograms: reset so the host-fraction is
+            # measured on the columnar workload alone
+            for h in ingress_histograms().values():
+                h.reset()
+            for h in ingress_decode_histograms().values():
+                h.reset()
+            model._hists["pad_ms"].reset()
+            col_rps, col_p50 = spray(col_body, ct, 80, 32)
+            assert model.jit_cache_misses == misses0
+            ratio = col_rps / json_rps
+            assert ratio >= 2.0, \
+                f"columnar {col_rps:.0f} rows/s vs JSON " \
+                f"{json_rps:.0f} rows/s = {ratio:.2f}x < 2x floor"
+            ih = ingress_histograms()
+            decode = ingress_decode_histograms().get("msgpack")
+            host_ms = (ih["negotiate"].summary().get("p50", 0.0)
+                       + ih["assemble"].summary().get("p50", 0.0)
+                       + (decode.summary().get("p50", 0.0)
+                          if decode else 0.0))
+            stage = fleet.metrics()["aggregate"].get(
+                "pipeline_stage", {})
+            host_ms += stage.get("pad_ms", {}).get("p50", 0.0) or 0.0
+            assert host_ms < 0.2 * col_p50, \
+                f"host phases {host_ms:.3f}ms vs p50 {col_p50:.2f}ms"
+        finally:
+            fleet.stop_all()

@@ -58,6 +58,14 @@ _FORBIDDEN_ATTRS = {"device_get", "block_until_ready", "item",
                     "to_py", "tolist"}
 _WHITELIST_MARK = "# fusion:host-ok"
 
+# ingress decode kernels (io/columnar.py INGRESS_REGISTRY) promise NO
+# per-row Python iteration and NO per-element boxing between the socket
+# and device_put: loops/comprehensions and per-element materializers
+# are forbidden unless the line carries the explicit acknowledgment
+# (per-COLUMN loops and the documented string passes)
+_INGRESS_MARK = "# ingress:row-ok"
+_INGRESS_ATTRS = {"tolist", "item", "to_py"}
+
 
 def _kernel_sources() -> List[Tuple[str, str, int, List[str]]]:
     """(name, source, firstlineno, lines) per registered kernel."""
@@ -136,6 +144,79 @@ def check_registered_kernels() -> List[str]:
     violations: List[str] = []
     for name, src, first, lines in _kernel_sources():
         violations.extend(_check_source(name, src, first, lines))
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# ingress decode kernels (columnar serving ingress — io/columnar.py)
+# ---------------------------------------------------------------------------
+
+
+def _ingress_sources() -> List[Tuple[str, str, int, List[str]]]:
+    from mmlspark_tpu.io.columnar import INGRESS_REGISTRY
+    out = []
+    seen = set()
+    for code, name in INGRESS_REGISTRY.items():
+        key = (code.co_filename, code.co_firstlineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        try:
+            lines, first = inspect.getsourcelines(code)
+        except OSError:
+            continue   # dynamically built (tests); nothing to audit
+        out.append((name, textwrap.dedent("".join(lines)), first, lines))
+    return out
+
+
+_LOOP_NODES = (ast.For, ast.While, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+def _check_ingress_source(name: str, src: str, first: int,
+                          lines: List[str]) -> List[str]:
+    """Per-row iteration / per-element boxing audit of ONE registered
+    ingress decode kernel. Any loop or comprehension must carry the
+    ``# ingress:row-ok`` acknowledgment on its first line (per-column
+    loops and the documented string-materialization passes); so must
+    ``.tolist()``/``.item()`` and ``map()`` calls."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return [f"{name}: unparseable ingress kernel source"]
+    violations: List[str] = []
+
+    def line_ok(lineno: int) -> bool:
+        idx = lineno - 1
+        if 0 <= idx < len(lines):
+            return _INGRESS_MARK in lines[idx]
+        return False
+
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, _LOOP_NODES):
+            bad = ("per-row Python iteration "
+                   f"({type(node).__name__.lower()})")
+        elif isinstance(node, ast.Attribute) and \
+                node.attr in _INGRESS_ATTRS:
+            bad = f"per-element boxing '.{node.attr}'"
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and node.func.id == "map":
+            bad = "per-element boxing 'map()'"
+        if bad is not None and not line_ok(node.lineno):
+            violations.append(
+                f"{name} (line {first + node.lineno - 1}): {bad} inside "
+                f"a registered ingress decode kernel (acknowledge a "
+                f"per-column loop with '{_INGRESS_MARK}')")
+    return violations
+
+
+def check_ingress_kernels() -> List[str]:
+    """All per-row-iteration violations across registered ingress
+    decode kernels (empty = clean)."""
+    violations: List[str] = []
+    for name, src, first, lines in _ingress_sources():
+        violations.extend(_check_ingress_source(name, src, first, lines))
     return violations
 
 
@@ -269,13 +350,17 @@ def main() -> int:
     n = register_representative_pipelines()
     n += register_known_callees()
     violations = check_registered_kernels()
+    from mmlspark_tpu.io.columnar import INGRESS_REGISTRY
+    n_ingress = len(INGRESS_REGISTRY)
+    violations += check_ingress_kernels()
     if violations:
-        print(f"{len(violations)} fused-kernel host-round-trip "
-              f"violation(s) across {n} registered kernels:")
+        print(f"{len(violations)} kernel violation(s) across {n} fused "
+              f"+ {n_ingress} ingress registered kernels:")
         for v in violations:
             print("  -", v)
         return 1
-    print(f"OK: {n} registered fused kernels, no host round trips")
+    print(f"OK: {n} registered fused kernels, no host round trips; "
+          f"{n_ingress} ingress kernels, no per-row iteration")
     return 0
 
 
