@@ -1221,6 +1221,157 @@ def bench_coldstart() -> dict:
     }
 
 
+ZOO_MODELS = 256
+ZOO_MAX_RESIDENT = 32
+ZOO_REQUESTS = 2000
+ZOO_CLIENTS = 16
+
+
+def bench_zoo() -> dict:
+    """The multi-model serving plane (serving/zoo.py): ZOO_MODELS
+    distinct versioned models behind one 2-engine fleet, mixed-tenant
+    load over a skewed model distribution with only ZOO_MAX_RESIDENT
+    resident at once — so the run measures p99 UNDER CHURN (activations
+    and LRU evictions happening mid-traffic), availability, and the
+    cold-model activation wall through the AOT load path (export one
+    real artifact, activate it cold, report the audit event's ms)."""
+    import concurrent.futures
+    import tempfile
+    import threading
+    import urllib.error
+
+    import jax
+
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.models.networks import build_network
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    from mmlspark_tpu.serving import (
+        AdmissionController, ModelZoo, ServingFleet,
+        ServingUnavailable, aot,
+    )
+    from mmlspark_tpu.stages.basic import Lambda
+
+    rng = np.random.default_rng(0)
+
+    def scoring_stage(tag, w):
+        # a real (host numpy) per-model compute so batches cost
+        # something; distinct weights per model
+        def handle(table):
+            feats = np.asarray(
+                [json.loads(r["entity"].decode())["features"]
+                 for r in table["request"]], np.float32)
+            scores = feats @ w
+            return table.with_column("reply", [
+                {"model": tag, "prediction": int(s.argmax())}
+                for s in scores])
+        return Lambda.apply(handle)
+
+    zoo = ModelZoo(max_resident=ZOO_MAX_RESIDENT, memory_probe=None)
+    dim, classes = 16, 8
+    for i in range(ZOO_MODELS):
+        w = rng.normal(size=(dim, classes)).astype(np.float32)
+        zoo.register_factory(
+            f"m{i:03d}", f"v{i % 8}",
+            (lambda i=i, w=w: scoring_stage(f"m{i:03d}", w)),
+            metadata={"cost_bytes": int(w.nbytes)})
+
+    # ONE real AOT artifact: the cold-activation-in-hundreds-of-ms
+    # claim is measured on the genuine load path, not a factory
+    module = build_network({"type": "mlp", "features": [64, 32],
+                            "num_classes": classes})
+    x0 = np.zeros((1, dim), np.float32)
+    tpu_model = TPUModel.from_flax(
+        module, module.init(jax.random.PRNGKey(0), x0),
+        inputCol="features", outputCol="scores", batchSize=64)
+    art = tempfile.mkdtemp(prefix="mmlspark_zoo_bench_")
+    aot.export_model(tpu_model, {"features": x0}, art, version="v1")
+    zoo.register_artifact("aot_scorer", "v1", art)
+
+    admission = AdmissionController()   # default tiers, no quotas
+    fleet = ServingFleet(n_engines=2, base_port=19860, batch_size=64,
+                         workers=2, max_wait_ms=3.0, zoo=zoo,
+                         admission=admission, tracing=False)
+    # skewed popularity (zipf-ish): a hot head keeps the cache busy
+    # while a long tail forces continuous activations + evictions
+    ranks = np.arange(1, ZOO_MODELS + 1, dtype=np.float64)
+    probs = (1.0 / ranks ** 1.1)
+    probs /= probs.sum()
+    picks = rng.choice(ZOO_MODELS, size=ZOO_REQUESTS, p=probs)
+    payload = json.dumps(
+        {"features": rng.normal(size=dim).tolist()}).encode()
+    lock = threading.Lock()
+    lat, failures = [], []
+
+    def post(i):
+        model = f"m{picks[i]:03d}"
+        tenant = f"t{i % 4}"
+        t0 = time.perf_counter()
+        try:
+            body = fleet.post(payload, model=model, tenant=tenant,
+                              timeout=120)
+            assert body["model"] == model, (model, body)   # no mixing
+            ok = True
+        except urllib.error.HTTPError as e:
+            with lock:
+                failures.append(e.code)
+            ok = False
+        except ServingUnavailable:
+            # fleet-level unavailability (both circuits open) is a
+            # FAILED request in the availability metric, not a
+            # crashed bench
+            with lock:
+                failures.append(503)
+            ok = False
+        dt = (time.perf_counter() - t0) * 1e3
+        with lock:
+            lat.append(dt)
+        return ok
+
+    try:
+        # cold AOT activation measured through live HTTP: first
+        # request to the never-loaded artifact model
+        t0 = time.perf_counter()
+        body = fleet.post(payload, model="aot_scorer", timeout=300)
+        aot_first_request_ms = (time.perf_counter() - t0) * 1e3
+        assert "prediction" in body
+        activate_ev = [e for e in zoo.events if e.kind == "activate"
+                       and e.model == "aot_scorer"][0]
+        t0 = time.perf_counter()
+        with concurrent.futures.ThreadPoolExecutor(ZOO_CLIENTS) as ex:
+            results = list(ex.map(post, range(ZOO_REQUESTS)))
+        wall = time.perf_counter() - t0
+        stats = zoo.stats()
+        distinct_served = len({f"m{p:03d}" for p in picks})
+    finally:
+        fleet.stop_all()
+        zoo.close()
+    lat_arr = np.asarray(sorted(lat))
+    availability = sum(results) / len(results)
+    return {
+        "metric": "zoo_p99_ms_under_churn",
+        "value": round(float(np.percentile(lat_arr, 99)), 1),
+        "unit": "ms",
+        "models_registered": ZOO_MODELS + 1,
+        "distinct_models_requested": distinct_served,
+        "max_resident": ZOO_MAX_RESIDENT,
+        "qps": round(ZOO_REQUESTS / wall, 1),
+        "p50_ms": round(float(np.percentile(lat_arr, 50)), 1),
+        "availability": round(availability, 4),
+        "failure_codes": sorted(set(failures)),
+        "activations": stats["activations"],
+        "evictions": stats["evictions"],
+        "evictions_with_outstanding":
+            stats["evictions_with_outstanding"],
+        "cold_aot_activation_ms": round(activate_ev.stats["ms"], 1),
+        "cold_aot_first_request_ms": round(aot_first_request_ms, 1),
+        "backend": jax.default_backend(),
+        "config": (f"{ZOO_MODELS} factory models + 1 AOT artifact, "
+                   f"cache {ZOO_MAX_RESIDENT}, zipf(1.1) picks, "
+                   f"{ZOO_REQUESTS} reqs x {ZOO_CLIENTS} clients, "
+                   f"4 tenants, 2 engines x 2 workers"),
+    }
+
+
 # scenario registry for --scenarios (cheap subsets of the full bench:
 # the serving/lifecycle numbers are measurable on any backend, the
 # training-throughput scenarios only mean anything on the TPU chip)
@@ -1237,6 +1388,7 @@ SCENARIOS = {
     "quant": lambda: ("secondary_quant", bench_quant()),
     "coldstart": lambda: ("secondary_coldstart", bench_coldstart()),
     "ingress": lambda: ("secondary_ingress", bench_ingress()),
+    "zoo": lambda: ("secondary_zoo", bench_zoo()),
 }
 
 
@@ -1246,8 +1398,8 @@ def main():
     ap.add_argument(
         "--scenarios", default="all",
         help="comma list from {cifar,resnet,lm,higgs,serving,swap,"
-             "automl,pipeline,observability,quant,coldstart,ingress} "
-             "or 'all' (the full flagship bench)")
+             "automl,pipeline,observability,quant,coldstart,ingress,"
+             "zoo} or 'all' (the full flagship bench)")
     args = ap.parse_args()
     if args.scenarios != "all":
         _enable_compile_cache()

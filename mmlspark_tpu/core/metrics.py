@@ -181,6 +181,51 @@ def histogram_set(*names: str) -> Dict[str, LatencyHistogram]:
     return {n: LatencyHistogram() for n in names}
 
 
+class LabelledHistograms:
+    """Per-label ``LatencyHistogram`` family with a HARD cardinality
+    cap: the first ``cap`` distinct labels get their own histogram,
+    every later label folds into the shared ``"_other"`` series. The
+    multi-model serving plane labels latency per model name, and a zoo
+    of thousands of models must not turn /metrics into thousands of
+    18-bucket series (the Prometheus label-cardinality discipline —
+    see docs/model_zoo.md). Thread-safe; ``observe`` on an
+    already-known label is lock-free on the read path."""
+
+    OTHER = "_other"
+
+    def __init__(self, cap: int = 64):
+        self.cap = max(1, int(cap))
+        self._hists: Dict[str, LatencyHistogram] = {}
+        self._lock = threading.Lock()
+
+    def hist(self, label: str) -> LatencyHistogram:
+        label = str(label)
+        h = self._hists.get(label)
+        if h is not None:
+            return h
+        with self._lock:
+            h = self._hists.get(label)
+            if h is None:
+                named = len(self._hists) - (
+                    1 if self.OTHER in self._hists else 0)
+                if named < self.cap:
+                    h = self._hists[label] = LatencyHistogram()
+                else:
+                    h = self._hists.get(self.OTHER)
+                    if h is None:
+                        h = self._hists[self.OTHER] = LatencyHistogram()
+        return h
+
+    def observe(self, label: str, value: float) -> None:
+        self.hist(label).observe(value)
+
+    def snapshot(self) -> Dict[str, LatencyHistogram]:
+        """label -> histogram (the live objects — exporters need exact
+        buckets), at most ``cap`` named series plus ``_other``."""
+        with self._lock:
+            return dict(self._hists)
+
+
 # ---------------------------------------------------------------------------
 # GBDT training-phase histograms
 # ---------------------------------------------------------------------------

@@ -213,6 +213,50 @@ def pipeline_families(r: PromRenderer, pipeline: Any,
             pass
 
 
+def zoo_families(r: PromRenderer, zoo: Any,
+                 labels: Optional[Dict[str, Any]] = None) -> None:
+    """The multi-model serving plane's families (serving/zoo.py):
+    state counts + lifecycle counters (always full totals), per-model
+    ``serving_model_info`` rows, and per-model latency histograms.
+    The per-model label space is HARD-CAPPED at the zoo's
+    ``label_cardinality_cap`` — info rows are resident-first
+    most-recent-first, latency overflow folds into ``model="_other"``
+    — so a 256-model zoo scrapes like a 64-model one
+    (docs/model_zoo.md)."""
+    s = zoo.stats()
+    base = dict(labels or {})
+    for state in sorted(s["by_state"]):
+        r.gauge("serving_zoo_models",
+                "registered zoo models by lifecycle state",
+                s["by_state"][state], {**base, "state": state})
+    r.gauge("serving_zoo_registered_models",
+            "total models registered in the zoo", s["registered"], base)
+    r.gauge("serving_zoo_resident_bytes",
+            "estimated bytes held by resident models",
+            s["resident_bytes"], base)
+    r.counter("serving_zoo_activations_total",
+              "lazy model activations (AOT load + warmup)",
+              s["activations"], base)
+    r.counter("serving_zoo_evictions_total",
+              "LRU evictions under the memory/count budget",
+              s["evictions"], base)
+    r.counter("serving_zoo_load_failures_total",
+              "model activations that raised", s["load_failures"], base)
+    for m in s["models"]:
+        r.info("serving_model_info",
+               "per-model metadata (cardinality-capped: resident-first "
+               "most-recent rows up to the zoo's label cap)",
+               {**base, "model": m["model"], "version": m["version"],
+                "precision": m["precision"],
+                "aot": "true" if m["aot"] else "false",
+                "state": m["state"]})
+    for label, hist in sorted(zoo.model_histograms().items()):
+        r.histogram("serving_model_latency_ms",
+                    "per-model batch execution latency (cardinality-"
+                    'capped: overflow models fold into model="_other")',
+                    hist, {**base, "model": label})
+
+
 def drift_families(r: PromRenderer, monitor: Any,
                    labels: Optional[Dict[str, Any]] = None) -> None:
     """``DriftMonitor`` summary as gauges (served-traffic feature drift

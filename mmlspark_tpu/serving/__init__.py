@@ -5,6 +5,9 @@ DistributedHTTPSource.scala, ServingImplicits.scala,
 PartitionConsolidator.scala).
 """
 
+from mmlspark_tpu.serving.admission import (
+    AdmissionController, TenantQuota,
+)
 from mmlspark_tpu.serving.aot import (
     export_model, load_model, read_manifest,
 )
@@ -19,11 +22,15 @@ from mmlspark_tpu.serving.server import (
     HTTPSource, PipelineHandle, ServingEngine, SharedSingleton,
     SharedVariable, serve_model,
 )
+from mmlspark_tpu.serving.zoo import (
+    ModelZoo, ZooEvent, model_key_of,
+)
 
-__all__ = ["CanaryPolicy", "HTTPSource", "ModelRegistry",
-           "PartitionConsolidator", "PipelineHandle", "ServingEngine",
+__all__ = ["AdmissionController", "CanaryPolicy", "HTTPSource",
+           "ModelRegistry", "ModelZoo", "PartitionConsolidator",
+           "PipelineHandle", "ServingEngine",
            "ServingFleet", "ServingUnavailable", "SharedSingleton",
            "SharedVariable", "SwapEvent", "SwapInProgress", "SwapResult",
-           "export_model", "json_row_scoring_pipeline",
-           "json_scoring_pipeline", "load_model", "read_manifest",
-           "serve_model"]
+           "TenantQuota", "ZooEvent", "export_model",
+           "json_row_scoring_pipeline", "json_scoring_pipeline",
+           "load_model", "model_key_of", "read_manifest", "serve_model"]

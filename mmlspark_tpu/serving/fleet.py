@@ -649,12 +649,21 @@ class _FusedPipelineScorer:
         through the shared bucket loop (core/warmup.py), so each
         bucket's compile wall lands in the ``model_warmup_ms``
         histogram on /metrics — near-zero for AOT-loaded pipelines."""
-        from mmlspark_tpu.core.warmup import warmup_buckets
+        from mmlspark_tpu.core.warmup import (
+            warmup_buckets, warn_warmup_example,
+        )
         from mmlspark_tpu.io.http import _jsonable
         table = example if isinstance(example, DataTable) \
             else DataTable(dict(example))
         if len(table) == 0:
             raise ValueError("warmup needs at least one example row")
+        # PR 11 footnote, enforced: an all-None column (or a column set
+        # that disagrees with live traffic's pinned request keys) would
+        # warm programs no live batch matches — warn NOW, actionably,
+        # instead of silently recompiling on the first live batch
+        with self._names_lock:
+            live = list(self._row_names)
+        warn_warmup_example(table, live_columns=live or None)
         body = [json.dumps({k: _jsonable(v) for k, v in row.items()}
                            ).encode() for row in table.rows()]
 
@@ -696,11 +705,14 @@ class _FusedPipelineScorer:
 
 
 # engine-reported statuses worth failing over for: overload/shedding
-# (503 + Retry-After), serving timeout (504), gateway-ish 502, and 429.
+# (503 + Retry-After), serving timeout (504), and gateway-ish 502.
 # Anything else 4xx/5xx is the REQUEST's problem (poison row -> 500) and
 # must surface to the caller unchanged — retrying it on another replica
-# would just poison that one too.
-_FAILOVER_CODES = frozenset({429, 502, 503, 504})
+# would just poison that one too. 429 is deliberately NOT here: the
+# admission layer's tenant quotas (serving/admission.py) are fleet-wide,
+# so replaying an over-quota request on the next replica would only
+# spend the tenant's tokens everywhere — the 429 surfaces to the caller.
+_FAILOVER_CODES = frozenset({502, 503, 504})
 
 
 class ServingFleet:
@@ -719,7 +731,7 @@ class ServingFleet:
     latency percentile fires a duplicate on another replica and the first
     reply wins."""
 
-    def __init__(self, pipeline, n_engines: int = 2,
+    def __init__(self, pipeline=None, n_engines: int = 2,
                  host: str = "127.0.0.1", base_port: int = 18700,
                  batch_size: int = 64, reply_col: str = "reply",
                  workers: int = 1,
@@ -731,8 +743,14 @@ class ServingFleet:
                  max_wait_ms: float = 5.0,
                  pipeline_depth: int = 2,
                  version: str = "v0", tracer=None,
-                 tracing: Optional[bool] = None):
+                 tracing: Optional[bool] = None,
+                 zoo=None, admission=None):
         from mmlspark_tpu.core import trace as trace_mod
+        # the multi-model plane: ONE zoo (and one admission controller)
+        # shared by every engine — models are process-resident, so the
+        # device-memory budget and tenant quotas are fleet-wide
+        self.zoo = zoo
+        self.admission = admission
         # ONE tracer across the fleet: every engine's completed traces
         # land in the same tail-sampled buffer, so fleet.traces() is
         # the whole fleet's story (default: the process-wide tracer)
@@ -777,7 +795,8 @@ class ServingFleet:
                         max_wait_ms=max_wait_ms,
                         pipeline_depth=pipeline_depth,
                         version=version, tracer=self.tracer,
-                        tracing=self.tracer is not None).start()
+                        tracing=self.tracer is not None,
+                        zoo=zoo, admission=admission).start()
                 except Exception:
                     source.close()   # don't orphan the bound port
                     raise
@@ -838,6 +857,7 @@ class ServingFleet:
     def _http_post(cls, addr: str, body: bytes, timeout: float,
                    replayable: bool = True, pooled: bool = True,
                    content_type: str = "application/json",
+                   extra_headers: Optional[Dict[str, str]] = None,
                    ) -> Dict[str, Any]:
         """POST over a pooled keep-alive connection (HTTP/1.1): the
         serving hot path pays no TCP handshake and spawns no server
@@ -858,7 +878,7 @@ class ServingFleet:
         caller's failover policy decides."""
         import time as _time
         t0 = _time.perf_counter()
-        headers = {"Content-Type": content_type}
+        headers = {"Content-Type": content_type, **(extra_headers or {})}
         for attempt in (0, 1):
             if pooled:
                 conn = cls._pooled_conn(addr, timeout)
@@ -965,7 +985,9 @@ class ServingFleet:
 
     def _attempt(self, i: int, body: bytes, timeout: float, tried: set,
                  allow_hedge: bool,
-                 content_type: str = "application/json") -> Dict[str, Any]:
+                 content_type: str = "application/json",
+                 extra_headers: Optional[Dict[str, str]] = None,
+                 ) -> Dict[str, Any]:
         """One logical attempt against engine ``i``, hedged onto another
         replica if allowed and the reply is slower than the hedge
         threshold. ALL breaker recording happens here — for a hedged
@@ -983,7 +1005,8 @@ class ServingFleet:
                 # response-phase stale-connection failure
                 result = self._http_post(addr, body, timeout,
                                          replayable=allow_hedge,
-                                         content_type=content_type)
+                                         content_type=content_type,
+                                         extra_headers=extra_headers)
             except Exception as e:
                 self._classify_and_record(breaker, e)
                 raise
@@ -995,7 +1018,7 @@ class ServingFleet:
         # each call would strand a keep-alive conn in a dead thread's
         # local storage (hedging only runs for idempotent requests)
         f1 = self._submit(self._http_post, addr, body, timeout,
-                          True, False, content_type)
+                          True, False, content_type, extra_headers)
         f1.add_done_callback(
             lambda f: self._classify_and_record(breaker, f.exception()))
         try:
@@ -1016,7 +1039,8 @@ class ServingFleet:
             self.hedged_requests += 1
         tried.add(j)   # the hedge consumed replica j for this request
         f2 = self._submit(self._http_post, self.addresses[j], body,
-                          timeout, True, False, content_type)
+                          timeout, True, False, content_type,
+                          extra_headers)
         f2.add_done_callback(
             lambda f: self._classify_and_record(self.breakers[j],
                                                 f.exception()))
@@ -1041,9 +1065,29 @@ class ServingFleet:
 
     # -- the client --------------------------------------------------------
 
+    @staticmethod
+    def _route_headers(model: Optional[str], tenant: Optional[str],
+                       priority: Optional[int],
+                       headers: Optional[Dict[str, str]]
+                       ) -> Optional[Dict[str, str]]:
+        """The model-routing/admission headers (serving/zoo.py +
+        serving/admission.py) as one merged extra-header dict."""
+        out = dict(headers or {})
+        if model is not None:
+            out["X-Model"] = str(model)
+        if tenant is not None:
+            out["X-Tenant"] = str(tenant)
+        if priority is not None:
+            out["X-Priority"] = str(int(priority))
+        return out or None
+
     def post(self, payload: Any, timeout: float = 30.0,
              idempotent: bool = True,
-             content_type: str = "application/json") -> Dict[str, Any]:
+             content_type: str = "application/json",
+             model: Optional[str] = None,
+             tenant: Optional[str] = None,
+             priority: Optional[int] = None,
+             headers: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
         """Failover-aware round-robin client — the stand-in for an
         external load balancer in tests/examples.
 
@@ -1052,9 +1096,17 @@ class ServingFleet:
         replica when ``idempotent`` (scoring requests are). When every
         candidate fails, raises ``ServingUnavailable`` carrying the
         per-engine attempt log. Application-level HTTP errors (e.g. a
-        poison row's 500) propagate unchanged."""
+        poison row's 500) propagate unchanged — as do admission 429s
+        (a tenant's empty quota is fleet-wide; replaying the request
+        on another replica would just spend it there too).
+
+        ``model``/``tenant``/``priority`` ride as the multi-model
+        plane's routing headers (``X-Model``/``X-Tenant``/
+        ``X-Priority``); ``headers`` adds arbitrary extras."""
         body = payload if isinstance(payload, bytes) \
             else json.dumps(payload).encode()
+        extra_headers = self._route_headers(model, tenant, priority,
+                                            headers)
         n = len(self.engines)
         start = next(self._next)
         order = [(start + k) % n for k in range(n)]
@@ -1076,7 +1128,8 @@ class ServingFleet:
                 # _attempt owns ALL breaker recording (incl. hedge legs)
                 result = self._attempt(i, body, timeout, tried,
                                        allow_hedge=idempotent,
-                                       content_type=content_type)
+                                       content_type=content_type,
+                                       extra_headers=extra_headers)
             except urllib.error.HTTPError as e:
                 if e.code in _FAILOVER_CODES:
                     attempts.append(
@@ -1110,7 +1163,8 @@ class ServingFleet:
                 raise ServingUnavailable(attempts)
             try:
                 return self._probe(order[0], body, timeout, attempts,
-                                   idempotent, content_type)
+                                   idempotent, content_type,
+                                   extra_headers)
             finally:
                 self._probe_lock.release()
         raise ServingUnavailable(attempts)
@@ -1118,12 +1172,15 @@ class ServingFleet:
     def _probe(self, i: int, body: bytes, timeout: float,
                attempts: List[Dict[str, Any]],
                replayable: bool = True,
-               content_type: str = "application/json") -> Dict[str, Any]:
+               content_type: str = "application/json",
+               extra_headers: Optional[Dict[str, str]] = None,
+               ) -> Dict[str, Any]:
         """The all-circuits-open last-resort probe of engine ``i``."""
         try:
             result = self._http_post(self.addresses[i], body, timeout,
                                      replayable=replayable,
-                                     content_type=content_type)
+                                     content_type=content_type,
+                                     extra_headers=extra_headers)
         except urllib.error.HTTPError as e:
             if e.code not in _FAILOVER_CODES:
                 # engine alive and answering: the post() contract —
@@ -1151,7 +1208,10 @@ class ServingFleet:
 
     def post_columns(self, columns: Dict[str, Any],
                      timeout: float = 30.0, codec: str = "msgpack",
-                     idempotent: bool = True) -> Dict[str, Any]:
+                     idempotent: bool = True,
+                     model: Optional[str] = None,
+                     tenant: Optional[str] = None,
+                     priority: Optional[int] = None) -> Dict[str, Any]:
         """The pooled COLUMNAR client: typed columns (numpy arrays /
         string lists / token lists, any row count) encode ONCE as a
         columnar record batch and ride the same keep-alive pool,
@@ -1173,7 +1233,8 @@ class ServingFleet:
             try:
                 result = self.post(body, timeout=timeout,
                                    idempotent=idempotent,
-                                   content_type=ct)
+                                   content_type=ct, model=model,
+                                   tenant=tenant, priority=priority)
                 self._columnar_ok = True   # (re-)probe succeeded
                 return result
             except urllib.error.HTTPError as e:
@@ -1185,7 +1246,9 @@ class ServingFleet:
                     raise
                 log.warning("columnar POST rejected (HTTP %d); "
                             "retrying as JSON", e.code)
-        out = self._post_columns_json(columns, timeout, idempotent)
+        out = self._post_columns_json(columns, timeout, idempotent,
+                                      model=model, tenant=tenant,
+                                      priority=priority)
         if try_columnar:
             # the JSON replay succeeded where columnar failed: treat
             # the engine as JSON-only for a cooldown, then re-probe —
@@ -1200,14 +1263,20 @@ class ServingFleet:
 
     def _post_columns_json(self, columns: Dict[str, Any],
                            timeout: float,
-                           idempotent: bool) -> Dict[str, Any]:
+                           idempotent: bool,
+                           model: Optional[str] = None,
+                           tenant: Optional[str] = None,
+                           priority: Optional[int] = None
+                           ) -> Dict[str, Any]:
         """The negotiation fallback: replay the columns as per-row JSON
         oracle requests, merging the scalar replies into the columnar
         reply shape (one list per reply key)."""
         from mmlspark_tpu.io.columnar import columns_to_rows
         merged: Dict[str, List[Any]] = {}
         for row in columns_to_rows(columns):
-            body = self.post(row, timeout=timeout, idempotent=idempotent)
+            body = self.post(row, timeout=timeout, idempotent=idempotent,
+                             model=model, tenant=tenant,
+                             priority=priority)
             for k, v in body.items():
                 merged.setdefault(k, []).append(v)
         return merged
@@ -1326,6 +1395,21 @@ class ServingFleet:
                     "precision": snap["precision"],
                     "aot": "true" if snap["aot"] else "false",
                     "swap_state": snap["swap_state"]})
+            with e._stats_lock:
+                rejections = dict(e.rejections)
+            for reason in sorted(rejections):
+                r.counter("serving_admission_rejected_total",
+                          "requests rejected by admission/model routing",
+                          rejections[reason],
+                          {**labels, "reason": reason})
+        if self.zoo is not None:
+            # ONE zoo across the fleet: its families render once, not
+            # per engine (the per-model label space stays capped)
+            from mmlspark_tpu.core.prometheus import zoo_families
+            try:
+                zoo_families(r, self.zoo)
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         if self.engines:
             for key in self.engines[0].hists:
                 merged = LatencyHistogram.merged(

@@ -44,7 +44,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from mmlspark_tpu.core.logging_utils import get_logger
 from mmlspark_tpu.core.metrics import LatencyHistogram
@@ -164,7 +164,13 @@ class ModelRegistry:
     Versions are insertion-ordered; ``previous(v)`` answers "what do we
     roll back to" and the registry records every ``SwapEvent`` handed
     to ``record_event`` so ops can audit the lifecycle history.
+    ``events`` keeps the newest ``events_cap`` records — swaps alone
+    would never fill it, but the model zoo logs every
+    activate/evict on the same timeline, and a churning cache in an
+    always-on process must not grow the audit log forever.
     Thread-safe."""
+
+    events_cap = 4096
 
     def __init__(self):
         self._versions: Dict[str, Any] = {}
@@ -196,6 +202,42 @@ class ModelRegistry:
                                f"have {self._order}")
             return self._versions[version]
 
+    def _entry_locked(self, version: str
+                      ) -> "Tuple[Any, str, Dict[str, Any]]":
+        """(served object, state, metadata) for one version — caller
+        holds ``self._lock``. Base registries hold materialized
+        pipelines, so state is always ``"registered"``; ``ModelZoo``
+        overrides this with its load/evict lifecycle (and a
+        ``PipelineHandle`` in the first slot when resident)."""
+        return (self._versions[version], "registered",
+                dict(self._meta.get(version, {})))
+
+    def lookup(self, version: str) -> "Tuple[Any, str, Dict[str, Any]]":
+        """ONE consistent ``(handle, state, metadata)`` triple under
+        the registry lock — the ``engine._lifecycle_snapshot``
+        discipline applied to registry reads. A reader racing a
+        concurrent register/load/evict must never see a half-updated
+        entry (e.g. state ``resident`` with no handle, or metadata
+        from a different lifecycle step than the state)."""
+        with self._lock:
+            if version not in self._versions:
+                raise KeyError(f"unknown model version {version!r}; "
+                               f"have {self._order}")
+            return self._entry_locked(version)
+
+    def list(self) -> List[Dict[str, Any]]:
+        """Every version's ``{version, state, metadata, loaded}`` as
+        ONE consistent snapshot under the registry lock, in insertion
+        order (the ``lookup`` consistency contract, registry-wide)."""
+        with self._lock:
+            out = []
+            for v in self._order:
+                obj, state, meta = self._entry_locked(v)
+                out.append({"version": v, "state": state,
+                            "metadata": meta,
+                            "loaded": obj is not None})
+            return out
+
     def metadata(self, version: str) -> Dict[str, Any]:
         with self._lock:
             return dict(self._meta.get(version, {}))
@@ -220,6 +262,8 @@ class ModelRegistry:
     def record_event(self, event: SwapEvent) -> None:
         with self._lock:
             self.events.append(event)
+            if len(self.events) > self.events_cap:
+                del self.events[:len(self.events) - self.events_cap]
 
 
 class SwapController:

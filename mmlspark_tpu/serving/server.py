@@ -310,11 +310,12 @@ class HTTPSource:
                     if tr is None:
                         return
                     tr.root.set("http_status", code)
-                    if code == 503:
-                        # load shedding is EXPECTED back-pressure, not
-                        # a failure: marking sheds as errors would let
-                        # an overload flood the protected tail ring and
-                        # evict the genuine error traces it exists for
+                    if code in (429, 503):
+                        # load shedding / admission rejections are
+                        # EXPECTED back-pressure, not failures: marking
+                        # them as errors would let an overload flood the
+                        # protected tail ring and evict the genuine
+                        # error traces it exists for
                         tr.root.set("shed", True)
                     elif code >= 500:
                         tr.root.error()
@@ -512,6 +513,17 @@ class HTTPSource:
         self.server.server_close()
 
 
+class _NoDefaultPipeline:
+    """Placeholder active pipeline of a zoo-only engine (no default
+    model): reaching it means a request bypassed the model-routing
+    reject, which is a bug — fail loudly."""
+
+    def transform(self, table):
+        raise RuntimeError("engine has no default pipeline; requests "
+                           "must name a model (X-Model header or "
+                           "/models/<name@version> path)")
+
+
 class PipelineHandle:
     """One immutable (pipeline, version) binding plus its in-flight
     batch count — the unit of the zero-downtime swap protocol. Every
@@ -524,11 +536,16 @@ class PipelineHandle:
     the lifecycle layer: canary batch outcomes feed the controller's
     breach detector, and a failing canary batch re-executes on
     ``rescue_to`` (the stable handle) so clients never eat a canary's
-    faults."""
+    faults.
+
+    ``model_name``/``model_key`` are set only on zoo handles
+    (serving/zoo.py): a model-routed batch carries the model identity
+    through decode/execute/reply, so device spans and reply headers
+    can audit exactly which ``name@version`` served each row."""
 
     __slots__ = ("pipeline", "version", "precision", "aot", "prepare",
                  "execute", "is_canary", "controller", "rescue_to",
-                 "_outstanding", "_lock")
+                 "model_name", "model_key", "_outstanding", "_lock")
 
     def __init__(self, pipeline: Transformer, version: str,
                  is_canary: bool = False):
@@ -548,6 +565,8 @@ class PipelineHandle:
         self.is_canary = bool(is_canary)
         self.controller = None
         self.rescue_to: Optional["PipelineHandle"] = None
+        self.model_name: Optional[str] = None
+        self.model_key: Optional[str] = None
         self._outstanding = 0
         self._lock = threading.Lock()
 
@@ -643,17 +662,44 @@ class ServingEngine:
     pad / device split) exported through ``metrics()`` and /healthz.
     """
 
-    def __init__(self, source: HTTPSource, pipeline: Transformer,
+    def __init__(self, source: HTTPSource,
+                 pipeline: Optional[Transformer] = None,
                  reply_col: str = "reply", id_col: str = "id",
                  batch_size: int = 64,
                  content_type: str = "application/json",
                  error_col: str = "error", workers: int = 1,
                  max_wait_ms: float = 5.0, pipeline_depth: int = 2,
                  version: str = "v0", tracer=None,
-                 tracing: Optional[bool] = None):
+                 tracing: Optional[bool] = None,
+                 zoo=None, admission=None,
+                 activation_timeout_s: float = 30.0,
+                 zoo_enforce_interval_s: float = 1.0):
         from mmlspark_tpu.core.metrics import histogram_set
         from mmlspark_tpu.core import trace as trace_mod
         self.source = source
+        # multi-model plane (serving/zoo.py + serving/admission.py):
+        # with a zoo, requests carrying model=name@version route to
+        # lazily-activated zoo handles; ``pipeline`` stays the default
+        # for unkeyed requests (None = unkeyed requests answer 400)
+        if pipeline is None and zoo is None:
+            raise ValueError("ServingEngine needs a pipeline, a zoo, "
+                             "or both")
+        self.zoo = zoo
+        self.admission = admission
+        self.activation_timeout_s = float(activation_timeout_s)
+        self._zoo_enforce_interval_s = float(zoo_enforce_interval_s)
+        self._default_ok = pipeline is not None
+        if pipeline is None:
+            pipeline = _NoDefaultPipeline()
+        # batcher-thread-only state: requests parked on a model that is
+        # still activating (flushed by _poll_awaiting; bounded by the
+        # source's parked-request table like every parked request)
+        self._awaiting: Dict[str, List[_ParkedRequest]] = {}
+        self._awaiting_since: Dict[str, float] = {}
+        # admission/routing rejections by reason (under _stats_lock):
+        # quota, priority, no_model, unknown_model, load_failed,
+        # activation_timeout
+        self.rejections: Dict[str, int] = {}
         # request tracing: ``tracing`` overrides config
         # ``trace.enabled``; the tracer (and so the completed-trace
         # buffer) defaults to the process-wide one, so a fleet's
@@ -756,13 +802,19 @@ class ServingEngine:
         return execute_swap(self, pipeline, version,
                             warmup_example=warmup_example, policy=policy)
 
-    def _respond_ok(self, rid: str, rep: Any) -> None:
+    def _respond_ok(self, rid: str, rep: Any,
+                    handle: Optional[PipelineHandle] = None) -> None:
         body = rep if isinstance(rep, (bytes, str)) \
             else json.dumps(_to_jsonable(rep))
+        headers = {"Content-Type": self.content_type}
+        if handle is not None and handle.model_key is not None:
+            # model-routed replies echo the serving identity so a
+            # client (and the chaos drill) can audit that no reply ever
+            # crossed models
+            headers["X-Model"] = handle.model_key
         self.source.respond(rid, HTTPSchema.response(
             200, "OK", body if isinstance(body, bytes)
-            else body.encode("utf-8"),
-            {"Content-Type": self.content_type}))
+            else body.encode("utf-8"), headers))
 
     def _finish_request_trace(self, tctx: Optional[_BatchTraceCtx],
                               rid: str, t_answer: float,
@@ -786,7 +838,8 @@ class ServingEngine:
         root.finish()
 
     def _answer_output(self, out: DataTable, ids: List[str],
-                       tctx: Optional[_BatchTraceCtx] = None) -> None:
+                       tctx: Optional[_BatchTraceCtx] = None,
+                       handle: Optional[PipelineHandle] = None) -> None:
         """Answer one transformed batch, splitting per-row errors: a
         non-null ``error_col`` value means that row failed and gets a
         500 while its batchmates still get their 200s
@@ -796,6 +849,11 @@ class ServingEngine:
         out_ids = out[self.id_col]
         errors = (out[self.error_col]
                   if self.error_col in out.column_names else None)
+        # per-row 500s echo the model identity too: a client auditing
+        # routing must be able to attribute EVERY reply, not just 200s
+        err_headers = ({"X-Model": handle.model_key}
+                       if handle is not None
+                       and handle.model_key is not None else None)
         answered = set()
         for i, (rid, rep) in enumerate(zip(out_ids, replies)):
             err = errors[i] if errors is not None else None
@@ -803,17 +861,17 @@ class ServingEngine:
                 self._finish_request_trace(tctx, rid, t_answer,
                                            error=True)
                 self.source.respond(rid, HTTPSchema.response(
-                    500, f"row error: {err}", None))
+                    500, f"row error: {err}", None, err_headers))
             else:
                 self._finish_request_trace(tctx, rid, t_answer)
-                self._respond_ok(rid, rep)
+                self._respond_ok(rid, rep, handle)
             answered.add(rid)
         for rid in ids:
             if rid not in answered:
                 self._finish_request_trace(tctx, rid, t_answer,
                                            error=True)
                 self.source.respond(rid, HTTPSchema.response(
-                    500, "row dropped by pipeline", None))
+                    500, "row dropped by pipeline", None, err_headers))
 
     def process_one_batch(self, wait_s: float = 0.05) -> int:
         """Synchronous one-shot drain (fixed poll window) — kept for
@@ -837,6 +895,8 @@ class ServingEngine:
         tctx.dispatched_at = None      # re-run starts its span at now
         ds = tctx.batch_span("device", start=start)
         ds.set("model_version", handle.version)
+        if handle.model_key is not None:
+            ds.set("model", handle.model_key)
         ds.set("rows", rows)
         if handle.is_canary:
             ds.set("canary", True)
@@ -930,9 +990,12 @@ class ServingEngine:
             ctl.observe(handle, ok=True, latency_ms=dt_ms,
                         row_errors=row_errors)
         self.hists["pipeline_ms"].observe(dt_ms)
+        if self.zoo is not None and handle.model_name is not None:
+            # per-model latency (cardinality-capped — serving/zoo.py)
+            self.zoo.observe_latency(handle.model_name, dt_ms)
         t1 = time.perf_counter()
         try:
-            self._answer_output(out, ids, tctx)
+            self._answer_output(out, ids, tctx, handle)
         except Exception as e:  # noqa: BLE001 — e.g. missing reply column
             log.warning("answering batch failed (%s); sending 500s", e)
             for rid in ids:
@@ -993,7 +1056,7 @@ class ServingEngine:
                 out = handle.pipeline.transform(row)
                 if span is not None:
                     span.finish()
-                self._answer_output(out, [rid], tctx)
+                self._answer_output(out, [rid], tctx, handle)
             except Exception as e:  # noqa: BLE001
                 if span is not None:
                     span.error(e).finish()
@@ -1097,7 +1160,14 @@ class ServingEngine:
         work instead of serializing with it. While the dispatch queue
         is full (workers saturated), the pending batch keeps absorbing
         newly-queued requests up to batch_size, so batches grow toward
-        full occupancy exactly when the device is the bottleneck."""
+        full occupancy exactly when the device is the bottleneck.
+
+        With a model zoo attached the plane is MODEL-ROUTED: each
+        drained batch passes admission (per-tenant quotas, priority
+        tiers) and partitions by ``model=name@version`` so a
+        micro-batch never mixes models; cold models activate on the
+        zoo's loader thread while their requests park in
+        ``_awaiting`` — resident models keep dispatching meanwhile."""
         while not self._stop.is_set():
             try:
                 parked = self.source.drain_parked(
@@ -1106,31 +1176,82 @@ class ServingEngine:
                 log.error("serving batcher error (continuing): %s", e)
                 time.sleep(0.005)
                 continue
-            if not parked:
+            if self.zoo is None:
+                if parked:
+                    self._dispatch_parked(parked)
                 continue
-            # wait for an in-flight token, topping the pending batch up
-            # from the queue meanwhile: back-pressure converts directly
-            # into batch occupancy instead of tiny trailing batches
-            granted = False
-            while not self._stop.is_set():
-                if self._inflight.acquire(timeout=0.005):
-                    granted = True
-                    break
-                if len(parked) < self.batch_size:
-                    try:
-                        self.source.top_up(parked, self.batch_size)
-                    except Exception:  # noqa: BLE001 — source closing
-                        pass
-            if not granted:          # stopping — parked requests will
-                continue             # run out their reply timeout
-            # token ownership transfers to the worker ONLY on a
-            # successful put; any other exit (assembly failure, a
-            # respond() error, a BaseException killing this thread)
-            # must give it back, or each incident would permanently
-            # shrink the engine's dispatch budget
-            handed_off = False
-            handle = None
+            groups: List[Tuple] = []
             try:
+                groups = self._partition_parked(parked)
+                groups.extend(self._poll_awaiting())
+            except Exception as e:  # noqa: BLE001 — keep collecting
+                log.error("model routing failed (%s); dropping to 500s",
+                          e)
+                # last resort (partition/poll handle their own zoo
+                # faults per group): requests IN a built group are
+                # unanswered by construction — answer them and drain
+                # their zoo handles, or their models could never evict
+                # again. Rejected requests were already answered and
+                # must not be responded to twice; anything partition
+                # never reached runs out its reply timeout.
+                for handle, group, _prio in groups:
+                    if handle is not None:
+                        handle.release()
+                    for p in group:
+                        self.source.respond(p.id, HTTPSchema.response(
+                            500, f"model routing error: {e}", None))
+                continue
+            try:
+                # LRU eviction under memory pressure, rate-gated: the
+                # batcher is the one thread that is always awake while
+                # traffic flows (the loader also enforces after loads)
+                self.zoo.enforce(
+                    min_interval_s=self._zoo_enforce_interval_s)
+            except Exception as e:  # noqa: BLE001 — eviction is
+                # best-effort here; the loader's post-load enforce
+                # and the next tick retry
+                log.error("zoo enforce failed (continuing): %s", e)
+            # priority-tiered batching: higher tiers (lower numbers)
+            # dispatch first, so a cold-activation flush or low-tier
+            # burst never queues ahead of premium traffic
+            groups.sort(key=lambda g: g[2])
+            for handle, group, _prio in groups:
+                self._dispatch_parked(group, handle=handle)
+
+    def _dispatch_parked(self, parked: List[_ParkedRequest],
+                         handle: Optional[PipelineHandle] = None) -> None:
+        """Token-gate + assemble + dispatch ONE micro-batch. ``handle``
+        is None for the default (single-model) path — version routing
+        and acquisition happen here — or a zoo handle that arrives
+        ALREADY acquired (zoo.acquire bumps outstanding under the
+        registry lock, atomically with the eviction scan)."""
+        # wait for an in-flight token, topping the pending batch up
+        # from the queue meanwhile: back-pressure converts directly
+        # into batch occupancy instead of tiny trailing batches.
+        # (Model-routed engines skip the top-up: absorbed requests
+        # could belong to other models/tenants.)
+        granted = False
+        while not self._stop.is_set():
+            if self._inflight.acquire(timeout=0.005):
+                granted = True
+                break
+            if self.zoo is None and len(parked) < self.batch_size:
+                try:
+                    self.source.top_up(parked, self.batch_size)
+                except Exception:  # noqa: BLE001 — source closing
+                    pass
+        if not granted:              # stopping — parked requests will
+            if handle is not None:   # run out their reply timeout, but
+                handle.release()     # the zoo handle must drain
+            return
+        # token ownership transfers to the worker ONLY on a
+        # successful put; any other exit (assembly failure, a
+        # respond() error, a BaseException killing this thread)
+        # must give it back, or each incident would permanently
+        # shrink the engine's dispatch budget
+        handed_off = False
+        try:
+            if handle is None:
                 # version routing happens HERE, once per batch: the
                 # handle rides with the item so decode, execution,
                 # retries, and replies all use one model version.
@@ -1145,35 +1266,236 @@ class ServingEngine:
                     handle.release()
                     handle = self._active   # stale route: follow cutover
                     handle.acquire()
-                try:
-                    item = self._build_item(parked, handle)
-                except Exception as e:  # noqa: BLE001
-                    log.error("batch assembly failed (%s); "
-                              "dropping to 500s", e)
-                    for p in parked:
-                        self.source.respond(p.id, HTTPSchema.response(
-                            500, f"batch assembly error: {e}", None))
+            try:
+                item = self._build_item(parked, handle)
+            except Exception as e:  # noqa: BLE001
+                log.error("batch assembly failed (%s); "
+                          "dropping to 500s", e)
+                for p in parked:
+                    self.source.respond(p.id, HTTPSchema.response(
+                        500, f"batch assembly error: {e}", None))
+                return
+            if item is None:
+                # every request in the batch was codec-rejected
+                # (each already answered 400); nothing to dispatch
+                return
+            self._dispatch_q.put(item)   # unbounded: tokens bound it
+            handed_off = True
+        finally:
+            if not handed_off:
+                # both the in-flight token AND the version handle
+                # must come back on any non-dispatch exit
+                if handle is not None:
+                    handle.release()
+                self._inflight.release()
+        for p in parked:
+            # dequeue stamp, not dispatch time: queue_wait must not
+            # absorb the token wait or the decode stage (decode_ms
+            # measures that) — the breakdown stays additive
+            self.hists["queue_wait_ms"].observe(
+                max(0.0, p.dequeued_at - p.enqueued_at) * 1e3)
+        self.hists["batch_rows"].observe(float(len(parked)))
+
+    # -- model routing + admission (zoo engines; batcher thread only) -------
+
+    def _pressure(self) -> int:
+        """The admission layer's saturation signal: prepared batches
+        queued behind busy workers PLUS requests backed up in the
+        source queue. The dispatch queue alone is bounded by the
+        in-flight token count (workers + pipeline_depth - 1, typically
+        2-3), which would leave the default tier limits unreachable;
+        the source backlog is where real overload actually shows."""
+        pressure = self._dispatch_q.qsize()
+        try:
+            pressure += self.source.queue.qsize()
+        except Exception:  # noqa: BLE001 — source closing
+            pass
+        return pressure
+
+    def _reject_parked(self, p: _ParkedRequest, code: int, reason: str,
+                       message: str,
+                       headers: Optional[Dict[str, str]] = None) -> None:
+        """Answer one request rejected by admission/model routing,
+        counting it by reason (``serving_admission_rejected_total``)."""
+        with self._stats_lock:
+            self.rejections[reason] = self.rejections.get(reason, 0) + 1
+        if p.trace is not None:
+            p.trace.root.set("rejected", reason)
+        self.source.respond(p.id, HTTPSchema.response(
+            code, message,
+            json.dumps({"error": message}).encode("utf-8"),
+            {"Content-Type": "application/json", **(headers or {})}))
+
+    def _partition_parked(self, parked: List[_ParkedRequest]
+                          ) -> List[Tuple]:
+        """Admission-check + model-partition one drained batch:
+        returns ``[(handle, group, priority)]`` dispatch groups —
+        zoo handles pre-acquired, ``None`` handles meaning the default
+        pipeline. Batches never mix models by construction. Cold
+        models' requests park in ``_awaiting``; over-quota /
+        shed-tier / unroutable requests answer here and never
+        dispatch."""
+        from mmlspark_tpu.serving.admission import request_identity
+        from mmlspark_tpu.serving.zoo import model_key_of
+        buckets: Dict[Optional[str], List[_ParkedRequest]] = {}
+        prios: Dict[Optional[str], int] = {}
+        # one pressure sample per drained batch: the batcher is the
+        # only consumer of both queues, so it cannot meaningfully
+        # change within one partition pass — no per-request qsize()
+        pressure = self._pressure() if self.admission is not None else 0
+        for p in parked:
+            # route FIRST, admit second: an unroutable request (no
+            # model named on a zoo-only engine, or a typo'd name) must
+            # answer its 400/404 WITHOUT spending the tenant's quota
+            # tokens — a burst of mistyped requests could otherwise
+            # 429 the tenant's well-formed traffic
+            key = model_key_of(p.request)
+            if key is None and not self._default_ok:
+                self._reject_parked(
+                    p, 400, "no_model",
+                    "no model specified: set X-Model or POST "
+                    "/models/<name@version>")
+                continue
+            if key is not None:
+                # resolving here also merges bare-name and
+                # name@latest requests into ONE dispatch group
+                resolved = self.zoo.resolve(key)
+                if resolved is None:
+                    self._reject_parked(
+                        p, 404, "unknown_model",
+                        f"unknown model {key!r}; registered: "
+                        f"{self.zoo.names_preview()}")
                     continue
-                if item is None:
-                    # every request in the batch was codec-rejected
-                    # (each already answered 400); nothing to dispatch
+                key = resolved
+            tenant, priority = request_identity(p.request)
+            if self.admission is not None:
+                verdict = self.admission.decide(tenant, priority,
+                                                pressure)
+                if verdict == "quota":
+                    self._reject_parked(
+                        p, 429, "quota",
+                        f"tenant {tenant!r} over quota",
+                        {"Retry-After": "1"})
                     continue
-                self._dispatch_q.put(item)   # unbounded: tokens bound it
-                handed_off = True
-            finally:
-                if not handed_off:
-                    # both the in-flight token AND the version handle
-                    # must come back on any non-dispatch exit
-                    if handle is not None:
-                        handle.release()
-                    self._inflight.release()
-            for p in parked:
-                # dequeue stamp, not dispatch time: queue_wait must not
-                # absorb the token wait or the decode stage (decode_ms
-                # measures that) — the breakdown stays additive
-                self.hists["queue_wait_ms"].observe(
-                    max(0.0, p.dequeued_at - p.enqueued_at) * 1e3)
-            self.hists["batch_rows"].observe(float(len(parked)))
+                if verdict == "priority":
+                    self._reject_parked(
+                        p, 503, "priority",
+                        f"shed: engine saturated (priority {priority})",
+                        {"Retry-After": "1"})
+                    continue
+            buckets.setdefault(key, []).append(p)
+            prios[key] = min(prios.get(key, 9), priority)
+        out: List[Tuple] = []
+        for key, group in buckets.items():
+            if key is None:
+                out.append((None, group, prios[key]))
+                continue
+            try:
+                handle, state, msg = self.zoo.acquire(key)
+            except Exception as e:  # noqa: BLE001 — e.g. the loader
+                # thread failing to spawn; this group answers alone,
+                # other groups (and the batcher) keep going
+                for p in group:
+                    self._reject_parked(
+                        p, 500, "routing_error",
+                        f"model routing error for {key!r}: {e}")
+                continue
+            if state == "resident":
+                out.append((handle, group, prios[key]))
+            elif state == "loading":
+                self._enqueue_awaiting(key, group)
+            elif state == "failed":
+                for p in group:
+                    self._reject_parked(
+                        p, 503, "load_failed",
+                        f"model {key!r} failed to load: {msg}",
+                        {"Retry-After": "5"})
+            else:   # unknown
+                for p in group:
+                    self._reject_parked(p, 404, "unknown_model", msg)
+        return out
+
+    def _enqueue_awaiting(self, key: str,
+                          group: List[_ParkedRequest]) -> None:
+        lst = self._awaiting.setdefault(key, [])
+        if not lst:
+            self._awaiting_since[key] = time.monotonic()
+            # register the parked demand with the zoo: an awaited
+            # model must survive from activation to our flush poll,
+            # or demand > capacity livelocks (load, evict before the
+            # flush, reload, starve — see ModelZoo.add_waiter)
+            self.zoo.add_waiter(key)
+        lst.extend(group)
+
+    def _drop_awaiting(self, key: str) -> None:
+        """Forget a parked key (flushed or rejected) and release its
+        zoo waiter hold so the model becomes evictable again."""
+        self._awaiting.pop(key, None)
+        self._awaiting_since.pop(key, None)
+        self.zoo.remove_waiter(key)
+
+    def _poll_awaiting(self) -> List[Tuple]:
+        """Flush requests parked on cold models: activated models come
+        back as dispatch groups (chunked to ``batch_size`` — every
+        chunk gets its own acquired handle), failed/overdue activations
+        answer 503."""
+        if not self._awaiting:
+            return []
+        from mmlspark_tpu.serving.admission import request_identity
+        out: List[Tuple] = []
+        now = time.monotonic()
+        for key in list(self._awaiting):
+            try:
+                handle, state, msg = self.zoo.acquire(key)
+            except Exception as e:  # noqa: BLE001 — transient zoo
+                # fault: the requests STAY parked (no handle leaked,
+                # nothing unanswered) and the activation timeout still
+                # bounds their wait
+                log.error("zoo acquire failed for %s (still parked):"
+                          " %s", key, e)
+                continue
+            group = self._awaiting[key]
+            if state == "loading":
+                if now - self._awaiting_since[key] \
+                        <= self.activation_timeout_s:
+                    continue            # keep waiting
+                for p in group:
+                    self._reject_parked(
+                        p, 503, "activation_timeout",
+                        f"model {key!r} still activating after "
+                        f"{self.activation_timeout_s:.0f}s",
+                        {"Retry-After": "1"})
+            elif state == "resident":
+                prio = min(request_identity(p.request)[1]
+                           for p in group)
+                chunks = [group[i:i + self.batch_size]
+                          for i in range(0, len(group), self.batch_size)]
+                out.append((handle, chunks[0], prio))
+                for i in range(1, len(chunks)):
+                    try:
+                        h2, st2, _ = self.zoo.acquire(key)
+                    except Exception:  # noqa: BLE001 — re-park
+                        st2 = None
+                    if st2 == "resident":
+                        out.append((h2, chunks[i], prio))
+                    else:   # can't happen while chunk 0 holds the
+                        #     handle outstanding; guard anyway —
+                        #     re-park this AND every later chunk
+                        self._awaiting[key] = [
+                            p for c in chunks[i:] for p in c]
+                        self._awaiting_since[key] = now
+                        break
+                else:
+                    self._drop_awaiting(key)
+                continue
+            else:   # failed / unknown (e.g. deregistered mid-wait)
+                for p in group:
+                    self._reject_parked(
+                        p, 503, "load_failed",
+                        f"model {key!r} failed to activate: {msg}",
+                        {"Retry-After": "5"})
+            self._drop_awaiting(key)
+        return out
 
     def _worker_loop(self):
         while not self._stop.is_set():
@@ -1267,6 +1589,19 @@ class ServingEngine:
         counter). Exported on /healthz."""
         active, out = self._lifecycle_snapshot()
         out.update({k: h.summary() for k, h in self.hists.items()})
+        with self._stats_lock:
+            if self.rejections:
+                out["rejections"] = dict(self.rejections)
+        if self.zoo is not None:
+            try:
+                out["zoo"] = self.zoo.stats()
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
+        if self.admission is not None:
+            try:
+                out["admission"] = self.admission.stats()
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         swap_ctl = self.__dict__.get("_swap_ctl")
         if swap_ctl is not None:
             try:
@@ -1343,6 +1678,27 @@ class ServingEngine:
                          stats["canary_failed"], {"outcome": "failed"})
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
+        with self._stats_lock:
+            rejections = dict(self.rejections)
+        for reason in sorted(rejections):
+            r.counter("serving_admission_rejected_total",
+                      "requests rejected by admission/model routing "
+                      "(quota, priority, no_model, unknown_model, "
+                      "load_failed, activation_timeout)",
+                      rejections[reason], {"reason": reason})
+        if self.admission is not None:
+            try:
+                r.counter("serving_admission_admitted_total",
+                          "requests admitted by the admission layer",
+                          self.admission.stats()["admitted"])
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
+        if self.zoo is not None:
+            from mmlspark_tpu.core.prometheus import zoo_families
+            try:
+                zoo_families(r, self.zoo)
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         pipeline_families(r, active.pipeline)
         process_families(r, tracer=self.tracer)
         return r.render()
@@ -1398,19 +1754,29 @@ class ServingEngine:
                 threads.append(self._batcher)
         for t in threads:
             t.join(timeout=5)
+        if self.zoo is not None:
+            # release this engine's parked-demand holds: a shared zoo
+            # must not carry dead engines' waiters (they would exempt
+            # models from eviction forever)
+            for key in list(self._awaiting):
+                self.zoo.remove_waiter(key)
+            self._awaiting.clear()
+            self._awaiting_since.clear()
         try:
             self.source.close()
         except Exception:  # noqa: BLE001 — already closed by kill()
             pass
 
 
-def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
+def serve_model(pipeline: Optional[Transformer] = None,
+                host: str = "127.0.0.1",
                 port: int = 8899, batch_size: int = 64,
                 reply_col: str = "reply",
                 workers: int = 1, max_wait_ms: float = 5.0,
                 pipeline_depth: int = 2,
                 version: str = "v0", tracer=None,
-                tracing: Optional[bool] = None) -> ServingEngine:
+                tracing: Optional[bool] = None,
+                zoo=None, admission=None) -> ServingEngine:
     """One-call serving: the ``.server()`` DSL analog
     (ref: ServingImplicits.scala:10-50). Batches flush on
     ``batch_size`` rows or ``max_wait_ms`` elapsed, whichever first;
@@ -1424,4 +1790,5 @@ def serve_model(pipeline: Transformer, host: str = "127.0.0.1",
                          max_wait_ms=max_wait_ms,
                          pipeline_depth=pipeline_depth,
                          version=version, tracer=tracer,
-                         tracing=tracing).start()
+                         tracing=tracing, zoo=zoo,
+                         admission=admission).start()

@@ -537,6 +537,58 @@ class TestMetricsExposition:
                      if n == "trace_traces_added_total")
         assert added > 0
 
+    def test_zoo_metrics_grammar_and_cardinality_cap_at_256(self):
+        """The multi-model plane's families pass the grammar validator,
+        and the per-model label space stays HARD-CAPPED with 256
+        registered models: at most ``label_cardinality_cap`` named
+        latency series (+ ``_other``), at most that many
+        ``serving_model_info{model=...}`` rows, while
+        ``serving_zoo_*`` state gauges still count all 256."""
+        from mmlspark_tpu.serving import ModelZoo, ServingEngine
+        from mmlspark_tpu.serving.server import HTTPSource
+        cap = 64
+        zoo = ModelZoo(max_resident=16, memory_probe=None,
+                       label_cardinality_cap=cap)
+        for i in range(256):
+            zoo.register_factory(
+                f"m{i:03d}", f"v{i % 8}",
+                (lambda i=i: _scoring_pipeline()))
+        # a few models actually resident + latency observed for ALL
+        # 256 names (the worst-case label pressure)
+        for i in range(4):
+            zoo.get(f"m{i:03d}")
+        for i in range(256):
+            zoo.observe_latency(f"m{i:03d}", 1.0 + i % 7)
+        source = HTTPSource(port=19690)
+        engine = ServingEngine(source, zoo=zoo, tracing=False).start()
+        try:
+            text = urllib.request.urlopen(
+                engine.source.address + "/metrics",
+                timeout=5).read().decode()
+        finally:
+            engine.stop()
+            zoo.close()
+        types, samples = validate_prom_text(text)
+        assert types["serving_model_latency_ms"] == "histogram"
+        lat_models = {l["model"] for n, l, _v in samples
+                      if n == "serving_model_latency_ms_bucket"}
+        assert "_other" in lat_models
+        assert len(lat_models) <= cap + 1, len(lat_models)
+        info_models = {l["model"] for n, l, _v in samples
+                       if n == "serving_model_info" and "model" in l}
+        assert 0 < len(info_models) <= cap
+        # resident rows always have labeled series (they're the ones
+        # an operator is debugging)
+        for i in range(4):
+            assert f"m{i:03d}" in info_models
+        # the full population is still countable — by state, uncapped
+        by_state = {l["state"]: v for n, l, v in samples
+                    if n == "serving_zoo_models"}
+        assert sum(by_state.values()) == 256
+        registered = next(v for n, _l, v in samples
+                          if n == "serving_zoo_registered_models")
+        assert registered == 256
+
     def test_fleet_metrics_text_grammar(self):
         from mmlspark_tpu.serving.fleet import ServingFleet
         tracer = Tracer(enabled=True)
