@@ -821,15 +821,24 @@ OBS_REPS = 2
 
 
 def bench_observability() -> dict:
-    """Tracing overhead on the serving hot path: the serving-scenario
-    load runs with request tracing OFF and ON (interleaved reps,
-    best-of per mode so shared-host noise hits both sides), reporting
-    qps for each, the overhead percentage, the tail-sampling buffer
-    stats, one exported trace's span coverage of its request wall, and
-    the /metrics exposition size. The ≤3% overhead contract is pinned
-    by tests/test_perf_floors.py::TestTracingOverheadFloor."""
+    """Telemetry overhead on the serving hot path, three interleaved
+    modes (best-of per mode so shared-host noise hits every side):
+
+    - ``off``   — tracing, SLO engine, and flight recorder all off
+      (the bare PR 2 hot path);
+    - ``tracing`` — request tracing only (the PR 7 contract);
+    - ``telemetry`` — the FULL default-on plane: tracing + windowed
+      SLO recording/burn-rate evaluation + the always-on flight
+      recorder (the PR 13 contract: ≤3% vs off, pinned by
+      tests/test_perf_floors.py::TestTelemetryOverheadFloor alongside
+      the tracing floor).
+
+    Reports qps per mode, both overhead percentages, buffer/SLO/
+    recorder state from the telemetry run, one exported trace's span
+    coverage, and the /metrics exposition size."""
     import concurrent.futures
 
+    from mmlspark_tpu.core.flightrecorder import FlightRecorder
     from mmlspark_tpu.core.trace import Tracer, to_chrome_trace
     from mmlspark_tpu.models.networks import build_network
     from mmlspark_tpu.models.tpu_model import TPUModel
@@ -852,13 +861,18 @@ def bench_observability() -> dict:
         {"features": rng.normal(size=SERVING_FEATURE_DIM).tolist()}
     ).encode()
 
-    def run_once(tracing: bool, base_port: int):
+    def run_once(mode: str, base_port: int):
+        tracing = mode in ("tracing", "telemetry")
+        telemetry = mode == "telemetry"
         tracer = Tracer(enabled=True) if tracing else None
+        recorder = FlightRecorder() if telemetry else False
         fleet = ServingFleet(json_scoring_pipeline(model), n_engines=2,
                              base_port=base_port, batch_size=256,
                              workers=2,
                              max_wait_ms=SERVING_MAX_WAIT_MS,
-                             tracer=tracer, tracing=tracing)
+                             tracer=tracer, tracing=tracing,
+                             slo=None if telemetry else False,
+                             flight_recorder=recorder)
         try:
             def post(_i):
                 body = fleet.post(payload, timeout=60)
@@ -893,29 +907,52 @@ def bench_observability() -> dict:
                     }
                 extras["metrics_exposition_lines"] = len(
                     fleet.metrics_text().splitlines())
+            if telemetry:
+                slo = fleet.engines[0].slo
+                status = slo.status()
+                extras["slo"] = {
+                    "degraded": status["degraded"],
+                    "error_rate_1m": status.get("error_rate_1m"),
+                    "p99_ms_1m": status.get("p99_ms_1m"),
+                    "requests_1m": status.get("requests_1m"),
+                }
+                extras["flight_recorder"] = recorder.stats()
+                bundle = recorder.dump_bundle("bench")
+                extras["bundle_trace_events"] = len(
+                    bundle["traces"].get("traceEvents", []))
         finally:
             fleet.stop_all()
+            if telemetry:
+                recorder.close()
         return OBS_REQUESTS / wall, extras
 
-    qps_off = qps_on = 0.0
-    extras_on = {}
+    qps = {"off": 0.0, "tracing": 0.0, "telemetry": 0.0}
+    extras_best: dict = {}
     port = 19000
-    for _ in range(OBS_REPS):     # interleaved: noise hits both modes
-        q, _x = run_once(False, port)
-        qps_off = max(qps_off, q)
-        port += 40
-        q, extras = run_once(True, port)
-        if q > qps_on:
-            qps_on, extras_on = q, extras
-        port += 40
-    overhead = (qps_off - qps_on) / qps_off * 100 if qps_off else None
+    for _ in range(OBS_REPS):     # interleaved: noise hits every mode
+        for mode in ("off", "tracing", "telemetry"):
+            q, extras = run_once(mode, port)
+            port += 40
+            if q > qps[mode]:
+                qps[mode] = q
+                if mode == "telemetry":
+                    extras_best = extras
+
+    def pct(off, on):
+        return round((off - on) / off * 100, 2) if off else None
+
     return {
-        "metric": "serving_tracing_overhead",
-        "value": round(overhead, 2) if overhead is not None else None,
-        "unit": "% qps lost with tracing on (best-of interleaved reps)",
-        "qps_tracing_off": round(qps_off, 1),
-        "qps_tracing_on": round(qps_on, 1),
-        **extras_on,
+        "metric": "serving_telemetry_overhead",
+        "value": pct(qps["off"], qps["telemetry"]),
+        "unit": "% qps lost with FULL telemetry on (tracing + "
+                "windowed SLO + flight recorder; best-of interleaved "
+                "reps)",
+        "qps_tracing_off": round(qps["off"], 1),
+        "qps_tracing_on": round(qps["tracing"], 1),
+        "qps_telemetry_on": round(qps["telemetry"], 1),
+        "tracing_overhead_pct": pct(qps["off"], qps["tracing"]),
+        "telemetry_overhead_pct": pct(qps["off"], qps["telemetry"]),
+        **extras_best,
         "config": (f"{OBS_REQUESTS} reqs x {OBS_REPS} reps per mode, "
                    f"{SERVING_CLIENTS} clients, 2 engines x 2 workers, "
                    f"MLP-{SERVING_FEATURE_DIM}, batch 256"),

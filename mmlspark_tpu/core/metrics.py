@@ -6,7 +6,8 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, Sequence
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 # regression
 MSE = "mse"
@@ -53,6 +54,28 @@ def is_regression_metric(name: str) -> bool:
 _DEFAULT_BOUNDS = (0.05, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0,
                    100.0, 200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0,
                    math.inf)
+
+
+def percentile_from_counts(bounds: Sequence[float],
+                           counts: Sequence[int], count: int,
+                           mx: float, q: float) -> float:
+    """q-th percentile from one consistent (bounds, counts) snapshot:
+    linear interpolation inside the containing bucket, never reporting
+    above the observed max. Shared by ``LatencyHistogram`` and the
+    windowed variants (``WindowedHistogram``)."""
+    if count == 0:
+        return 0.0
+    rank = q / 100.0 * count
+    seen = 0
+    for i, c in enumerate(counts):
+        if seen + c >= rank and c > 0:
+            lo = 0.0 if i == 0 else bounds[i - 1]
+            hi = mx if math.isinf(bounds[i]) else bounds[i]
+            frac = (rank - seen) / c
+            est = lo + (max(hi, lo) - lo) * min(max(frac, 0.0), 1.0)
+            return min(est, mx)   # never report above the true max
+        seen += c
+    return mx
 
 
 class LatencyHistogram:
@@ -115,20 +138,7 @@ class LatencyHistogram:
                   q: float) -> float:
         """q-th percentile from ONE consistent counts snapshot: linear
         interpolation inside the containing bucket."""
-        if count == 0:
-            return 0.0
-        rank = q / 100.0 * count
-        seen = 0
-        for i, c in enumerate(counts):
-            if seen + c >= rank and c > 0:
-                lo = 0.0 if i == 0 else self.bounds[i - 1]
-                hi = mx if math.isinf(self.bounds[i]) \
-                    else self.bounds[i]
-                frac = (rank - seen) / c
-                est = lo + (max(hi, lo) - lo) * min(max(frac, 0.0), 1.0)
-                return min(est, mx)   # never report above the true max
-            seen += c
-        return mx
+        return percentile_from_counts(self.bounds, counts, count, mx, q)
 
     def percentile(self, q: float) -> float:
         """Approximate q-th percentile (q in [0, 100])."""
@@ -224,6 +234,192 @@ class LabelledHistograms:
         buckets), at most ``cap`` named series plus ``_other``."""
         with self._lock:
             return dict(self._hists)
+
+
+# ---------------------------------------------------------------------------
+# windowed (sliding-window) primitives — the SLO engine's measurement
+# substrate (core/slo.py)
+# ---------------------------------------------------------------------------
+
+# Cumulative counters answer "since process start"; an SLO burn-rate
+# evaluator needs "over the last 1m/5m/1h". Both classes ring-buffer
+# TIME buckets: each slot covers ``bucket_s`` seconds of wall clock and
+# carries the epoch (bucket index since clock zero) it was last written
+# for, so rotation is lazy — a slot is zeroed exactly once, by the
+# first writer (or reader) that touches it in a new epoch, under the
+# same lock every mutation takes. The hot path is the LabelledHistograms
+# discipline: one short critical section, no allocation, O(1) per
+# observe; window reads sum only ceil(window/bucket_s) slots.
+
+
+class WindowedCounter:
+    """A counter readable over sliding time windows.
+
+    ``inc`` lands in the current time bucket; ``total(window_s)`` sums
+    the buckets covering the trailing window (partial current bucket
+    included — the standard streaming approximation: the window edge is
+    quantized to ``bucket_s``). ``cumulative`` stays monotone for
+    Prometheus counters. Thread-safe; buckets expire exactly once
+    (epoch-tagged slots, rotation under the lock)."""
+
+    __slots__ = ("bucket_s", "n_slots", "cumulative", "_counts",
+                 "_epochs", "_lock", "_clock")
+
+    def __init__(self, bucket_s: float = 1.0, horizon_s: float = 3660.0,
+                 clock=time.monotonic):
+        self.bucket_s = float(bucket_s)
+        if self.bucket_s <= 0:
+            raise ValueError("bucket_s must be positive")
+        self.n_slots = max(2, int(math.ceil(horizon_s / self.bucket_s)) + 1)
+        self.cumulative = 0.0
+        self._counts = [0.0] * self.n_slots
+        self._epochs = [-1] * self.n_slots
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def _epoch(self, now: Optional[float]) -> int:
+        return int((self._clock() if now is None else now)
+                   // self.bucket_s)
+
+    def inc(self, n: float = 1.0, now: Optional[float] = None) -> None:
+        epoch = self._epoch(now)
+        slot = epoch % self.n_slots
+        with self._lock:
+            if self._epochs[slot] != epoch:
+                # lazy rotation: this slot last held a bucket a full
+                # horizon ago — zero it exactly once for the new epoch
+                self._counts[slot] = 0.0
+                self._epochs[slot] = epoch
+            self._counts[slot] += n
+            self.cumulative += n
+
+    def total(self, window_s: float, now: Optional[float] = None) -> float:
+        """Sum over the trailing ``window_s`` (quantized to buckets)."""
+        epoch = self._epoch(now)
+        k = min(self.n_slots,
+                max(1, int(math.ceil(window_s / self.bucket_s))))
+        lo = epoch - k + 1
+        with self._lock:
+            return sum(self._counts[e % self.n_slots]
+                       for e in range(lo, epoch + 1)
+                       if self._epochs[e % self.n_slots] == e)
+
+    def rate(self, window_s: float, now: Optional[float] = None) -> float:
+        """Per-second rate over the trailing window."""
+        return self.total(window_s, now) / max(window_s, 1e-9)
+
+    def series(self, window_s: float, now: Optional[float] = None
+               ) -> List[Tuple[float, float]]:
+        """Per-bucket ``(bucket_start_s, value)`` pairs over the
+        trailing window, oldest first (the flight recorder's
+        machine-readable time series; empty buckets report 0)."""
+        epoch = self._epoch(now)
+        k = min(self.n_slots,
+                max(1, int(math.ceil(window_s / self.bucket_s))))
+        lo = epoch - k + 1
+        with self._lock:
+            return [(e * self.bucket_s,
+                     self._counts[e % self.n_slots]
+                     if self._epochs[e % self.n_slots] == e else 0.0)
+                    for e in range(lo, epoch + 1)]
+
+
+class WindowedHistogram:
+    """A latency histogram readable over sliding time windows.
+
+    Ring of time buckets, each holding a compact per-bound counts array
+    (same log-spaced layout as ``LatencyHistogram``); ``snapshot`` and
+    ``percentile`` merge the buckets covering the trailing window into
+    one consistent view, shaped exactly like
+    ``LatencyHistogram.snapshot()`` so the Prometheus renderer and the
+    percentile math are shared. Thread-safe; slots rotate lazily under
+    the lock (expire exactly once)."""
+
+    __slots__ = ("unit", "bounds", "bucket_s", "n_slots", "_counts",
+                 "_sums", "_maxes", "_ns", "_epochs", "_lock", "_clock")
+
+    def __init__(self, bucket_s: float = 5.0, horizon_s: float = 3660.0,
+                 unit: str = "ms",
+                 bounds: Sequence[float] = _DEFAULT_BOUNDS,
+                 clock=time.monotonic):
+        self.unit = unit
+        self.bounds = tuple(bounds)
+        if self.bounds[-1] != math.inf:
+            self.bounds = self.bounds + (math.inf,)
+        self.bucket_s = float(bucket_s)
+        if self.bucket_s <= 0:
+            raise ValueError("bucket_s must be positive")
+        self.n_slots = max(2, int(math.ceil(horizon_s / self.bucket_s)) + 1)
+        nb = len(self.bounds)
+        self._counts = [[0] * nb for _ in range(self.n_slots)]
+        self._sums = [0.0] * self.n_slots
+        self._maxes = [0.0] * self.n_slots
+        self._ns = [0] * self.n_slots
+        self._epochs = [-1] * self.n_slots
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def _epoch(self, now: Optional[float]) -> int:
+        return int((self._clock() if now is None else now)
+                   // self.bucket_s)
+
+    def observe(self, value: float, now: Optional[float] = None) -> None:
+        v = float(value)
+        i = 0
+        while self.bounds[i] < v:
+            i += 1
+        epoch = self._epoch(now)
+        slot = epoch % self.n_slots
+        with self._lock:
+            if self._epochs[slot] != epoch:
+                counts = self._counts[slot]
+                for j in range(len(counts)):
+                    counts[j] = 0
+                self._sums[slot] = 0.0
+                self._maxes[slot] = 0.0
+                self._ns[slot] = 0
+                self._epochs[slot] = epoch
+            self._counts[slot][i] += 1
+            self._sums[slot] += v
+            self._ns[slot] += 1
+            if v > self._maxes[slot]:
+                self._maxes[slot] = v
+
+    def snapshot(self, window_s: float = 300.0,
+                 now: Optional[float] = None) -> Dict[str, object]:
+        """One merged view of the trailing window, shaped like
+        ``LatencyHistogram.snapshot()`` (bounds/counts/count/sum/max)
+        so exporters treat windowed and cumulative histograms alike."""
+        epoch = self._epoch(now)
+        k = min(self.n_slots,
+                max(1, int(math.ceil(window_s / self.bucket_s))))
+        lo = epoch - k + 1
+        merged = [0] * len(self.bounds)
+        count, total, mx = 0, 0.0, 0.0
+        with self._lock:
+            for e in range(lo, epoch + 1):
+                slot = e % self.n_slots
+                if self._epochs[slot] != e:
+                    continue
+                counts = self._counts[slot]
+                for j, c in enumerate(counts):
+                    merged[j] += c
+                count += self._ns[slot]
+                total += self._sums[slot]
+                if self._maxes[slot] > mx:
+                    mx = self._maxes[slot]
+        return {"unit": self.unit, "bounds": list(self.bounds),
+                "counts": merged, "count": count, "sum": total,
+                "max": mx}
+
+    def percentile(self, q: float, window_s: float = 300.0,
+                   now: Optional[float] = None) -> float:
+        snap = self.snapshot(window_s, now)
+        return percentile_from_counts(
+            self.bounds, snap["counts"], snap["count"], snap["max"], q)
+
+    def count(self, window_s: float, now: Optional[float] = None) -> int:
+        return int(self.snapshot(window_s, now)["count"])
 
 
 # ---------------------------------------------------------------------------

@@ -257,6 +257,75 @@ def zoo_families(r: PromRenderer, zoo: Any,
                     hist, {**base, "model": label})
 
 
+def slo_families(r: PromRenderer, monitor: Any,
+                 labels: Optional[Dict[str, Any]] = None) -> None:
+    """The windowed SLO engine's families (core/slo.py): per-objective
+    burn rates over the monitor's windows, windowed error rate and p99,
+    active-alert gauges, and the alert totals. Per-model series render
+    only the short-window burn rate, and only for the monitor's
+    HARD-CAPPED label set (``label_cap`` + ``_other``), so a busy zoo
+    scrapes like a small one — the serving_model_latency_ms
+    discipline."""
+    base = dict(labels or {})
+    # the three scalars are free (no windowed aggregation): going
+    # through monitor.status() here would compute every burn/error/p99
+    # window just to throw it away — and the per-window gauges below
+    # recompute exactly what each sample needs, once
+    alert_stats = monitor.alerts.stats()
+    r.gauge("serving_slo_degraded",
+            "1 while any burn-rate alert is active", monitor.degraded,
+            base)
+    r.counter("serving_slo_alerts_fired_total",
+              "burn-rate alerts ever fired", alert_stats["fired_total"],
+              base)
+    r.counter("serving_slo_alerts_resolved_total",
+              "burn-rate alerts ever resolved",
+              alert_stats["resolved_total"], base)
+    for slo in monitor.slos:
+        slo_labels = {**base, "slo": slo.name}
+        r.gauge("serving_slo_target",
+                "declared objective (good-event fraction)", slo.target,
+                {**slo_labels, "kind": slo.kind})
+        for w in monitor.windows:
+            wl = _slo_window_label(w)
+            r.gauge("serving_slo_burn_rate",
+                    "error-budget burn rate over the trailing window "
+                    "(1.0 = sustainable pace)",
+                    monitor.burn_rate(slo, w),
+                    {**slo_labels, "window": wl})
+    for w in monitor.windows:
+        wl = _slo_window_label(w)
+        r.gauge("serving_slo_error_rate",
+                "5xx fraction over the trailing window",
+                monitor.error_rate(w), {**base, "window": wl})
+        r.gauge("serving_slo_latency_p99_ms",
+                "p99 reply latency over the trailing window",
+                monitor.latency_p99(w), {**base, "window": wl})
+        r.gauge("serving_slo_requests_window",
+                "requests observed in the trailing window",
+                monitor.requests(w), {**base, "window": wl})
+    for alert in monitor.alerts.active():
+        r.gauge("serving_slo_alert_active",
+                "active burn-rate alert (labels carry identity)", 1,
+                {**base, "slo": alert.slo, "rule": alert.rule,
+                 **({"model": alert.model} if alert.model else {})})
+    # per-model: ONE gauge family over the capped label set
+    short_w = min((rule.short_window_s for rule in monitor.rules),
+                  default=300.0)
+    for model in monitor.model_labels():
+        for slo in monitor.slos:
+            r.gauge("serving_slo_model_burn_rate",
+                    "short-window burn rate per model (cardinality-"
+                    'capped: overflow folds into model="_other")',
+                    monitor.burn_rate(slo, short_w, model=model),
+                    {**base, "slo": slo.name, "model": model})
+
+
+def _slo_window_label(window_s: float) -> str:
+    from mmlspark_tpu.core.slo import _window_label
+    return _window_label(window_s)
+
+
 def drift_families(r: PromRenderer, monitor: Any,
                    labels: Optional[Dict[str, Any]] = None) -> None:
     """``DriftMonitor`` summary as gauges (served-traffic feature drift

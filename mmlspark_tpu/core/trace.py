@@ -34,6 +34,18 @@ stores, ids come from a process prefix + an atomic counter (no
 per-request ``os.urandom``), and the tail-sampling threshold is
 recomputed only every few dozen adds.
 
+Cross-process propagation: ``Tracer.inject(span)`` emits a
+``traceparent``-style header (``00-<trace_id>-<span_id>-<flags>`` — the
+W3C Trace Context shape over our ids) plus the legacy ``X-Trace-Id``
+alias, and ``Tracer.extract(headers)`` parses either back into a
+``TraceContext``. A serving ingress that extracts a context CONTINUES
+the caller's trace — its root span is a *child* of the remote client
+span — instead of minting a fresh root, so one ``fleet.post`` that
+fans out across retries/hedges onto engines in other OS processes is
+still ONE trace: reassemble the per-process exports with
+``merge_chrome_traces`` and Perfetto renders the whole fan-out on one
+timeline, grouped by the ``process_name`` metadata each export carries.
+
 Logging correlation: ``use_span``/``current_span`` hold the active span
 in a ``contextvars`` context so the JSON log formatter
 (``core.logging_utils``) can stamp ``trace_id`` on every record emitted
@@ -62,6 +74,118 @@ _T0_WALL = time.time()
 
 def _now() -> float:
     return time.perf_counter()
+
+
+# ---------------------------------------------------------------------------
+# cross-process context propagation
+# ---------------------------------------------------------------------------
+
+# HTTP statuses that are EXPECTED back-pressure, not failures: load
+# shedding (503) and tenant quotas (429). Traces for these mark
+# shed=true instead of error so an overload can never flood the
+# protected tail ring — the ONE definition both the serving ingress
+# and the fleet client's root/leg verdicts classify against.
+SHED_STATUSES = frozenset({429, 503})
+
+# the propagation header (traceparent-style: version-traceid-spanid-flags)
+TRACEPARENT_HEADER = "traceparent"
+# legacy alias honored since PR 7: carries the trace id only (no parent
+# span), so old clients keep stitching by id while new ones parent
+LEGACY_TRACE_HEADER = "X-Trace-Id"
+
+
+class TraceContext:
+    """An extracted remote trace context: the id to continue, the
+    remote parent span to hang the local root under, and the sampled
+    flag the caller advertised."""
+
+    __slots__ = ("trace_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id: str, parent_id: Optional[str] = None,
+                 sampled: bool = True):
+        self.trace_id = str(trace_id)[:64]
+        self.parent_id = (str(parent_id)[:64] if parent_id else None)
+        self.sampled = bool(sampled)
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id}, parent={self.parent_id},"
+                f" sampled={self.sampled})")
+
+
+def format_traceparent(trace_id: str, span_id: str,
+                       sampled: bool = True) -> str:
+    """``00-<trace_id>-<span_id>-<flags>``. Our span ids are hex (no
+    dashes); trace ids may carry dashes when a legacy client supplied
+    one — the parser tolerates that (span id and flags are the LAST two
+    fields)."""
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def parse_traceparent(value: Any) -> Optional[TraceContext]:
+    """Parse a traceparent-style header; None on anything malformed
+    (the caller then falls back to the legacy header / a fresh root).
+    Tolerant of dashes inside the trace-id field: the span id (ours:
+    hex, dash-free) and flags are anchored from the right."""
+    if not value:
+        return None
+    parts = str(value).strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, flags = parts[0], parts[-1]
+    span_id = parts[-2]
+    trace_id = "-".join(parts[1:-2])
+    if len(version) != 2 or not _is_hex(version):
+        return None
+    if not trace_id or len(trace_id) > 64 or set(trace_id) == {"0"}:
+        return None
+    if not span_id or len(span_id) > 64 or not _is_hex(span_id):
+        return None
+    if len(flags) != 2 or not _is_hex(flags):
+        return None
+    sampled = bool(int(flags, 16) & 0x01)
+    return TraceContext(trace_id, span_id, sampled)
+
+
+def _is_hex(s: str) -> bool:
+    try:
+        int(s, 16)
+    except ValueError:
+        return False
+    return True
+
+
+def header_get(headers: Any, name: str) -> Optional[str]:
+    """Case-insensitive header lookup over a dict OR an
+    ``email.message``-style object (http.server's ``self.headers``)."""
+    if headers is None:
+        return None
+    get = getattr(headers, "get", None)
+    if get is not None:
+        val = get(name)
+        if val is not None:
+            return val
+    try:
+        items = headers.items()
+    except Exception:  # noqa: BLE001 — not a mapping
+        return None
+    low = name.lower()
+    for k, v in items:
+        if str(k).lower() == low:
+            return v
+    return None
+
+
+def extract_context(headers: Any) -> Optional[TraceContext]:
+    """The ingress side of propagation: ``traceparent`` wins; the
+    legacy ``X-Trace-Id`` supplies an id-only context (same trace,
+    fresh local root — PR 7 behavior, kept as the alias)."""
+    ctx = parse_traceparent(header_get(headers, TRACEPARENT_HEADER))
+    if ctx is not None:
+        return ctx
+    legacy = header_get(headers, LEGACY_TRACE_HEADER)
+    if legacy:
+        return TraceContext(legacy)
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -302,11 +426,18 @@ class TraceBuffer:
 # ---------------------------------------------------------------------------
 
 
-def to_chrome_trace(traces: Sequence[Trace]) -> Dict[str, Any]:
+def to_chrome_trace(traces: Sequence[Trace],
+                    process_name: Optional[str] = None) -> Dict[str, Any]:
     """Chrome trace-event JSON (the perfetto/chrome://tracing format):
     one complete ("X") event per span. Batch-join spans shared by N
     traces export ONCE (deduped by span_id) — their ``links`` arg names
-    every request span they serve."""
+    every request span they serve.
+
+    ``process_name`` emits a ``process_name`` metadata ("M") event so
+    Perfetto labels this process's track (e.g.
+    ``engine http://127.0.0.1:18701 pid=4242``) — essential once
+    exports from several engine processes are merged into one timeline
+    (``merge_chrome_traces``)."""
     events: List[Dict[str, Any]] = []
     seen: set = set()
     for tr in traces:
@@ -315,13 +446,69 @@ def to_chrome_trace(traces: Sequence[Trace]) -> Dict[str, Any]:
                 continue
             seen.add(span.span_id)
             events.append(span.to_event())
+    if process_name is not None and events:
+        # label this process's track — but only when there is a track:
+        # an empty export (tracing off) stays empty
+        events.insert(0, {
+            "name": "process_name", "ph": "M", "pid": os.getpid(),
+            "tid": 0, "args": {"name": str(process_name)},
+        })
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "clock": "perf_counter, us since process trace epoch",
             "epoch_unix_s": round(_T0_WALL, 3),
+            "pid": os.getpid(),
             "traces": len(traces),
+        },
+    }
+
+
+def merge_chrome_traces(*payloads: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge several processes' Chrome exports into ONE payload: the
+    cross-process reassembly step. Span ("X") events dedup by
+    (pid, span_id) — the fleet client and an engine may both have
+    buffered a shared trace — and ``process_name`` metadata dedups per
+    pid, so Perfetto shows one labeled track group per process.
+
+    Timestamps stay process-relative (each process's trace epoch is its
+    own perf_counter zero); every export carries ``epoch_unix_s`` in
+    ``otherData.epochs`` so tooling can re-anchor exactly. For the
+    human reading a fan-out this is fine: parenting/links carry the
+    causality, and legs within one process are exact."""
+    events: List[Dict[str, Any]] = []
+    seen_spans: set = set()
+    seen_meta: set = set()
+    epochs: Dict[str, Any] = {}
+    for payload in payloads:
+        if not payload:
+            continue
+        other = payload.get("otherData") or {}
+        pid = other.get("pid")
+        if pid is not None and "epoch_unix_s" in other:
+            epochs[str(pid)] = other["epoch_unix_s"]
+        for ev in payload.get("traceEvents", ()):
+            if ev.get("ph") == "M":
+                key = (ev.get("pid"), ev.get("name"),
+                       str(ev.get("args")))
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+            else:
+                args = ev.get("args") or {}
+                key = (ev.get("pid"), args.get("span_id"))
+                if key[1] is not None and key in seen_spans:
+                    continue
+                seen_spans.add(key)
+            events.append(ev)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "clock": "perf_counter, us since each process's trace epoch",
+            "epochs": epochs,
+            "merged_from": len(payloads),
         },
     }
 
@@ -372,15 +559,54 @@ class Tracer:
 
     def new_trace(self, name: str,
                   trace_id: Optional[str] = None,
-                  start: Optional[float] = None) -> Trace:
+                  start: Optional[float] = None,
+                  parent_id: Optional[str] = None) -> Trace:
         """A fresh trace with a started root span. ``trace_id`` honors
-        an incoming propagation header (clamped to something sane)."""
+        an incoming propagation header (clamped to something sane);
+        ``parent_id`` makes the root a CHILD of a remote span — the
+        cross-process continuation: a serving ingress that extracted a
+        ``TraceContext`` passes both, so its whole span tree hangs
+        under the caller's client span instead of starting a second
+        root in the same trace."""
         if trace_id:
             trace_id = str(trace_id)[:64]
         else:
             trace_id = self._next_id()
-        root = Span(name, trace_id, self._next_id(), start=start)
+        root = Span(name, trace_id, self._next_id(),
+                    parent_id=(str(parent_id)[:64] if parent_id
+                               else None),
+                    start=start)
         return Trace(trace_id, root)
+
+    def continue_trace(self, name: str, ctx: Optional[TraceContext],
+                       start: Optional[float] = None) -> Trace:
+        """``new_trace`` from an extracted remote context (None context
+        = fresh root — the no-propagation fallback in one call)."""
+        if ctx is None:
+            return self.new_trace(name, start=start)
+        return self.new_trace(name, trace_id=ctx.trace_id, start=start,
+                              parent_id=ctx.parent_id)
+
+    # -- cross-process propagation ------------------------------------------
+
+    def inject(self, span: Optional[Span]) -> Dict[str, str]:
+        """The headers one outbound leg must carry so the remote
+        process continues THIS span's trace as a child: the
+        traceparent-style header plus the legacy ``X-Trace-Id`` alias
+        (old engines stitch by id; new ones parent properly)."""
+        if span is None:
+            return {}
+        return {
+            TRACEPARENT_HEADER: format_traceparent(
+                span.trace_id, span.span_id, sampled=self.enabled),
+            LEGACY_TRACE_HEADER: span.trace_id,
+        }
+
+    @staticmethod
+    def extract(headers: Any) -> Optional[TraceContext]:
+        """Parse an incoming propagation context (``extract_context``
+        as a method, for symmetry with ``inject``)."""
+        return extract_context(headers)
 
     def start_span(self, name: str, trace: Trace,
                    parent: Optional[Span] = None,

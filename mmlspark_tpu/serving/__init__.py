@@ -25,12 +25,20 @@ from mmlspark_tpu.serving.server import (
 from mmlspark_tpu.serving.zoo import (
     ModelZoo, ZooEvent, model_key_of,
 )
+from mmlspark_tpu.core.flightrecorder import (
+    FlightRecorder, get_recorder,
+)
+from mmlspark_tpu.core.slo import (
+    Alert, AlertEvent, AlertLog, BurnRateRule, SLO, SLOMonitor,
+)
 
-__all__ = ["AdmissionController", "CanaryPolicy", "HTTPSource",
+__all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
+           "BurnRateRule", "CanaryPolicy", "FlightRecorder",
+           "HTTPSource",
            "ModelRegistry", "ModelZoo", "PartitionConsolidator",
-           "PipelineHandle", "ServingEngine",
+           "PipelineHandle", "SLO", "SLOMonitor", "ServingEngine",
            "ServingFleet", "ServingUnavailable", "SharedSingleton",
            "SharedVariable", "SwapEvent", "SwapInProgress", "SwapResult",
-           "TenantQuota", "ZooEvent", "export_model",
+           "TenantQuota", "ZooEvent", "export_model", "get_recorder",
            "json_row_scoring_pipeline", "json_scoring_pipeline",
            "load_model", "model_key_of", "read_manifest", "serve_model"]

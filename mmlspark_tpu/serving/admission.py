@@ -50,14 +50,14 @@ DEFAULT_PRESSURE_LIMITS: Dict[int, Optional[int]] = {
 def header_get(request: Optional[Dict[str, Any]], name: str
                ) -> Optional[str]:
     """Case-insensitive header lookup on a request struct — the ONE
-    header-scan implementation (model routing and tenant identity both
-    use it, so header handling cannot diverge between carriers)."""
-    headers = (request or {}).get("headers") or {}
-    lname = name.lower()
-    for k, v in headers.items():
-        if str(k).lower() == lname:
-            return str(v)
-    return None
+    header-scan implementation (model routing, tenant identity, and
+    trace propagation all route through ``core.trace.header_get``, so
+    header handling cannot diverge between carriers). This wrapper
+    adds the request-struct unwrap and the str() coercion admission
+    callers rely on."""
+    from mmlspark_tpu.core.trace import header_get as _scan
+    value = _scan((request or {}).get("headers") or {}, name)
+    return None if value is None else str(value)
 
 
 def request_identity(request: Optional[Dict[str, Any]]

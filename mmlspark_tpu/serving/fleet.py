@@ -744,13 +744,49 @@ class ServingFleet:
                  pipeline_depth: int = 2,
                  version: str = "v0", tracer=None,
                  tracing: Optional[bool] = None,
-                 zoo=None, admission=None):
-        from mmlspark_tpu.core import trace as trace_mod
+                 zoo=None, admission=None,
+                 slo=None, flight_recorder=None):
         # the multi-model plane: ONE zoo (and one admission controller)
         # shared by every engine — models are process-resident, so the
         # device-memory budget and tenant quotas are fleet-wide
         self.zoo = zoo
         self.admission = admission
+        self._init_client(tracer=tracer, tracing=tracing,
+                          hedge_percentile=hedge_percentile,
+                          hedge_min_s=hedge_min_s)
+        port = base_port
+        try:
+            for _ in range(n_engines):
+                source = HTTPSource(host=host, port=port,
+                                    max_parked=max_parked)
+                port = source.port + 1      # skip whatever port-scan used
+                try:
+                    engine = ServingEngine(
+                        source, pipeline, reply_col=reply_col,
+                        batch_size=batch_size, workers=workers,
+                        max_wait_ms=max_wait_ms,
+                        pipeline_depth=pipeline_depth,
+                        version=version, tracer=self.tracer,
+                        tracing=self.tracer is not None,
+                        zoo=zoo, admission=admission,
+                        slo=slo, flight_recorder=flight_recorder).start()
+                except Exception:
+                    source.close()   # don't orphan the bound port
+                    raise
+                self.engines.append(engine)
+        except Exception:
+            # partial construction must not leak threads/bound ports
+            self.stop_all()
+            raise
+        self._build_breakers(failure_threshold, breaker_cooldown)
+        log.info("fleet of %d engines: %s", n_engines, self.addresses)
+
+    def _init_client(self, tracer=None, tracing: Optional[bool] = None,
+                     hedge_percentile: Optional[float] = None,
+                     hedge_min_s: float = 0.02) -> None:
+        """Client-side state shared by the in-process fleet and the
+        remote-address client (``connect``)."""
+        from mmlspark_tpu.core import trace as trace_mod
         # ONE tracer across the fleet: every engine's completed traces
         # land in the same tail-sampled buffer, so fleet.traces() is
         # the whole fleet's story (default: the process-wide tracer)
@@ -762,6 +798,7 @@ class ServingFleet:
         if self.tracer is not None and not self.tracer.enabled:
             self.tracer = None
         self.engines: List[ServingEngine] = []
+        self._remote_addresses: Optional[List[str]] = None
         self.transport_errors = 0
         self.hedged_requests = 0
         self._stats_lock = threading.Lock()
@@ -782,41 +819,72 @@ class ServingFleet:
         self._columnar_ok = True
         self.columnar_retry_cooldown_s = 60.0
         self._columnar_retry_at = 0.0
-        port = base_port
-        try:
-            for _ in range(n_engines):
-                source = HTTPSource(host=host, port=port,
-                                    max_parked=max_parked)
-                port = source.port + 1      # skip whatever port-scan used
-                try:
-                    engine = ServingEngine(
-                        source, pipeline, reply_col=reply_col,
-                        batch_size=batch_size, workers=workers,
-                        max_wait_ms=max_wait_ms,
-                        pipeline_depth=pipeline_depth,
-                        version=version, tracer=self.tracer,
-                        tracing=self.tracer is not None,
-                        zoo=zoo, admission=admission).start()
-                except Exception:
-                    source.close()   # don't orphan the bound port
-                    raise
-                self.engines.append(engine)
-        except Exception:
-            # partial construction must not leak threads/bound ports
-            self.stop_all()
-            raise
         # itertools.count: next() is atomic under the GIL, so
         # concurrent client threads can't tear the round-robin
         self._next = itertools.count()
-        self.breakers: List[CircuitBreaker] = [
+        self.breakers: List[CircuitBreaker] = []
+
+    def _build_breakers(self, failure_threshold: int,
+                        breaker_cooldown: float) -> None:
+        self.breakers = [
             CircuitBreaker(failure_threshold=failure_threshold,
                            cooldown=breaker_cooldown,
-                           name=f"engine{i}@{e.source.address}")
-            for i, e in enumerate(self.engines)]
-        log.info("fleet of %d engines: %s", n_engines, self.addresses)
+                           name=f"engine{i}@{addr}")
+            for i, addr in enumerate(self.addresses)]
+        # an opening circuit is exactly the moment evidence matters:
+        # auto-capture a flight-recorder bundle (rate-limited) on the
+        # closed->open transition of any engine's breaker. on_open is
+        # a single slot, so ONE recorder gets the hook — the fleet's
+        # engines share one (the constructor arg or the process-wide
+        # default), so take the first engine's.
+        rec = next((e.flight_recorder for e in self.engines
+                    if getattr(e, "flight_recorder", None) is not None),
+                   None)
+        if rec is not None:
+            for breaker in self.breakers:
+                breaker.on_open = (
+                    lambda b, _rec=rec: _rec.trigger(
+                        f"circuit_open:{b.name}"))
+
+    @classmethod
+    def connect(cls, addresses: List[str],
+                failure_threshold: int = 3,
+                breaker_cooldown: float = 2.0,
+                hedge_percentile: Optional[float] = None,
+                hedge_min_s: float = 0.02,
+                tracer=None,
+                tracing: Optional[bool] = None) -> "ServingFleet":
+        """A CLIENT-ONLY fleet over engines that live in OTHER
+        processes (or hosts): the same round-robin + circuit-breaking
+        + failover + hedging client, pointed at explicit addresses
+        instead of in-process engines. This is the multi-process
+        deployment shape (one OS process per engine — the ROADMAP
+        sharded-serving direction): each leg injects the traceparent
+        context, so a request that retries/hedges across processes
+        still reassembles into ONE trace from the engines' exported
+        buffers (``core.trace.merge_chrome_traces``).
+
+        Engine-management surfaces (``rolling_swap``, ``metrics``,
+        ``kill_engine``) are inert on a connected client — scrape the
+        remote engines' own ``/metrics``/``/healthz`` instead."""
+        fleet = cls.__new__(cls)
+        fleet.zoo = None
+        fleet.admission = None
+        fleet._init_client(tracer=tracer, tracing=tracing,
+                           hedge_percentile=hedge_percentile,
+                           hedge_min_s=hedge_min_s)
+        fleet._remote_addresses = [str(a).rstrip("/") for a in addresses]
+        if not fleet._remote_addresses:
+            raise ValueError("connect() needs at least one address")
+        fleet._build_breakers(failure_threshold, breaker_cooldown)
+        log.info("fleet client connected to %d remote engines: %s",
+                 len(fleet._remote_addresses), fleet.addresses)
+        return fleet
 
     @property
     def addresses(self) -> List[str]:
+        if self._remote_addresses is not None:
+            return list(self._remote_addresses)
         return [e.source.address for e in self.engines]
 
     # -- transport ---------------------------------------------------------
@@ -983,44 +1051,153 @@ class ServingFleet:
         else:
             breaker.record_failure()
 
+    # -- client-side tracing -------------------------------------------------
+
+    def _client_trace(self, name: str):
+        """One trace per logical client call. Inside an active span
+        (``core.trace.use_span``) the new root CONTINUES that trace as
+        a child, so an embedder's own spans, the client legs, and the
+        remote engines' server spans all share one trace id."""
+        if self.tracer is None:
+            return None
+        from mmlspark_tpu.core.trace import current_span
+        cur = current_span()
+        return self.tracer.new_trace(
+            name,
+            trace_id=cur.trace_id if cur is not None else None,
+            parent_id=cur.span_id if cur is not None else None)
+
+    def _leg_span(self, trace, i: int, hedge: bool = False,
+                  probe: bool = False):
+        """One client leg span + the propagation headers it must carry.
+        Every leg of one logical post — retries, failovers, hedges —
+        is a SIBLING under the same root, so the fan-out renders as one
+        trace; the remote engine parents its server span on the leg's
+        span id (Tracer.inject/extract)."""
+        if trace is None:
+            return None, None
+        span = self.tracer.start_span("client.post", trace,
+                                      parent=trace.root)
+        span.set("engine", i)
+        span.set("address", self.addresses[i])
+        if hedge:
+            span.set("hedge", True)
+        if probe:
+            span.set("probe", True)
+        return span, self.tracer.inject(span)
+
+    @staticmethod
+    def _merged_headers(extra_headers: Optional[Dict[str, str]],
+                        inject: Optional[Dict[str, str]]
+                        ) -> Optional[Dict[str, str]]:
+        if not inject:
+            return extra_headers
+        return {**(extra_headers or {}), **inject}
+
+    # serializes leg-span verdicts: a hedge winner cancelling the
+    # loser races the loser's own done-callback (they run on different
+    # threads); without the lock the same span could be labeled BOTH
+    # cancelled and error, or a genuinely failed leg could lose its
+    # error to a concurrent cancel. Critical sections are a few
+    # attribute stores — one class-wide lock is cheap and sufficient.
+    _leg_lock = threading.Lock()
+
+    @staticmethod
+    def _mark_root_http(trace, code: int) -> None:
+        """The client root's verdict for an app-level HTTP status —
+        the server-side shed-vs-error discipline (the shared
+        ``core.trace.SHED_STATUSES`` policy): back-pressure statuses
+        are shed=true, only real 5xx are errors. A hot tenant's quota
+        429s must not flood the client tracer's protected tail ring."""
+        if trace is None:
+            return
+        from mmlspark_tpu.core.trace import SHED_STATUSES
+        trace.root.set("http_status", code)
+        if code in SHED_STATUSES:
+            trace.root.set("shed", True)
+        elif code >= 500:
+            trace.root.error()
+
+    def _finish_leg(self, span, err: Optional[BaseException]) -> None:
+        """Close one leg span for its own outcome — UNLESS the leg was
+        already marked cancelled (it lost a hedge race: the winner
+        closed it; its late real outcome must not rewrite the
+        verdict). Quota/shed HTTP statuses mark the leg shed, not
+        error (the root discipline, per leg)."""
+        if span is None:
+            return
+        from mmlspark_tpu.core.trace import SHED_STATUSES
+        with self._leg_lock:
+            if span.end is not None or span.attrs.get("cancelled"):
+                return
+            if isinstance(err, urllib.error.HTTPError) and \
+                    err.code in SHED_STATUSES:
+                span.set("shed", True)
+                span.set("http_status", err.code)
+            elif err is not None:
+                span.error(err)
+            span.finish()
+
+    @classmethod
+    def _cancel_leg(cls, span) -> None:
+        """Mark a hedge loser: ``cancelled=true``, NOT error — the leg
+        was abandoned because its sibling answered first, which is the
+        hedge working as designed, not a failure (the shed-vs-error
+        distinction applied to client spans: 'cancelled' must not
+        flood error dashboards or the protected tail ring)."""
+        if span is None:
+            return
+        with cls._leg_lock:
+            if span.end is None:
+                span.set("cancelled", True)
+                span.finish()
+
     def _attempt(self, i: int, body: bytes, timeout: float, tried: set,
                  allow_hedge: bool,
                  content_type: str = "application/json",
                  extra_headers: Optional[Dict[str, str]] = None,
-                 ) -> Dict[str, Any]:
+                 trace=None) -> Dict[str, Any]:
         """One logical attempt against engine ``i``, hedged onto another
         replica if allowed and the reply is slower than the hedge
         threshold. ALL breaker recording happens here — for a hedged
         primary the outcome is recorded when its leg actually finishes
         (a stalled primary must still open its circuit even though the
         hedge rescued the request). Raises the (winning) transport
-        error on failure."""
+        error on failure. Each leg carries its own traceparent headers
+        (per-leg client spans under ``trace``)."""
         breaker = self.breakers[i]
         addr = self.addresses[i]
         threshold = self._hedge_threshold() if allow_hedge else None
         if threshold is None or threshold >= timeout:
+            span, inj = self._leg_span(trace, i)
             try:
                 # allow_hedge carries post()'s idempotent flag: only
                 # idempotent requests may transparently replay a
                 # response-phase stale-connection failure
-                result = self._http_post(addr, body, timeout,
-                                         replayable=allow_hedge,
-                                         content_type=content_type,
-                                         extra_headers=extra_headers)
+                result = self._http_post(
+                    addr, body, timeout, replayable=allow_hedge,
+                    content_type=content_type,
+                    extra_headers=self._merged_headers(extra_headers,
+                                                       inj))
             except Exception as e:
                 self._classify_and_record(breaker, e)
+                self._finish_leg(span, e)
                 raise
             self._classify_and_record(breaker, None)
+            self._finish_leg(span, None)
             return result
         import time as _time
         start = _time.monotonic()
         # hedge legs run on spawned one-shot threads: pooled=False, or
         # each call would strand a keep-alive conn in a dead thread's
         # local storage (hedging only runs for idempotent requests)
+        span1, inj1 = self._leg_span(trace, i)
         f1 = self._submit(self._http_post, addr, body, timeout,
-                          True, False, content_type, extra_headers)
+                          True, False, content_type,
+                          self._merged_headers(extra_headers, inj1))
         f1.add_done_callback(
-            lambda f: self._classify_and_record(breaker, f.exception()))
+            lambda f: (self._classify_and_record(breaker, f.exception()),
+                       self._finish_leg(span1, f.exception())))
         try:
             return f1.result(timeout=threshold)
         except _FutureTimeout:
@@ -1028,7 +1205,7 @@ class ServingFleet:
         # allow() (not a bare state check) so a half-open replica's
         # probe budget also gates hedge traffic — a barely-recovered
         # engine must not get a thundering herd of hedges
-        j = next((k for k in range(len(self.engines))
+        j = next((k for k in range(len(self.breakers))
                   if k != i and k not in tried
                   and self.breakers[k].allow()),
                  None)
@@ -1038,12 +1215,14 @@ class ServingFleet:
         with self._stats_lock:
             self.hedged_requests += 1
         tried.add(j)   # the hedge consumed replica j for this request
+        span2, inj2 = self._leg_span(trace, j, hedge=True)
         f2 = self._submit(self._http_post, self.addresses[j], body,
                           timeout, True, False, content_type,
-                          extra_headers)
+                          self._merged_headers(extra_headers, inj2))
         f2.add_done_callback(
-            lambda f: self._classify_and_record(self.breakers[j],
-                                                f.exception()))
+            lambda f: (self._classify_and_record(self.breakers[j],
+                                                 f.exception()),
+                       self._finish_leg(span2, f.exception())))
         pending = {f1, f2}
         first_error: Optional[BaseException] = None
         while pending:
@@ -1059,6 +1238,16 @@ class ServingFleet:
             for f in done:
                 err = f.exception()
                 if err is None:
+                    # the sibling leg LOSES: mark it cancelled (not
+                    # error) — but only while it is genuinely still in
+                    # flight. A leg that already COMPLETED (e.g. both
+                    # futures landed in one wait round) gets its real
+                    # verdict from its own done-callback; cancelling
+                    # it would erase a true transport error.
+                    loser_f, loser_span = ((f2, span2) if f is f1
+                                           else (f1, span1))
+                    if not loser_f.done():
+                        self._cancel_leg(loser_span)
                     return f.result()
                 first_error = first_error or err
         raise first_error  # both legs failed — surface the primary's
@@ -1107,86 +1296,120 @@ class ServingFleet:
             else json.dumps(payload).encode()
         extra_headers = self._route_headers(model, tenant, priority,
                                             headers)
-        n = len(self.engines)
+        n = len(self.addresses)
         start = next(self._next)
         order = [(start + k) % n for k in range(n)]
         max_tries = n if idempotent else 1
         attempts: List[Dict[str, Any]] = []
         tried: set = set()
-        for i in order:
-            if len(tried) >= max_tries:
-                break
-            if i in tried:
-                continue   # already consumed as a hedge leg
-            breaker = self.breakers[i]
-            if not breaker.allow():
-                attempts.append({"engine": i, "address": self.addresses[i],
-                                 "error": "circuit open", "skipped": True})
-                continue
-            tried.add(i)
-            try:
-                # _attempt owns ALL breaker recording (incl. hedge legs)
-                result = self._attempt(i, body, timeout, tried,
-                                       allow_hedge=idempotent,
-                                       content_type=content_type,
-                                       extra_headers=extra_headers)
-            except urllib.error.HTTPError as e:
-                if e.code in _FAILOVER_CODES:
+        # the client-side trace of this LOGICAL request: every leg
+        # (failover, hedge, probe) is a sibling client span under this
+        # root, and each leg's traceparent headers make the remote
+        # engine's server spans children of that leg — one trace id
+        # across processes
+        trace = self._client_trace("fleet.post")
+        try:
+            for i in order:
+                if len(tried) >= max_tries:
+                    break
+                if i in tried:
+                    continue   # already consumed as a hedge leg
+                breaker = self.breakers[i]
+                if not breaker.allow():
                     attempts.append(
                         {"engine": i, "address": self.addresses[i],
-                         "error": f"HTTP {e.code}", "skipped": False})
+                         "error": "circuit open", "skipped": True})
                     continue
-                # app-level error: the engine is alive and answering —
-                # the request itself is at fault. Surface it unchanged.
-                raise
-            except Exception as e:  # noqa: BLE001 — URLError/timeout/...
-                with self._stats_lock:
-                    self.transport_errors += 1
-                attempts.append(
-                    {"engine": i, "address": self.addresses[i],
-                     "error": f"{type(e).__name__}: {e}", "skipped": False})
-                continue
-            self._record_latency(result["latency"])
-            return result["body"]
-        if not tried and order:
-            # every circuit open: last-resort probe of the round-robin
-            # head so the fleet can rediscover a recovered engine even
-            # before the breaker cooldown elapses. SINGLE-FLIGHT: only
-            # one caller at a time pays the probe's timeout against a
-            # possibly-stalled engine; everyone else fails fast — the
-            # whole point of an open circuit during a total outage.
-            if not self._probe_lock.acquire(blocking=False):
-                attempts.append(
-                    {"engine": order[0], "address": self.addresses[order[0]],
-                     "error": "circuit open (probe in flight)",
-                     "skipped": True})
-                raise ServingUnavailable(attempts)
-            try:
-                return self._probe(order[0], body, timeout, attempts,
-                                   idempotent, content_type,
-                                   extra_headers)
-            finally:
-                self._probe_lock.release()
-        raise ServingUnavailable(attempts)
+                tried.add(i)
+                try:
+                    # _attempt owns ALL breaker recording (incl. hedges)
+                    result = self._attempt(i, body, timeout, tried,
+                                           allow_hedge=idempotent,
+                                           content_type=content_type,
+                                           extra_headers=extra_headers,
+                                           trace=trace)
+                except urllib.error.HTTPError as e:
+                    if e.code in _FAILOVER_CODES:
+                        attempts.append(
+                            {"engine": i, "address": self.addresses[i],
+                             "error": f"HTTP {e.code}", "skipped": False})
+                        continue
+                    # app-level error: the engine is alive and
+                    # answering — the request itself is at fault.
+                    # Surface it unchanged.
+                    self._mark_root_http(trace, e.code)
+                    raise
+                except Exception as e:  # noqa: BLE001 — URLError/...
+                    with self._stats_lock:
+                        self.transport_errors += 1
+                    attempts.append(
+                        {"engine": i, "address": self.addresses[i],
+                         "error": f"{type(e).__name__}: {e}",
+                         "skipped": False})
+                    continue
+                self._record_latency(result["latency"])
+                if trace is not None:
+                    # failovers = legs that actually RAN and failed
+                    # before this one; circuit-open skips produced no
+                    # client leg and must not inflate the count the
+                    # perfetto walkthrough pairs with sibling legs
+                    failovers = len([a for a in attempts
+                                     if not a.get("skipped")])
+                    if failovers:
+                        trace.root.set("failovers", failovers)
+                return result["body"]
+            if not tried and order:
+                # every circuit open: last-resort probe of the
+                # round-robin head so the fleet can rediscover a
+                # recovered engine even before the breaker cooldown
+                # elapses. SINGLE-FLIGHT: only one caller at a time
+                # pays the probe's timeout against a possibly-stalled
+                # engine; everyone else fails fast — the whole point of
+                # an open circuit during a total outage.
+                if not self._probe_lock.acquire(blocking=False):
+                    attempts.append(
+                        {"engine": order[0],
+                         "address": self.addresses[order[0]],
+                         "error": "circuit open (probe in flight)",
+                         "skipped": True})
+                    raise ServingUnavailable(attempts)
+                try:
+                    return self._probe(order[0], body, timeout, attempts,
+                                       idempotent, content_type,
+                                       extra_headers, trace=trace)
+                finally:
+                    self._probe_lock.release()
+            raise ServingUnavailable(attempts)
+        except ServingUnavailable:
+            if trace is not None:
+                trace.root.error("no serving engine available")
+            raise
+        finally:
+            if trace is not None:
+                self.tracer.finish(trace)
 
     def _probe(self, i: int, body: bytes, timeout: float,
                attempts: List[Dict[str, Any]],
                replayable: bool = True,
                content_type: str = "application/json",
                extra_headers: Optional[Dict[str, str]] = None,
-               ) -> Dict[str, Any]:
+               trace=None) -> Dict[str, Any]:
         """The all-circuits-open last-resort probe of engine ``i``."""
+        span, inj = self._leg_span(trace, i, probe=True)
+        extra_headers = self._merged_headers(extra_headers, inj)
         try:
             result = self._http_post(self.addresses[i], body, timeout,
                                      replayable=replayable,
                                      content_type=content_type,
                                      extra_headers=extra_headers)
         except urllib.error.HTTPError as e:
+            self._finish_leg(span, e)
             if e.code not in _FAILOVER_CODES:
                 # engine alive and answering: the post() contract —
                 # app-level errors (a poison row's 500) propagate
                 # unchanged — holds on the probe path too, and an
                 # answering engine force-closes its breaker
+                self._mark_root_http(trace, e.code)
                 self.breakers[i].reset()
                 raise
             self.breakers[i].record_failure()
@@ -1195,6 +1418,7 @@ class ServingFleet:
                  "error": f"HTTP {e.code}", "skipped": False})
             raise ServingUnavailable(attempts) from e
         except Exception as e:  # noqa: BLE001 — URLError/timeout/...
+            self._finish_leg(span, e)
             with self._stats_lock:
                 self.transport_errors += 1
             attempts.append(
@@ -1202,6 +1426,7 @@ class ServingFleet:
                  "error": f"{type(e).__name__}: {e}", "skipped": False})
             raise ServingUnavailable(attempts) from e
         # a real scored reply while OPEN: force the breaker closed
+        self._finish_leg(span, None)
         self.breakers[i].reset()
         self._record_latency(result["latency"])
         return result["body"]
@@ -1284,11 +1509,11 @@ class ServingFleet:
     # -- observability -----------------------------------------------------
 
     def health(self, timeout: float = 2.0) -> List[Dict[str, Any]]:
-        """Poll every engine's /healthz; unreachable engines report
-        ``{"reachable": False, "error": ...}``."""
+        """Poll every engine's /healthz (in-process or remote);
+        unreachable engines report ``{"reachable": False, ...}``."""
         out = []
-        for e in self.engines:
-            url = f"{e.source.address}/healthz"
+        for addr in self.addresses:
+            url = f"{addr}/healthz"
             try:
                 with urllib.request.urlopen(url, timeout=timeout) as r:
                     out.append({"reachable": True,
@@ -1402,6 +1627,15 @@ class ServingFleet:
                           "requests rejected by admission/model routing",
                           rejections[reason],
                           {**labels, "reason": reason})
+            if e.slo is not None:
+                from mmlspark_tpu.core.prometheus import slo_families
+                try:
+                    # per-engine SLO families (engine label): each
+                    # engine's burn state is its own — a fleet is
+                    # degraded engine by engine
+                    slo_families(r, e.slo, labels)
+                except Exception:  # noqa: BLE001 — stats stay partial
+                    pass
         if self.zoo is not None:
             # ONE zoo across the fleet: its families render once, not
             # per engine (the per-model label space stays capped)

@@ -475,6 +475,18 @@ def execute_swap(engine: ServingEngine, pipeline: Any, version: str,
             log.warning("swap %s -> %s ROLLED BACK on %s: %s",
                         from_version, version, engine.source.address,
                         reason)
+            recorder = getattr(engine, "flight_recorder", None)
+            if recorder is not None:
+                # a rollback is exactly the moment the evidence matters:
+                # auto-capture a post-mortem bundle (rate-limited) with
+                # the canary's traces, the alert/event timeline, and the
+                # windowed series at the decision point
+                try:
+                    recorder.trigger(
+                        f"swap_rollback:{from_version}->{version}:"
+                        f"{reason}")
+                except Exception:  # noqa: BLE001 — capture is
+                    pass           # best-effort, never blocks rollback
             return SwapResult(False, event)
 
         if not engine.is_alive():

@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import json
+import os
 import queue
 import secrets
 import threading
@@ -153,6 +154,12 @@ class HTTPSource:
         self.tracer = None
         self.trace_probe: Optional[Callable[..., Dict[str, Any]]] = None
         self.prom_probe: Optional[Callable[[], str]] = None
+        # set by ServingEngine.start(): the windowed SLO monitor
+        # (core/slo.py — one sample per answered request, burn-rate
+        # status folded into /healthz) and the flight-recorder bundle
+        # probe behind /debug/bundle
+        self.slo = None
+        self.bundle_probe: Optional[Callable[..., Dict[str, Any]]] = None
         self._pending: Dict[str, _ParkedRequest] = {}
         self._lock = threading.Lock()
         self._new_rid = _request_id_factory()
@@ -199,6 +206,32 @@ class HTTPSource:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _query_limit(self):
+                """``?limit=`` parsed strictly: (ok, value). A
+                non-integer or negative limit is the CALLER's mistake
+                and must 400 — the old silent-ignore turned typos into
+                full-buffer dumps, and a crash here was a 500 stack
+                trace on a debug endpoint."""
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query,
+                    keep_blank_values=True)
+                vals = query.get("limit")
+                if vals is None:
+                    return True, None
+                try:
+                    limit = int(vals[0])
+                except (TypeError, ValueError):
+                    return False, None
+                if limit < 0:
+                    return False, None
+                return True, limit
+
+            def _query_flag(self, name: str) -> bool:
+                query = urllib.parse.parse_qs(
+                    urllib.parse.urlsplit(self.path).query)
+                vals = query.get(name)
+                return bool(vals) and vals[0] not in ("0", "false", "")
+
             def do_GET(self):  # noqa: N802 (http.server API)
                 path_only = self.path.split("?", 1)[0].rstrip("/")
                 if path_only == "/metrics":
@@ -224,18 +257,44 @@ class HTTPSource:
                         self.send_error(
                             404, "no engine attached (traces)")
                         return
-                    limit = None
-                    query = urllib.parse.parse_qs(
-                        urllib.parse.urlsplit(self.path).query)
-                    if query.get("limit"):
-                        try:
-                            limit = int(query["limit"][0])
-                        except ValueError:
-                            pass
+                    ok, limit = self._query_limit()
+                    if not ok:
+                        self._send_json(400, {
+                            "error": "limit must be a non-negative "
+                                     "integer"})
+                        return
                     try:
                         payload = source.trace_probe(limit)
                     except Exception as e:  # noqa: BLE001
                         self.send_error(500, f"trace export: {e}")
+                        return
+                    self._send_json(200, payload)
+                    return
+                if path_only == "/debug/bundle":
+                    # the flight recorder's self-contained post-mortem
+                    # bundle (core/flightrecorder.py). Multi-MB on a
+                    # busy engine, so a casual scrape must opt in with
+                    # ?confirm=1 — crawlers and dashboard wildcards do
+                    # not get to dump the black box by accident.
+                    if source.bundle_probe is None:
+                        self.send_error(
+                            404, "no flight recorder attached (bundle)")
+                        return
+                    ok, limit = self._query_limit()
+                    if not ok:
+                        self._send_json(400, {
+                            "error": "limit must be a non-negative "
+                                     "integer"})
+                        return
+                    if not self._query_flag("confirm"):
+                        self._send_json(400, {
+                            "error": "bundle dumps are large; re-request"
+                                     " with ?confirm=1"})
+                        return
+                    try:
+                        payload = source.bundle_probe(limit)
+                    except Exception as e:  # noqa: BLE001
+                        self.send_error(500, f"bundle export: {e}")
                         return
                     self._send_json(200, payload)
                     return
@@ -254,9 +313,26 @@ class HTTPSource:
                         metrics = source.metrics_probe()
                     except Exception:  # noqa: BLE001 — stats stay partial
                         metrics = {"error": "metrics probe failed"}
+                slo_status: Optional[Dict[str, Any]] = None
+                if source.slo is not None:
+                    try:
+                        # a scrape-driven evaluation (tightly gated) so
+                        # alert state is fresh even on an idle engine
+                        source.slo.evaluate(min_interval_s=0.2)
+                        slo_status = source.slo.status()
+                    except Exception:  # noqa: BLE001 — stats stay partial
+                        slo_status = {"error": "slo probe failed"}
+                # DEGRADED: alive and serving, but an SLO is burning —
+                # stays HTTP 200 (a degraded engine must keep taking
+                # traffic; pulling it from the LB would turn a burn
+                # into an outage) with the machine-readable verdict
+                status = "ok" if healthy else "unhealthy"
+                if healthy and slo_status is not None and \
+                        slo_status.get("degraded"):
+                    status = "degraded"
                 with source._lock:
                     stats = {
-                        "status": "ok" if healthy else "unhealthy",
+                        "status": status,
                         "seen": source.requests_seen,
                         "accepted": source.requests_accepted,
                         "answered": source.requests_answered,
@@ -266,6 +342,8 @@ class HTTPSource:
                     }
                 if metrics is not None:
                     stats["metrics"] = metrics
+                if slo_status is not None:
+                    stats["slo"] = slo_status
                 self._send_json(200 if healthy else 503, stats)
 
             def do_POST(self):  # noqa: N802 (http.server API)
@@ -283,6 +361,7 @@ class HTTPSource:
                     return
                 with source._lock:
                     source.requests_seen += 1
+                t_req = time.perf_counter()
                 path_only = self.path.split("?", 1)[0]
                 if source.api_path not in ("/", "") and \
                         path_only.rstrip("/") != source.api_path.rstrip("/"):
@@ -296,21 +375,37 @@ class HTTPSource:
                 parked = _ParkedRequest(source._new_rid(), req)
                 tracer = source.tracer
                 if tracer is not None and tracer.enabled:
-                    # request-scoped trace: root span from ingress,
-                    # trace id propagated from (or issued to) the
-                    # client via X-Trace-Id. This handler is the single
-                    # finalization point — every exit below buffers it.
-                    parked.trace = tracer.new_trace(
-                        "request",
-                        trace_id=self.headers.get("X-Trace-Id"))
+                    # request-scoped trace: root span from ingress. A
+                    # traceparent header (or the legacy X-Trace-Id
+                    # alias) CONTINUES the caller's trace — the root
+                    # becomes a child of the remote client span, so a
+                    # fleet request spanning several engine processes
+                    # reassembles into one trace. This handler is the
+                    # single finalization point — every exit below
+                    # buffers it.
+                    ctx = tracer.extract(self.headers)
+                    parked.trace = tracer.continue_trace("request", ctx)
                     parked.trace.root.set("path", self.path)
+                    if ctx is not None and ctx.parent_id:
+                        parked.trace.root.set("remote_parent", True)
 
                 def _finalize(code: int) -> None:
+                    # every exit records exactly one SLO sample: the
+                    # caller-observed verdict (5xx = unavailability,
+                    # shed 503s included) and wall latency
+                    if source.slo is not None:
+                        try:
+                            source.slo.record(
+                                code < 500,
+                                (time.perf_counter() - t_req) * 1e3)
+                        except Exception:  # noqa: BLE001 — best-effort
+                            pass
                     tr = parked.trace
                     if tr is None:
                         return
+                    from mmlspark_tpu.core.trace import SHED_STATUSES
                     tr.root.set("http_status", code)
-                    if code in (429, 503):
+                    if code in SHED_STATUSES:
                         # load shedding / admission rejections are
                         # EXPECTED back-pressure, not failures: marking
                         # them as errors would let an overload flood the
@@ -378,7 +473,7 @@ class HTTPSource:
                     # of killing the handler thread with a stack trace
                     if parked.trace is not None:
                         parked.trace.root.set("client_disconnected", True)
-                        _finalize(499)
+                    _finalize(499)
                     self.close_connection = True
                     return
                 with source._lock:
@@ -673,7 +768,9 @@ class ServingEngine:
                  tracing: Optional[bool] = None,
                  zoo=None, admission=None,
                  activation_timeout_s: float = 30.0,
-                 zoo_enforce_interval_s: float = 1.0):
+                 zoo_enforce_interval_s: float = 1.0,
+                 slo=None, flight_recorder=None,
+                 slo_eval_interval_s: float = 0.25):
         from mmlspark_tpu.core.metrics import histogram_set
         from mmlspark_tpu.core import trace as trace_mod
         self.source = source
@@ -713,6 +810,47 @@ class ServingEngine:
                        else trace_mod.get_tracer()) if tracing else None
         if self.tracer is not None and not self.tracer.enabled:
             self.tracer = None
+        # windowed SLO engine (core/slo.py): always on by default —
+        # one sample per answered request at the HTTP handler, a
+        # rate-gated burn-rate evaluation on the batcher tick, status
+        # on /healthz + serving_slo_* on /metrics. ``slo=False``
+        # disables; pass an SLOMonitor to share/customize objectives.
+        if slo is None:
+            from mmlspark_tpu.core.slo import SLOMonitor
+            slo = SLOMonitor()
+        elif slo is False:
+            slo = None
+        self.slo = slo
+        self._slo_eval_interval_s = float(slo_eval_interval_s)
+        # flight recorder (core/flightrecorder.py): the always-on
+        # black box — defaults to the process-wide recorder so one
+        # bundle tells the whole process's story. ``False`` disables.
+        if flight_recorder is None:
+            from mmlspark_tpu.core.flightrecorder import get_recorder
+            flight_recorder = get_recorder()
+        elif flight_recorder is False:
+            flight_recorder = None
+        self.flight_recorder = flight_recorder
+        # hooks THIS engine installs on the monitor are remembered so
+        # stop() can uninstall exactly them: a shared SLOMonitor
+        # reused in a later engine must not keep routing bundles to a
+        # stopped engine's recorder
+        self._slo_hooks_installed: List[str] = []
+        if self.slo is not None:
+            if self.flight_recorder is not None and \
+                    self.slo.on_fire is None:
+                # SLO breach => auto-captured post-mortem bundle
+                # (rate-limited inside the recorder)
+                rec = self.flight_recorder
+                self.slo.on_fire = (
+                    lambda alert: rec.trigger(
+                        f"slo_breach:{alert.name}"))
+                self._slo_hooks_installed.append("on_fire")
+            if zoo is not None and self.slo.record_event is None:
+                # alert transitions land on the registry event
+                # timeline next to SwapEvent/ZooEvent
+                self.slo.record_event = zoo.record_event
+                self._slo_hooks_installed.append("record_event")
         # versioned pipeline binding: batches carry the handle they
         # were built with, so a swap can cut over atomically (one
         # attribute store) while in-flight batches drain on their own
@@ -960,6 +1098,14 @@ class ServingEngine:
                 self._run_rescued(table, ids, handle.rescue_to, tctx)
                 return
             log.warning("serving batch failed (%s); retrying per-row", e)
+            if self.slo is not None and handle.model_key is not None:
+                # per-model SLO stream (batch granularity): the failed
+                # batch is this model's bad event even though per-row
+                # retries may still answer some rows
+                self.slo.record(False,
+                                (time.perf_counter() - t0) * 1e3,
+                                model=handle.model_key,
+                                include_engine=False)
             self._process_rows_individually(table, ids, handle, tctx)
             with self._stats_lock:
                 self.batches_processed += 1
@@ -993,6 +1139,11 @@ class ServingEngine:
         if self.zoo is not None and handle.model_name is not None:
             # per-model latency (cardinality-capped — serving/zoo.py)
             self.zoo.observe_latency(handle.model_name, dt_ms)
+        if self.slo is not None and handle.model_key is not None:
+            # per-model SLO stream (engine-level totals come from the
+            # HTTP handler; include_engine=False avoids double count)
+            self.slo.record(True, dt_ms, model=handle.model_key,
+                            include_engine=False)
         t1 = time.perf_counter()
         try:
             self._answer_output(out, ids, tctx, handle)
@@ -1176,6 +1327,16 @@ class ServingEngine:
                 log.error("serving batcher error (continuing): %s", e)
                 time.sleep(0.005)
                 continue
+            if self.slo is not None:
+                # burn-rate evaluation tick: the batcher is the one
+                # thread that is always awake (drain polls 50 ms even
+                # idle), so alerts fire DURING a burn and resolve
+                # after recovery without waiting for a scrape
+                try:
+                    self.slo.evaluate(
+                        min_interval_s=self._slo_eval_interval_s)
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    log.error("slo evaluate failed (continuing): %s", e)
             if self.zoo is None:
                 if parked:
                     self._dispatch_parked(parked)
@@ -1608,6 +1769,11 @@ class ServingEngine:
                 out["swap"] = swap_ctl.stats()
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
+        if self.slo is not None:
+            try:
+                out["slo"] = self.slo.status()
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         stage = getattr(active.pipeline, "metrics", None)
         if callable(stage):
             try:
@@ -1699,6 +1865,12 @@ class ServingEngine:
                 zoo_families(r, self.zoo)
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
+        if self.slo is not None:
+            from mmlspark_tpu.core.prometheus import slo_families
+            try:
+                slo_families(r, self.slo)
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         pipeline_families(r, active.pipeline)
         process_families(r, tracer=self.tracer)
         return r.render()
@@ -1714,9 +1886,18 @@ class ServingEngine:
 
     def export_traces(self, limit: Optional[int] = None) -> Dict[str, Any]:
         """The buffer as Chrome trace-event JSON (the /debug/traces
-        payload — save it and open in Perfetto)."""
+        payload — save it and open in Perfetto). Carries a
+        ``process_name`` metadata event naming this engine + pid, so
+        merged multi-process exports (``core.trace.merge_chrome_traces``)
+        render one labeled track group per engine process."""
         from mmlspark_tpu.core.trace import to_chrome_trace
-        return to_chrome_trace(self.traces(limit))
+        return to_chrome_trace(
+            self.traces(limit),
+            process_name=f"engine {self.source.address} "
+                         f"pid={os.getpid()}")
+
+    def _recorder_key(self) -> str:
+        return f"engine@{self.source.address}"
 
     def start(self) -> "ServingEngine":
         with self._threads_lock:
@@ -1731,6 +1912,30 @@ class ServingEngine:
         self.source.tracer = self.tracer
         self.source.trace_probe = self.export_traces
         self.source.prom_probe = self.metrics_text
+        self.source.slo = self.slo
+        rec = self.flight_recorder
+        if rec is not None:
+            # the black box sees this engine's traces, SLO state, the
+            # lifecycle/zoo event timelines, and a metrics snapshot;
+            # keys carry the address so stop() can detach cleanly
+            key = self._recorder_key()
+            rec.attach_tracer(
+                self.tracer,
+                label=f"engine {self.source.address} pid={os.getpid()}",
+                key=f"{key}:tracer")
+            if self.slo is not None:
+                rec.attach_slo(key, self.slo)
+            rec.add_event_source(f"{key}:swap_events",
+                                 lambda: self.swap_events)
+            if self.zoo is not None:
+                # keyed per engine (a shared zoo re-attaches under each
+                # engine's key) so stop()'s prefix detach releases it
+                rec.add_event_source(f"{key}:registry_events",
+                                     lambda: self.zoo.events)
+            rec.add_stats_source(key, self.metrics)
+            self.source.bundle_probe = (
+                lambda limit=None: rec.dump_bundle(
+                    reason="http_request", trace_limit=limit))
         return self
 
     def kill(self, close_source: bool = True) -> None:
@@ -1746,6 +1951,17 @@ class ServingEngine:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.flight_recorder is not None:
+            # drop this engine's recorder hooks (a process recorder
+            # outlives engines; stale closures would leak them)
+            self.flight_recorder.detach(self._recorder_key())
+        if self.slo is not None:
+            # uninstall exactly the monitor hooks THIS engine wired:
+            # a shared monitor handed to a later engine must re-wire
+            # to that engine's recorder/zoo, not keep ours
+            for hook in self._slo_hooks_installed:
+                setattr(self.slo, hook, None)
+            self._slo_hooks_installed = []
         if self._supervisor is not None:
             self._supervisor.join(timeout=5)
         with self._threads_lock:
@@ -1776,7 +1992,9 @@ def serve_model(pipeline: Optional[Transformer] = None,
                 pipeline_depth: int = 2,
                 version: str = "v0", tracer=None,
                 tracing: Optional[bool] = None,
-                zoo=None, admission=None) -> ServingEngine:
+                zoo=None, admission=None,
+                slo=None, flight_recorder=None,
+                slo_eval_interval_s: float = 0.25) -> ServingEngine:
     """One-call serving: the ``.server()`` DSL analog
     (ref: ServingImplicits.scala:10-50). Batches flush on
     ``batch_size`` rows or ``max_wait_ms`` elapsed, whichever first;
@@ -1791,4 +2009,7 @@ def serve_model(pipeline: Optional[Transformer] = None,
                          pipeline_depth=pipeline_depth,
                          version=version, tracer=tracer,
                          tracing=tracing, zoo=zoo,
-                         admission=admission).start()
+                         admission=admission, slo=slo,
+                         flight_recorder=flight_recorder,
+                         slo_eval_interval_s=slo_eval_interval_s,
+                         ).start()

@@ -33,7 +33,12 @@ ATC'21): many versioned models, one fleet, bounded tail latency.
   version,precision,aot,state}`` and per-model latency histograms ride
   ``serving_model_latency_ms{model=...}`` under a hard
   label-cardinality cap (overflow models fold into ``model="_other"``
-  — docs/model_zoo.md).
+  — docs/model_zoo.md). A zoo-attached engine's SLO monitor
+  (core/slo.py) also records its burn-rate ``AlertEvent``s into the
+  SAME inherited event log, so swaps, evictions, and SLO breaches read
+  as one interleaved timeline — and its per-model SLO streams follow
+  this module's cardinality-cap discipline (overflow models share the
+  ``"_other"`` stream).
 
 ``ModelZoo`` *is* a ``ModelRegistry``: the version-ordered bookkeeping,
 ``lookup``/``list`` consistent-snapshot reads, and the event log are

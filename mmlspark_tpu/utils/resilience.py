@@ -262,6 +262,11 @@ class CircuitBreaker:
         self._opened_at = 0.0
         self._half_open_inflight = 0
         self.times_opened = 0
+        # optional closed->open transition hook (the flight recorder's
+        # auto-capture trigger rides it): called OUTSIDE the breaker
+        # lock, exceptions swallowed — a sick observer must never wedge
+        # the breaker
+        self.on_open: Optional[Callable[["CircuitBreaker"], None]] = None
 
     # -- state --------------------------------------------------------------
 
@@ -329,22 +334,35 @@ class CircuitBreaker:
                          self.name)
 
     def record_failure(self) -> None:
+        # ``tripped`` drives on_open and is set ONLY on the
+        # closed->open transition: a half-open probe failing during a
+        # sustained outage re-trips every cooldown, and firing the
+        # hook each time would churn the flight recorder's bounded
+        # bundle deque until the ORIGINAL incident's bundle — the
+        # evidence the hook exists to capture — is evicted
+        tripped = False
         with self._lock:
             self._consecutive_failures += 1
             self._outcomes.append(True)
             del self._outcomes[:-self.window]
             if self._state == self.HALF_OPEN:
                 self._trip()
-                return
-            if self._state != self.CLOSED:
-                return
-            if self._consecutive_failures >= self.failure_threshold:
-                self._trip()
-            elif (self.failure_rate is not None
-                  and len(self._outcomes) >= self.min_calls
-                  and (sum(self._outcomes) / len(self._outcomes)
-                       >= self.failure_rate)):
-                self._trip()
+            elif self._state == self.CLOSED:
+                if self._consecutive_failures >= self.failure_threshold:
+                    self._trip()
+                    tripped = True
+                elif (self.failure_rate is not None
+                      and len(self._outcomes) >= self.min_calls
+                      and (sum(self._outcomes) / len(self._outcomes)
+                           >= self.failure_rate)):
+                    self._trip()
+                    tripped = True
+        if tripped and self.on_open is not None:
+            try:
+                self.on_open(self)
+            except Exception as e:  # noqa: BLE001 — observer only
+                log.error("circuit %s on_open hook failed: %s",
+                          self.name, e)
 
     def call(self, fn: Callable[[], Any]) -> Any:
         """One gated call: open circuit raises CircuitOpenError; the

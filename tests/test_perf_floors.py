@@ -248,10 +248,14 @@ class TestTracingOverheadFloor:
 
         def run_once(tracing: bool, base_port: int) -> float:
             tracer = Tracer(enabled=True) if tracing else None
+            # slo/flight recorder OFF on both sides: this floor
+            # isolates TRACING; TestTelemetryOverheadFloor pins the
+            # full default-on telemetry plane
             fleet = ServingFleet(
                 json_scoring_pipeline(model), n_engines=2,
                 base_port=base_port, batch_size=64, workers=2,
-                max_wait_ms=6.0, tracer=tracer, tracing=tracing)
+                max_wait_ms=6.0, tracer=tracer, tracing=tracing,
+                slo=False, flight_recorder=False)
             try:
                 def post(_):
                     out = fleet.post(body, timeout=60)
@@ -289,6 +293,90 @@ class TestTracingOverheadFloor:
         # scan shows up as 10%+ and still fails hard)
         assert overhead <= 0.05, (
             f"tracing overhead {overhead:.1%} "
+            f"(off {qps_off:.1f} qps, on {qps_on:.1f} qps)")
+
+
+class TestTelemetryOverheadFloor:
+    def test_full_telemetry_overhead_within_3_percent(self):
+        """The WHOLE default-on telemetry plane — tracing + windowed
+        SLO recording/evaluation + the always-on flight recorder —
+        must stay ≤3% of serving throughput (same interleaved
+        best-of-reps discipline + 2-point noise band as the tracing
+        floor). This is PR 13's steady-state-overhead contract: the
+        black box and the burn-rate engine ride every request."""
+        import concurrent.futures
+        import json
+
+        import jax
+        from mmlspark_tpu.core.flightrecorder import FlightRecorder
+        from mmlspark_tpu.core.trace import Tracer
+        from mmlspark_tpu.models.networks import build_network
+        from mmlspark_tpu.models.tpu_model import TPUModel
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+
+        dim, n_req, clients, reps = 32, 200, 8, 3
+        module = build_network({"type": "mlp", "features": [32],
+                                "num_classes": 4})
+        weights = {"params": module.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, dim), np.float32))["params"]}
+        model = TPUModel(modelFn=lambda w, ins: module.apply(
+            {"params": w["params"]}, list(ins.values())[0]),
+            weights=weights, inputCol="features", outputCol="scores",
+            batchSize=64, computeDtype="float32")
+        model.warmup({"features": np.zeros((1, dim), np.float32)})
+        body = json.dumps({"features": [0.1] * dim}).encode()
+
+        def run_once(telemetry: bool, base_port: int) -> float:
+            tracer = Tracer(enabled=True) if telemetry else None
+            rec = FlightRecorder() if telemetry else False
+            fleet = ServingFleet(
+                json_scoring_pipeline(model), n_engines=2,
+                base_port=base_port, batch_size=64, workers=2,
+                max_wait_ms=6.0, tracer=tracer, tracing=telemetry,
+                slo=None if telemetry else False,
+                flight_recorder=rec)
+            try:
+                def post(_):
+                    out = fleet.post(body, timeout=60)
+                    assert "prediction" in out, out
+                for _ in range(8):
+                    post(0)
+                t0 = time.perf_counter()
+                with concurrent.futures.ThreadPoolExecutor(
+                        clients) as ex:
+                    list(ex.map(post, range(n_req)))
+                wall = time.perf_counter() - t0
+                if telemetry:
+                    # the plane really ran: SLO samples landed and the
+                    # recorder holds its sources
+                    slo = fleet.engines[0].slo
+                    assert slo is not None
+                    status = slo.status()
+                    assert any(k.startswith("requests_") and v > 0
+                               for k, v in status.items()
+                               if isinstance(v, (int, float))), status
+                    assert rec.stats()["slos"], "recorder saw no slo"
+            finally:
+                fleet.stop_all()
+                if telemetry:
+                    rec.close()
+            return n_req / wall
+
+        qps_off = qps_on = 0.0
+        port = 19560
+        for _ in range(reps):
+            qps_off = max(qps_off, run_once(False, port))
+            port += 30
+            qps_on = max(qps_on, run_once(True, port))
+            port += 30
+        overhead = (qps_off - qps_on) / qps_off
+        # ≤3% pinned + the same 2-point shared-host guard band the
+        # tracing floor uses
+        assert overhead <= 0.05, (
+            f"telemetry overhead {overhead:.1%} "
             f"(off {qps_off:.1f} qps, on {qps_on:.1f} qps)")
 
 

@@ -1,0 +1,737 @@
+"""Windowed SLO engine tests: WindowedCounter/WindowedHistogram
+(including multithreaded hammers — no lost updates, buckets expire
+exactly once), golden-value burn-rate math (fast burn fires at 14.4x,
+stays quiet on slow noise, resolves when the window drains), the
+flight recorder, the tools/check_metrics.py static audit, and the
+end-to-end chaos acceptance: an error-rate spike flips /healthz to
+degraded with a named burn-rate alert, emits grammar-valid
+serving_slo_* families, auto-captures a flight-recorder bundle, and
+resolves after recovery.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu.core.metrics import WindowedCounter, WindowedHistogram
+from mmlspark_tpu.core.slo import (
+    SLO, AlertEvent, BurnRateRule, SLOMonitor, default_rules,
+)
+from mmlspark_tpu.core.flightrecorder import FlightRecorder
+from mmlspark_tpu.core.trace import Tracer
+from mmlspark_tpu.serving.server import serve_model
+from mmlspark_tpu.stages.basic import Lambda
+
+from test_observability import validate_prom_text
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------------------
+# windowed primitives
+# ---------------------------------------------------------------------------
+
+
+class TestWindowedCounter:
+    def test_windowed_totals(self):
+        clock = _FakeClock(100.0)
+        c = WindowedCounter(bucket_s=1.0, horizon_s=10.0, clock=clock)
+        c.inc(2)
+        clock.advance(3)
+        c.inc(5)
+        assert c.total(1) == 5            # current bucket only
+        assert c.total(10) == 7
+        assert c.cumulative == 7
+        clock.advance(8)                  # first bucket ages out of 10s
+        assert c.total(10) == 5
+        assert c.rate(10) == pytest.approx(0.5)
+        assert c.cumulative == 7          # cumulative never decays
+
+    def test_bucket_expires_exactly_once_on_wrap(self):
+        clock = _FakeClock(0.0)
+        c = WindowedCounter(bucket_s=1.0, horizon_s=4.0, clock=clock)
+        c.inc(3)                          # epoch 0
+        clock.advance(c.n_slots * 1.0)    # same SLOT, new epoch
+        c.inc(1)
+        assert c.total(1) == 1, "stale slot must rezero, not add"
+        assert c.cumulative == 4
+
+    def test_series_oldest_first_with_gaps(self):
+        clock = _FakeClock(50.0)
+        c = WindowedCounter(bucket_s=1.0, horizon_s=10.0, clock=clock)
+        c.inc(1)
+        clock.advance(2)
+        c.inc(4)
+        series = c.series(4)
+        assert [v for _, v in series] == [0.0, 1.0, 0.0, 4.0]
+        assert series[0][0] < series[-1][0]
+
+    def test_hammer_no_lost_updates_under_rotation(self):
+        """8 threads inc through a real clock with 2ms buckets — many
+        rotations happen mid-run; the cumulative count and the
+        full-horizon windowed total must both be exact."""
+        c = WindowedCounter(bucket_s=0.002, horizon_s=60.0)
+        n_threads, n_incs = 8, 4000
+
+        def work(_t):
+            for _ in range(n_incs):
+                c.inc()
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.cumulative == n_threads * n_incs
+        assert c.total(60.0) == n_threads * n_incs
+
+
+class TestWindowedHistogram:
+    def test_windowed_snapshot_and_percentile(self):
+        clock = _FakeClock(100.0)
+        h = WindowedHistogram(bucket_s=1.0, horizon_s=20.0, clock=clock)
+        h.observe(10.0)
+        clock.advance(5)
+        for _ in range(99):
+            h.observe(1.0)
+        h.observe(400.0)
+        snap = h.snapshot(3)
+        assert snap["count"] == 100       # the old 10.0 aged out of 3s
+        assert snap["max"] == 400.0
+        assert h.percentile(50, 3) <= 2.0
+        assert h.percentile(99.9, 3) >= 100.0
+        full = h.snapshot(20)
+        assert full["count"] == 101
+        # prometheus-compatible shape
+        assert sum(snap["counts"]) == snap["count"]
+        assert len(snap["bounds"]) == len(snap["counts"])
+
+    def test_bucket_expires_exactly_once_on_wrap(self):
+        clock = _FakeClock(0.0)
+        h = WindowedHistogram(bucket_s=1.0, horizon_s=3.0, clock=clock)
+        h.observe(5.0)
+        clock.advance(h.n_slots * 1.0)
+        h.observe(7.0)
+        snap = h.snapshot(1)
+        assert snap["count"] == 1 and snap["sum"] == 7.0
+
+    def test_hammer_no_lost_updates(self):
+        h = WindowedHistogram(bucket_s=0.002, horizon_s=60.0)
+        n_threads, n_obs = 8, 3000
+        stop = threading.Event()
+        bad = []
+
+        def reader():
+            while not stop.is_set():
+                snap = h.snapshot(60.0)
+                if sum(snap["counts"]) != snap["count"]:
+                    bad.append(snap)
+
+        rt = threading.Thread(target=reader)
+        rt.start()
+
+        def work(seed):
+            for i in range(n_obs):
+                h.observe(float((i + seed) % 13))
+
+        threads = [threading.Thread(target=work, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        rt.join()
+        assert not bad, f"torn snapshots: {bad[:2]}"
+        snap = h.snapshot(60.0)
+        total = n_threads * n_obs
+        assert snap["count"] == total
+        expected = sum(float((i + s) % 13) for s in range(n_threads)
+                       for i in range(n_obs))
+        assert snap["sum"] == expected   # small ints: f64-exact
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math (golden values)
+# ---------------------------------------------------------------------------
+
+
+def _monitor(clock, min_events=4, label_cap=16):
+    """Availability 99.9% with the workbook fast/slow rules scaled to
+    test-sized windows: fast 14.4x over 60s/10s, slow 6x over 60s/30s."""
+    return SLOMonitor(
+        slos=[SLO("availability", target=0.999)],
+        rules=[BurnRateRule("fast_burn", 60.0, 10.0, 14.4,
+                            min_events=min_events),
+               BurnRateRule("slow_burn", 60.0, 30.0, 6.0,
+                            min_events=min_events)],
+        windows=(10.0, 60.0), label_cap=label_cap,
+        bucket_s=1.0, hist_bucket_s=1.0, horizon_s=120.0, clock=clock)
+
+
+class TestBurnRateGolden:
+    def test_fast_burn_fires_at_14_4x(self):
+        """Golden value: a 2% error rate against a 0.1% budget is a
+        20x burn — over threshold in BOTH windows — and the measured
+        burn rate is exactly errors/total/budget."""
+        clock = _FakeClock()
+        mon = _monitor(clock)
+        for i in range(1000):             # 2% errors, spread over 8s
+            mon.record(i % 50 != 0, 10.0)
+            if i % 125 == 124:
+                clock.advance(1)
+        fired = mon.evaluate()
+        names = [a.name for a in fired]
+        assert "availability:fast_burn" in names
+        alert = next(a for a in fired
+                     if a.name == "availability:fast_burn")
+        assert alert.burn_short == pytest.approx(20.0, rel=0.05)
+        assert alert.burn_long == pytest.approx(20.0, rel=0.05)
+        assert mon.degraded
+
+    def test_quiet_on_slow_noise(self):
+        """0.5% errors = 5x burn: below the 14.4x fast gate AND below
+        the 6x slow gate — no alert, not degraded."""
+        clock = _FakeClock()
+        mon = _monitor(clock)
+        for i in range(2000):             # 0.5% errors
+            mon.record(i % 200 != 0, 10.0)
+            if i % 250 == 249:
+                clock.advance(1)
+        assert mon.evaluate() == []
+        assert not mon.degraded
+        assert mon.burn_rate(mon.slos[0], 10.0) == pytest.approx(
+            5.0, rel=0.1)
+
+    def test_min_events_guard(self):
+        """One error at tiny traffic is a huge burn RATE but must not
+        page: min_events gates the blip."""
+        clock = _FakeClock()
+        mon = _monitor(clock, min_events=4)
+        mon.record(False, 10.0)
+        mon.record(True, 10.0)
+        assert mon.evaluate() == []
+        assert mon.burn_rate(mon.slos[0], 10.0) > 100
+
+    def test_resolves_when_window_drains(self):
+        clock = _FakeClock()
+        mon = _monitor(clock)
+        events = []
+        mon.record_event = events.append
+        for i in range(200):              # 10% errors — hard burn
+            mon.record(i % 10 != 0, 10.0)
+        assert mon.evaluate(), "burn did not fire"
+        assert mon.degraded
+        # recovery: the error events age out of the short window
+        clock.advance(11)
+        for _ in range(50):
+            mon.record(True, 10.0)
+        mon.evaluate()
+        assert not any(a.name == "availability:fast_burn"
+                       for a in mon.alerts.active())
+        kinds = [e.kind for e in events
+                 if isinstance(e, AlertEvent)]
+        assert "alert_fired" in kinds and "alert_resolved" in kinds
+        stats = mon.alerts.stats()
+        assert stats["fired_total"] >= 1
+        assert stats["resolved_total"] >= 1
+
+    def test_no_refire_while_active(self):
+        clock = _FakeClock()
+        mon = _monitor(clock)
+        for i in range(200):
+            mon.record(i % 5 != 0, 10.0)
+        assert mon.evaluate()
+        fired_total = mon.alerts.stats()["fired_total"]
+        for i in range(100):              # still burning
+            mon.record(i % 5 != 0, 10.0)
+        assert mon.evaluate() == []       # same identity: no re-fire
+        assert mon.alerts.stats()["fired_total"] == fired_total
+
+    def test_latency_slo_slow_requests_spend_budget(self):
+        clock = _FakeClock()
+        mon = SLOMonitor(
+            slos=[SLO("latency_p99", "latency", target=0.99,
+                      latency_threshold_ms=100.0)],
+            rules=[BurnRateRule("fast_burn", 60.0, 10.0, 14.4,
+                                min_events=4)],
+            windows=(10.0, 60.0), bucket_s=1.0, hist_bucket_s=1.0,
+            horizon_s=120.0, clock=clock)
+        for i in range(500):              # 20% slow vs 1% budget = 20x
+            mon.record(True, 500.0 if i % 5 == 0 else 10.0)
+        fired = mon.evaluate()
+        assert [a.name for a in fired] == ["latency_p99:fast_burn"]
+        assert fired[0].burn_short == pytest.approx(20.0, rel=0.05)
+
+    def test_per_model_streams_capped_and_alert_named(self):
+        clock = _FakeClock()
+        mon = _monitor(clock, label_cap=2)
+        for m in ("m0", "m1", "m2", "m3"):
+            for i in range(100):
+                # m1 burns; engine-level stream untouched
+                mon.record(not (m == "m1" and i % 5 == 0), 10.0,
+                           model=m, include_engine=False)
+        labels = mon.model_labels()
+        assert len(labels) <= 3           # 2 named + _other
+        assert "_other" in labels
+        fired = mon.evaluate()
+        assert any(a.name == "availability:fast_burn:m1"
+                   for a in fired)
+        # the engine-level stream saw nothing
+        assert mon.error_rate(60.0) == 0.0
+
+    def test_default_rules_are_the_workbook_pair(self):
+        rules = {r.name: r for r in default_rules()}
+        assert rules["fast_burn"].factor == 14.4
+        assert rules["fast_burn"].short_window_s == 300.0
+        assert rules["slow_burn"].factor == 6.0
+
+    def test_horizon_clamp_copies_rules_not_mutates(self):
+        """Review regression: clamping rules to the monitor horizon
+        must not mutate the caller's (possibly shared) rule objects —
+        a second monitor sizing its horizon FROM the same rules must
+        still see the full 6h window."""
+        rule = BurnRateRule("slow_burn", 21600.0, 1800.0, 6.0)
+        mon = SLOMonitor(rules=[rule], horizon_s=3600.0)
+        assert mon.rules[0].long_window_s == 3600.0   # clamped copy
+        assert rule.long_window_s == 21600.0          # caller untouched
+        mon2 = SLOMonitor(rules=[rule], horizon_s=None)
+        assert mon2.horizon_s == 21600.0
+        assert mon2.rules[0].long_window_s == 21600.0
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_bundle_is_self_contained_and_json_safe(self):
+        clock = _FakeClock()
+        mon = _monitor(clock)
+        mon.record(False, 50.0)
+        tracer = Tracer(enabled=True)
+        tr = tracer.new_trace("request")
+        tr.root.error("boom")
+        tracer.finish(tr)
+        rec = FlightRecorder(min_interval_s=0.0)
+        try:
+            rec.attach_tracer(tracer, label="engine test")
+            rec.attach_slo("engine", mon)
+            demo_event = type(
+                "DemoEvent", (),
+                {"kind": "demo", "at": 1.0,
+                 "__repr__": lambda s: "DemoEvent(demo)"})()
+            rec.add_event_source("events", lambda: [demo_event])
+            rec.add_stats_source("engine", lambda: {"qps": 10})
+            bundle = rec.dump_bundle("unit")
+            text = json.dumps(bundle)     # fully JSON-safe
+            assert "boom" in text
+            assert bundle["slo"]["engine"]["status"]["degraded"] \
+                is False
+            assert bundle["slo"]["engine"]["series"]["errors"]
+            assert bundle["stats"]["engine"] == {"qps": 10}
+            events = bundle["traces"]["traceEvents"]
+            assert any(e.get("ph") == "M" for e in events)
+        finally:
+            rec.close()
+
+    def test_trigger_rate_limited_and_async(self):
+        clock = _FakeClock()
+        rec = FlightRecorder(min_interval_s=30.0, clock=clock)
+        try:
+            t1 = rec.trigger("one")
+            assert t1 is not None       # capture scheduled (a thread)
+            assert rec.trigger("two") is None        # suppressed
+            clock.advance(31)
+            t3 = rec.trigger("three")
+            assert t3 is not None
+            # captures run OFF the triggering thread (the breaker-trip
+            # / SLO-tick hot paths); join to observe the results
+            t1.join(timeout=10)
+            t3.join(timeout=10)
+            stats = rec.stats()
+            assert stats["triggers_seen"] == 3
+            assert stats["triggers_captured"] == 2
+            assert stats["triggers_rate_limited"] == 1
+            assert len(rec.bundles) == 2
+            assert [b["reason"] for b in rec.bundles] == ["one", "three"]
+        finally:
+            rec.close()
+
+    def test_log_ring_bounded_and_captured(self):
+        from mmlspark_tpu.core.logging_utils import get_logger
+        rec = FlightRecorder(min_interval_s=0.0, log_capacity=32)
+        try:
+            logger = get_logger("slo-test")
+            for i in range(100):
+                logger.warning("chaos event %d", i)
+            bundle = rec.dump_bundle("logs")
+            msgs = [r["msg"] for r in bundle["logs"]]
+            assert len(msgs) <= 32
+            assert "chaos event 99" in msgs
+            assert "chaos event 0" not in msgs       # bounded ring
+        finally:
+            rec.close()
+
+    def test_circuit_on_open_fires_only_on_closed_to_open(self):
+        """Review regression: a sustained outage re-trips the breaker
+        from HALF_OPEN every cooldown; firing on_open each time would
+        churn the recorder's bounded bundle deque until the ORIGINAL
+        incident's bundle is evicted. Only closed->open fires."""
+        from mmlspark_tpu.utils.resilience import CircuitBreaker
+        opened = []
+        clock = _FakeClock()
+        b = CircuitBreaker(failure_threshold=1, cooldown=5.0,
+                           clock=clock)
+        b.on_open = opened.append
+        b.record_failure()                 # CLOSED -> OPEN
+        assert len(opened) == 1
+        clock.advance(6)                   # cooldown elapses
+        assert b.allow()                   # HALF_OPEN probe admitted
+        b.record_failure()                 # probe fails: re-trip
+        assert b.state == CircuitBreaker.OPEN
+        assert len(opened) == 1, "half-open re-trip must not re-fire"
+
+    def test_detach_by_prefix(self):
+        rec = FlightRecorder(min_interval_s=0.0)
+        try:
+            rec.add_stats_source("engine@a", lambda: 1)
+            rec.add_stats_source("engine@a:swap_events", lambda: 2)
+            rec.add_stats_source("engine@b", lambda: 3)
+            # review regression: one address being a string-prefix of
+            # another (port 1890 vs 18900) must NOT cross-detach
+            rec.add_stats_source("engine@http://h:1890", lambda: 4)
+            rec.add_stats_source("engine@http://h:18900", lambda: 5)
+            rec.detach("engine@a")
+            rec.detach("engine@http://h:1890")
+            assert sorted(rec.dump_bundle("x")["stats"]) == [
+                "engine@b", "engine@http://h:18900"]
+        finally:
+            rec.close()
+
+    def test_shared_monitor_rewires_to_second_engines_recorder(self):
+        """Review regression: engine.stop() must uninstall the
+        slo.on_fire hook it installed, so a shared SLOMonitor reused
+        by a later engine routes breach bundles to THAT engine's
+        recorder — not the stopped one's."""
+        def echo(table):
+            return table.with_column("reply",
+                                     [b"ok" for _ in table["id"]])
+        mon_args = dict(
+            slos=[SLO("availability", target=0.999)],
+            rules=[BurnRateRule("fast_burn", 8.0, 2.0, 14.4,
+                                min_events=1)],
+            windows=(2.0, 8.0), horizon_s=30.0)
+        mon = SLOMonitor(**mon_args)
+        rec_a = FlightRecorder(min_interval_s=0.0)
+        rec_b = FlightRecorder(min_interval_s=0.0)
+        try:
+            a = serve_model(Lambda.apply(echo), port=19670,
+                            batch_size=4, tracing=False, slo=mon,
+                            flight_recorder=rec_a)
+            assert mon.on_fire is not None
+            a.stop()
+            assert mon.on_fire is None, \
+                "stop() must uninstall the hook it installed"
+            b = serve_model(Lambda.apply(echo), port=19672,
+                            batch_size=4, tracing=False, slo=mon,
+                            flight_recorder=rec_b)
+            try:
+                for _ in range(5):
+                    mon.record(False, 10.0)
+                mon.evaluate()
+                deadline = time.monotonic() + 5
+                while not rec_b.bundles and \
+                        time.monotonic() < deadline:
+                    time.sleep(0.05)
+                assert rec_b.stats()["triggers_captured"] >= 1, \
+                    "breach must reach the SECOND engine's recorder"
+                assert rec_a.stats()["triggers_captured"] == 0, \
+                    "stopped engine's recorder must see nothing"
+            finally:
+                b.stop()
+        finally:
+            rec_a.close()
+            rec_b.close()
+
+    def test_engine_stop_releases_every_recorder_hook(self):
+        """Review regression: a stopped engine must leave NOTHING on a
+        (process-lived) recorder — tracer attachment included — or a
+        long-lived process accumulates dead engines' closures and
+        dump_bundle keeps exporting their buffers forever."""
+        def echo(table):
+            return table.with_column("reply",
+                                     [b"ok" for _ in table["id"]])
+        rec = FlightRecorder(min_interval_s=0.0)
+        try:
+            engine = serve_model(Lambda.apply(echo), port=19660,
+                                 batch_size=4,
+                                 tracer=Tracer(enabled=True),
+                                 flight_recorder=rec)
+            stats = rec.stats()
+            assert stats["tracers"] == 1
+            assert stats["slos"] and stats["event_sources"]
+            engine.stop()
+            stats = rec.stats()
+            assert stats["tracers"] == 0, stats
+            assert stats["slos"] == [] and stats["event_sources"] == []
+            assert rec.dump_bundle("post-stop")["stats"] == {}
+        finally:
+            rec.close()
+
+
+# ---------------------------------------------------------------------------
+# tools/check_metrics.py — the static exposition audit
+# ---------------------------------------------------------------------------
+
+
+class TestCheckMetrics:
+    def test_shipped_expositions_clean(self):
+        from tools.check_metrics import main
+        assert main() == 0
+
+    def test_catches_bad_counter_suffix(self):
+        from tools.check_metrics import audit_source
+        out = audit_source('r.counter("requests_count", "help", 1)')
+        assert any("_total" in v.message for v in out)
+
+    def test_catches_missing_help(self):
+        from tools.check_metrics import audit_source
+        out = audit_source('r.gauge("depth", "", 1)')
+        assert any("HELP" in v.message for v in out)
+
+    def test_catches_bad_histogram_suffix(self):
+        from tools.check_metrics import audit_source
+        out = audit_source('r.histogram("latency", "help", h)')
+        assert any("unit suffix" in v.message for v in out)
+
+    def test_catches_uncapped_model_label(self):
+        from tools.check_metrics import audit_source
+        out = audit_source(
+            'r.gauge("per_model_qps", "help", 1, {"model": m})')
+        assert any("CAPPED_FAMILIES" in v.message for v in out)
+
+    def test_catches_undeclared_dynamic_name(self):
+        from tools.check_metrics import audit_source
+        out = audit_source('r.counter(f"x_{n}_total", "help", 1)')
+        assert any("DYNAMIC_OK" in v.message for v in out)
+
+    def test_capped_family_passes(self):
+        from tools.check_metrics import audit_source
+        assert audit_source(
+            'r.histogram("serving_model_latency_ms", "help", h, '
+            '{"model": m})') == []
+
+
+# ---------------------------------------------------------------------------
+# debug endpoints: strict query validation (satellite)
+# ---------------------------------------------------------------------------
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestDebugEndpointValidation:
+    @pytest.fixture()
+    def engine(self):
+        def echo(table):
+            return table.with_column("reply",
+                                     [b"ok" for _ in table["id"]])
+        rec = FlightRecorder(min_interval_s=0.0)
+        engine = serve_model(Lambda.apply(echo), port=19620,
+                             batch_size=4, tracer=Tracer(enabled=True),
+                             flight_recorder=rec)
+        yield engine
+        engine.stop()
+        rec.close()
+
+    @pytest.mark.parametrize("query", ["limit=abc", "limit=-1",
+                                       "limit=1.5", "limit="])
+    def test_bad_limit_is_400_not_500(self, engine, query):
+        for path in ("/debug/traces", "/debug/bundle"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _get(f"{engine.source.address}{path}?{query}&confirm=1")
+            assert exc.value.code == 400, \
+                f"{path}?{query} -> {exc.value.code}"
+            body = json.loads(exc.value.read())
+            assert "limit" in body["error"]
+
+    def test_bundle_requires_confirm(self, engine):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(engine.source.address + "/debug/bundle")
+        assert exc.value.code == 400
+        assert "confirm" in json.loads(exc.value.read())["error"]
+        status, bundle = _get(
+            engine.source.address + "/debug/bundle?confirm=1&limit=5")
+        assert status == 200
+        assert bundle["bundle_version"] == 1
+        assert "traces" in bundle and "slo" in bundle
+
+    def test_good_limit_still_works(self, engine):
+        status, payload = _get(
+            engine.source.address + "/debug/traces?limit=2")
+        assert status == 200
+        assert "traceEvents" in payload
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end chaos acceptance
+# ---------------------------------------------------------------------------
+
+
+class TestChaosSLOEndToEnd:
+    def test_error_spike_degrades_alerts_bundles_and_resolves(self):
+        """The acceptance bar: an injected error-rate spike on one
+        engine flips /healthz to degraded with a NAMED active
+        burn-rate alert, emits serving_slo_* families that pass the
+        text-format grammar validator, auto-captures a flight-recorder
+        bundle containing the offending traces + the alert + the
+        windowed series — and the alert RESOLVES after recovery."""
+        def good(table):
+            return table.with_column(
+                "reply", [b"ok" for _ in table["id"]])
+
+        def bad(table):
+            raise RuntimeError("injected chaos: engine poisoned")
+
+        # test-sized windows: fast burn over 8s/2s, quarter-second
+        # buckets, so the whole fire->resolve cycle fits in seconds
+        mon = SLOMonitor(
+            slos=[SLO("availability", target=0.999)],
+            rules=[BurnRateRule("fast_burn", 8.0, 2.0, 14.4,
+                                min_events=3)],
+            windows=(2.0, 8.0), bucket_s=0.25, hist_bucket_s=0.5,
+            horizon_s=30.0)
+        rec = FlightRecorder(min_interval_s=0.0)
+        tracer = Tracer(enabled=True)
+        engine = serve_model(Lambda.apply(good), port=19640,
+                             batch_size=4, max_wait_ms=2.0,
+                             tracer=tracer, slo=mon,
+                             flight_recorder=rec,
+                             slo_eval_interval_s=0.1)
+        addr = engine.source.address
+
+        def post(x):
+            req = urllib.request.Request(
+                addr, data=json.dumps({"x": x}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        try:
+            # phase 1: healthy traffic
+            for i in range(20):
+                assert post(i) == 200
+            status, health = _get(addr + "/healthz")
+            assert health["status"] == "ok"
+            assert health["slo"]["degraded"] is False
+
+            # phase 2: error spike — every request 500s
+            engine.pipeline = Lambda.apply(bad)
+            for i in range(15):
+                assert post(i) == 500
+            deadline = time.monotonic() + 5
+            health = None
+            while time.monotonic() < deadline:
+                _, health = _get(addr + "/healthz")
+                if health["status"] == "degraded":
+                    break
+                time.sleep(0.1)
+            assert health is not None and \
+                health["status"] == "degraded", health
+            active = health["slo"]["active_alerts"]
+            assert any(a["name"] == "availability:fast_burn"
+                       for a in active), active
+            alert = next(a for a in active
+                         if a["name"] == "availability:fast_burn")
+            assert alert["burn_short"] > 14.4
+
+            # /metrics: grammar-valid serving_slo_* families
+            text = urllib.request.urlopen(
+                addr + "/metrics", timeout=5).read().decode()
+            types, samples = validate_prom_text(text)
+            names = {n for n, _l, _v in samples}
+            for required in ("serving_slo_degraded",
+                             "serving_slo_burn_rate",
+                             "serving_slo_error_rate",
+                             "serving_slo_latency_p99_ms",
+                             "serving_slo_target",
+                             "serving_slo_alert_active",
+                             "serving_slo_alerts_fired_total"):
+                assert required in names, f"missing {required}"
+            degraded = next(v for n, _l, v in samples
+                            if n == "serving_slo_degraded")
+            assert degraded == 1
+            active_series = [(l, v) for n, l, v in samples
+                             if n == "serving_slo_alert_active"]
+            assert any(l.get("slo") == "availability"
+                       and l.get("rule") == "fast_burn"
+                       and v == 1 for l, v in active_series)
+
+            # the flight recorder auto-captured the post-mortem
+            # (capture runs on its own daemon thread — poll briefly)
+            assert rec.stats()["triggers_captured"] >= 1
+            cap_deadline = time.monotonic() + 5
+            while not rec.bundles and time.monotonic() < cap_deadline:
+                time.sleep(0.05)
+            assert rec.bundles, "auto-capture never landed"
+            bundle = rec.bundles[-1]
+            assert bundle["reason"].startswith(
+                "slo_breach:availability:fast_burn")
+            # ... containing the offending traces (error roots) ...
+            ev = bundle["traces"]["traceEvents"]
+            assert any(e.get("args", {}).get("status") == "error"
+                       for e in ev), "bundle lost the error traces"
+            # ... the alert ...
+            slo_key = next(iter(bundle["slo"]))
+            st = bundle["slo"][slo_key]["status"]
+            assert any(a["name"] == "availability:fast_burn"
+                       for a in st["active_alerts"])
+            # ... and the windowed series with the error spike (the
+            # bundle snapshots at FIRE time — at least the rule's
+            # min_events errors are already in the series)
+            series = bundle["slo"][slo_key]["series"]
+            assert sum(v for _, v in series["errors"]) >= 3
+            json.dumps(bundle)            # self-contained JSON
+
+            # phase 3: recovery — the short window drains, the alert
+            # resolves, /healthz returns to ok
+            engine.pipeline = Lambda.apply(good)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                assert post(999) == 200
+                _, health = _get(addr + "/healthz")
+                if health["status"] == "ok" and \
+                        not health["slo"]["active_alerts"]:
+                    break
+                time.sleep(0.2)
+            assert health["status"] == "ok", health
+            assert health["slo"]["active_alerts"] == []
+            assert health["slo"]["resolved_total"] >= 1
+            # the registry-style event trail: fired AND resolved both
+            # visible in the alert history
+            hist = mon.alerts.history()
+            assert any(not a.active for a in hist)
+        finally:
+            engine.stop()
+            rec.close()

@@ -1,0 +1,433 @@
+"""Cross-process trace propagation tests: traceparent inject/extract,
+client-leg spans on the fleet client (failover + hedging under ONE
+trace id, losing hedge leg cancelled-not-error), ingress continuation
+as a child span, and the acceptance bar — a fleet request traversing
+retry/hedge across engines in REAL OS processes reassembling into one
+Chrome/perfetto export with per-process labels.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import socket
+import threading
+import time
+
+import pytest
+
+from mmlspark_tpu.core.trace import (
+    TraceContext, Tracer, extract_context, format_traceparent,
+    merge_chrome_traces, parse_traceparent, to_chrome_trace, use_span,
+)
+from mmlspark_tpu.serving.fleet import ServingFleet
+from mmlspark_tpu.serving.server import serve_model
+from mmlspark_tpu.stages.basic import Lambda
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _sleepy_scorer():
+    def handle(table):
+        rows = [json.loads(r["entity"].decode())
+                for r in table["request"]]
+        out = []
+        for r in rows:
+            if r.get("sleep"):
+                time.sleep(float(r["sleep"]))
+            out.append({"y": r["x"] * 2})
+        return table.with_column("reply", out)
+    return Lambda.apply(handle)
+
+
+# ---------------------------------------------------------------------------
+# header format
+# ---------------------------------------------------------------------------
+
+
+class TestTraceparent:
+    def test_format_parse_round_trip(self):
+        hdr = format_traceparent("abcd1234", "ef567890")
+        assert hdr == "00-abcd1234-ef567890-01"
+        ctx = parse_traceparent(hdr)
+        assert ctx.trace_id == "abcd1234"
+        assert ctx.parent_id == "ef567890"
+        assert ctx.sampled is True
+        assert parse_traceparent(
+            format_traceparent("ab", "cd",
+                               sampled=False)).sampled is False
+
+    def test_legacy_trace_id_with_dashes_survives(self):
+        # legacy X-Trace-Id values may carry dashes; when such a trace
+        # id rides a traceparent, the span id + flags still anchor
+        # from the right
+        hdr = format_traceparent("my-trace-1", "abc123")
+        ctx = parse_traceparent(hdr)
+        assert ctx.trace_id == "my-trace-1"
+        assert ctx.parent_id == "abc123"
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-onlythree-01",
+        "zz-abc-def-01",              # non-hex version
+        "00-abc-nothex!-01",          # non-hex span id
+        "00-abc-def-zz",              # non-hex flags
+        "00-" + "x" * 70 + "-def-01",  # oversized trace id
+        "00-0000-def-01",             # all-zero trace id
+    ])
+    def test_malformed_is_none(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_extract_precedence_and_legacy_alias(self):
+        # traceparent wins over the legacy header
+        ctx = extract_context({
+            "Traceparent": "00-tid1-def1-01",
+            "X-Trace-Id": "legacy-id"})
+        assert ctx.trace_id == "tid1" and ctx.parent_id == "def1"
+        # legacy alone: id-only context (no remote parent)
+        ctx = extract_context({"x-trace-id": "legacy-id"})
+        assert ctx.trace_id == "legacy-id"
+        assert ctx.parent_id is None
+        assert extract_context({}) is None
+        assert extract_context(None) is None
+
+    def test_tracer_inject_extract_round_trip(self):
+        tracer = Tracer(enabled=True)
+        tr = tracer.new_trace("fleet.post")
+        leg = tracer.start_span("client.post", tr)
+        headers = tracer.inject(leg)
+        ctx = Tracer.extract(headers)
+        assert ctx.trace_id == tr.trace_id
+        assert ctx.parent_id == leg.span_id
+        # the legacy alias rides along for old engines
+        assert headers["X-Trace-Id"] == tr.trace_id
+
+    def test_continue_trace_parents_root(self):
+        tracer = Tracer(enabled=True)
+        ctx = TraceContext("tidX", "cafe01")
+        tr = tracer.continue_trace("request", ctx)
+        assert tr.trace_id == "tidX"
+        assert tr.root.parent_id == "cafe01"
+        fresh = tracer.continue_trace("request", None)
+        assert fresh.root.parent_id is None
+
+
+# ---------------------------------------------------------------------------
+# Chrome export: process labels + merge
+# ---------------------------------------------------------------------------
+
+
+class TestChromeMerge:
+    def test_process_name_metadata(self):
+        tracer = Tracer(enabled=True)
+        tr = tracer.new_trace("request")
+        tracer.finish(tr)
+        payload = to_chrome_trace(tracer.buffer.traces(),
+                                  process_name="engine X pid=1")
+        metas = [e for e in payload["traceEvents"] if e["ph"] == "M"]
+        assert metas and metas[0]["name"] == "process_name"
+        assert metas[0]["args"]["name"] == "engine X pid=1"
+        assert payload["otherData"]["pid"] == os.getpid()
+
+    def test_merge_dedups_spans_and_keeps_processes(self):
+        tracer = Tracer(enabled=True)
+        tr = tracer.new_trace("request")
+        tracer.finish(tr)
+        a = to_chrome_trace(tracer.buffer.traces(), process_name="A")
+        b = to_chrome_trace(tracer.buffer.traces(), process_name="B")
+        # fake a second process for b
+        for ev in b["traceEvents"]:
+            ev["pid"] = 99999
+        b["otherData"]["pid"] = 99999
+        merged = merge_chrome_traces(a, b)
+        xs = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 2           # same span, two pids — both kept
+        # merging the SAME payload twice dedups
+        merged2 = merge_chrome_traces(a, a)
+        xs2 = [e for e in merged2["traceEvents"] if e["ph"] == "X"]
+        assert len(xs2) == 1
+        metas = [e for e in merged["traceEvents"] if e["ph"] == "M"]
+        assert len(metas) == 2
+        assert str(os.getpid()) in merged["otherData"]["epochs"]
+
+
+# ---------------------------------------------------------------------------
+# fleet client legs (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestClientLegSpans:
+    def test_embedder_span_continues_into_fleet_post(self):
+        tracer = Tracer(enabled=True)
+        fleet = ServingFleet(_sleepy_scorer(), n_engines=1,
+                             base_port=19560, batch_size=4,
+                             tracer=tracer, slo=False,
+                             flight_recorder=False)
+        try:
+            outer = tracer.new_trace("embedder.op")
+            with use_span(outer.root):
+                fleet.post({"x": 1}, timeout=10)
+            tracer.finish(outer)
+            time.sleep(0.2)
+            posts = [t for t in tracer.buffer.traces()
+                     if t.root.name == "fleet.post"]
+            assert posts
+            assert posts[-1].trace_id == outer.trace_id
+            assert posts[-1].root.parent_id == outer.root.span_id
+        finally:
+            fleet.stop_all()
+
+    def test_hedged_legs_share_trace_and_loser_cancelled(self):
+        """Satellite regression: ALL legs of one logical fleet.post
+        share one trace id; the losing hedge leg is marked
+        ``cancelled=true`` and NOT ``error`` (the shed-vs-error
+        discipline applied to client spans)."""
+        tracer = Tracer(enabled=True)
+        fleet = ServingFleet(_sleepy_scorer(), n_engines=2,
+                             base_port=19570, batch_size=4,
+                             tracer=tracer, hedge_percentile=50,
+                             hedge_min_s=0.05, slo=False,
+                             flight_recorder=False)
+        try:
+            for i in range(20):       # establish the hedge threshold
+                fleet.post({"x": i}, timeout=10)
+            hedges0 = fleet.hedged_requests
+            body = fleet.post({"x": 3, "sleep": 0.6}, timeout=15)
+            assert body == {"y": 6}
+            assert fleet.hedged_requests == hedges0 + 1
+            time.sleep(0.8)           # let the losing leg's server
+            #                           batch finish + buffer
+            posts = [t for t in tracer.buffer.traces()
+                     if t.root.name == "fleet.post"]
+            hedged = [t for t in posts
+                      if len([s for s in t.spans()
+                              if s.name == "client.post"]) >= 2]
+            assert hedged, "no hedged fleet.post trace buffered"
+            tr = hedged[-1]
+            legs = [s for s in tr.spans() if s.name == "client.post"]
+            assert len(legs) == 2
+            # one trace id across every leg (and the root)
+            assert {s.trace_id for s in legs} == {tr.trace_id}
+            # every leg is a SIBLING under the post root
+            assert {s.parent_id for s in legs} == {tr.root.span_id}
+            winners = [s for s in legs if not s.attrs.get("cancelled")]
+            losers = [s for s in legs if s.attrs.get("cancelled")]
+            assert len(winners) == 1 and len(losers) == 1
+            assert losers[0].status != "error", \
+                "losing hedge leg must be cancelled, not error"
+            assert losers[0].attrs["cancelled"] is True
+            # server-side request traces CONTINUE the same trace id,
+            # parented on the client legs
+            leg_ids = {s.span_id for s in legs}
+            server = [t for t in tracer.buffer.traces()
+                      if t.root.name == "request"
+                      and t.trace_id == tr.trace_id]
+            assert len(server) >= 1
+            for st in server:
+                assert st.root.parent_id in leg_ids
+                assert st.root.attrs.get("remote_parent") is True
+        finally:
+            fleet.stop_all()
+
+    def test_quota_429_is_shed_not_error_on_client_trace(self):
+        """Review regression: a tenant-quota 429 is EXPECTED
+        back-pressure — the client's fleet.post trace root must be
+        shed=true, not error, or a hot tenant's 429 storm floods the
+        client tracer's protected tail ring (the server-side
+        shed-vs-error discipline, mirrored client-side)."""
+        import urllib.error
+        from mmlspark_tpu.serving.admission import (
+            AdmissionController, TenantQuota,
+        )
+        from mmlspark_tpu.serving.zoo import ModelZoo
+        tracer = Tracer(enabled=True)
+        admission = AdmissionController(
+            quotas={"greedy": TenantQuota(0.001, burst=1)})
+        zoo = ModelZoo(memory_probe=None)
+        zoo.register_factory("m", "v1", _sleepy_scorer)
+        fleet = ServingFleet(n_engines=1, base_port=19590,
+                             batch_size=4, tracer=tracer,
+                             zoo=zoo, admission=admission,
+                             slo=False, flight_recorder=False)
+        try:
+            fleet.post({"x": 1}, model="m@v1", tenant="greedy",
+                       timeout=10)            # spends the only token
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                fleet.post({"x": 2}, model="m@v1", tenant="greedy",
+                           timeout=10)
+            assert exc.value.code == 429
+            time.sleep(0.2)
+            posts = [t for t in tracer.buffer.traces()
+                     if t.root.name == "fleet.post"
+                     and t.root.attrs.get("http_status") == 429]
+            assert posts, "429 fleet.post trace not buffered"
+            assert posts[-1].root.attrs.get("shed") is True
+            assert not posts[-1].is_error, \
+                "quota 429 must be shed, not error"
+        finally:
+            fleet.stop_all()
+            zoo.close()
+
+    def test_failover_legs_share_trace_id(self):
+        """A leg that fails at transport level and the replica that
+        rescues it are siblings in ONE trace (the failed leg errored,
+        the rescue leg ok)."""
+        engine = serve_model(_sleepy_scorer(), port=19580,
+                             batch_size=4, tracing=False, slo=False,
+                             flight_recorder=False)
+        dead = f"http://127.0.0.1:{_free_port()}"
+        tracer = Tracer(enabled=True)
+        fleet = ServingFleet.connect(
+            [dead, engine.source.address], tracer=tracer,
+            failure_threshold=1000)   # dead stays in rotation
+        try:
+            # round-robin: find the post whose FIRST candidate is the
+            # dead address (start index advances by one per post)
+            for _ in range(4):
+                body = fleet.post({"x": 5}, timeout=10)
+                assert body == {"y": 10}
+            posts = [t for t in tracer.buffer.traces()
+                     if t.root.name == "fleet.post"]
+            multi = [t for t in posts
+                     if len([s for s in t.spans()
+                             if s.name == "client.post"]) == 2]
+            assert multi, "no failover post captured"
+            legs = [s for s in multi[-1].spans()
+                    if s.name == "client.post"]
+            assert {s.trace_id for s in legs} == {multi[-1].trace_id}
+            statuses = sorted(s.status for s in legs)
+            assert statuses == ["error", "ok"]
+            assert multi[-1].root.attrs.get("failovers") == 1
+        finally:
+            fleet.stop_all()
+            engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: real OS processes, one reassembled trace
+# ---------------------------------------------------------------------------
+
+
+def test_cross_process_retry_hedge_one_trace(tmp_path):
+    """One logical ``fleet.post`` traversing a transport-level retry
+    (dead address) AND a hedge across TWO live engine processes
+    reassembles into ONE trace: shared trace id, client legs as
+    siblings, each process's server span parented on its leg — proven
+    from the engines' EXPORTED buffers, merged into a single
+    perfetto-loadable payload with per-process labels."""
+    worker = os.path.join(os.path.dirname(__file__), "traced_worker.py")
+    procs, addrs, pids, dumps = [], {}, {}, {}
+    try:
+        for wid in range(2):
+            dump = str(tmp_path / f"worker{wid}.json")
+            p = subprocess.Popen(
+                [sys.executable, worker, str(_free_port()), str(wid),
+                 dump],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            procs.append(p)
+            line = p.stdout.readline().strip()   # blocks until READY
+            tag, wid_s, addr, pid_s = line.split()
+            assert tag == "READY" and int(wid_s) == wid, line
+            addrs[wid], pids[wid], dumps[wid] = addr, int(pid_s), dump
+
+        dead = f"http://127.0.0.1:{_free_port()}"
+        tracer = Tracer(enabled=True)
+        fleet = ServingFleet.connect(
+            [dead, addrs[0], addrs[1]], tracer=tracer,
+            failure_threshold=1000,   # the dead leg stays in rotation
+            hedge_percentile=50, hedge_min_s=0.05)
+
+        # establish the hedge latency threshold with fast traffic
+        for i in range(20):
+            body = fleet.post({"x": i}, timeout=15)
+            assert body["echo"] == i
+
+        # now the target request: stall worker 0 so its leg hedges to
+        # worker 1; issue a few so at least one post's round-robin
+        # order starts at the dead address (retry) AND routes its
+        # failover leg to the stalled worker (hedge)
+        target = None
+        for i in range(9):
+            hedges0 = fleet.hedged_requests
+            fleet.post({"x": 100 + i, "stall_worker": 0,
+                        "stall_s": 0.8}, timeout=20)
+            if fleet.hedged_requests == hedges0:
+                continue
+            time.sleep(0.1)
+            posts = [t for t in tracer.buffer.traces()
+                     if t.root.name == "fleet.post"]
+            for t in posts:
+                legs = [s for s in t.spans() if s.name == "client.post"]
+                if len(legs) >= 3:    # dead + stalled + hedge
+                    target = t
+                    break
+            if target is not None:
+                break
+        assert target is not None, \
+            "no post traversed retry + hedge (3 client legs)"
+        legs = [s for s in target.spans() if s.name == "client.post"]
+        assert {s.trace_id for s in legs} == {target.trace_id}
+        assert {s.parent_id for s in legs} == {target.root.span_id}
+        errored = [s for s in legs if s.status == "error"]
+        cancelled = [s for s in legs if s.attrs.get("cancelled")]
+        assert errored, "the dead-address leg must be errored"
+        assert cancelled, "the losing hedge leg must be cancelled"
+
+        # let the stalled worker finish serving the abandoned leg so
+        # its buffer holds the trace, then shut down + dump (each post
+        # stops whichever worker answers; failover routes around the
+        # already-stopped ones)
+        time.sleep(1.2)
+        for _ in range(2):
+            try:
+                fleet.post({"__shutdown__": True}, timeout=15)
+            except Exception:  # noqa: BLE001 — both may already be down
+                pass
+        for wid, p in enumerate(procs):
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, \
+                f"worker {wid} rc={p.returncode}\n{err}"
+            assert f"DUMPED {wid}" in out, out
+
+        exports = [json.load(open(dumps[wid])) for wid in (0, 1)]
+        client_export = to_chrome_trace(
+            tracer.buffer.traces(),
+            process_name=f"fleet client pid={os.getpid()}")
+        merged = merge_chrome_traces(client_export, *exports)
+
+        tid = target.trace_id
+        events = [e for e in merged["traceEvents"]
+                  if e.get("ph") == "X"
+                  and e.get("args", {}).get("trace_id") == tid]
+        assert events, "merged export lost the target trace"
+        # ≥2 engine processes + the client process on one timeline
+        ev_pids = {e["pid"] for e in events}
+        assert pids[0] in ev_pids and pids[1] in ev_pids, \
+            f"trace must span both engine processes: {ev_pids}"
+        assert os.getpid() in ev_pids
+        assert len(ev_pids) >= 3
+        # per-process labels present for every engine process
+        metas = {e["pid"]: e["args"]["name"]
+                 for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert pids[0] in metas and pids[1] in metas
+        assert "engine" in metas[pids[0]]
+        # server request roots parent onto client leg span ids
+        leg_ids = {s.span_id for s in legs}
+        server_roots = [e for e in events if e["name"] == "request"
+                        and e["pid"] in (pids[0], pids[1])]
+        assert len(server_roots) >= 2, \
+            "both engines' server spans must be in the merged trace"
+        for ev in server_roots:
+            assert ev["args"].get("parent_id") in leg_ids, \
+                "server root must be a child of a client leg"
+        # the whole thing must be JSON-serializable (perfetto-loadable)
+        json.dumps(merged)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
