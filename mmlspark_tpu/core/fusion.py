@@ -61,6 +61,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
 
 from mmlspark_tpu.core import metrics as MC
 from mmlspark_tpu.core.schema import (
@@ -287,6 +288,84 @@ def stage_epoch(stage) -> int:
 
 
 # ---------------------------------------------------------------------------
+# mesh sharding of fused serving programs
+# ---------------------------------------------------------------------------
+
+
+class SegmentSharding:
+    """Explicit mesh placement for fused serving programs (the pjit
+    pattern: ``jit`` with declared ``in_shardings``/``out_shardings``
+    over a named mesh — GSPMD, Xu et al. 2021).
+
+    Pipeline-family programs are **data-sharded**: every environment
+    array (table columns + host Feed outputs) shards its batch dim 0
+    over ``data_axis``, per-stage consts (weights, fills, forests)
+    replicate, and the program's outputs stay batch-sharded until the
+    single D2H fetch gathers them. ``const_specs`` overrides the
+    replicated default per op name with a ``PartitionSpec`` pytree for
+    tables big enough to shard (a ``DeviceTable`` const placement).
+
+    Shardings here are always DECLARED, never inferred — the static
+    audit (tools/check_fusion_kernels.py ``check_sharded_serving``)
+    holds that contract on every sharded jit call site.
+    """
+
+    __slots__ = ("mesh", "data_axis", "const_specs")
+
+    def __init__(self, mesh, data_axis: str = "data",
+                 const_specs: Optional[Dict[str, Any]] = None):
+        self.mesh = mesh
+        self.data_axis = str(data_axis)
+        if self.data_axis not in mesh.shape:
+            raise ValueError(
+                f"mesh has no axis {self.data_axis!r}; axes: "
+                f"{dict(mesh.shape)}")
+        self.const_specs = dict(const_specs or {})
+
+    @property
+    def n_shards(self) -> int:
+        return int(self.mesh.shape[self.data_axis])
+
+    def env_sharding(self) -> NamedSharding:
+        """Batch-dim data sharding (dim 0 over the data axis, all other
+        dims replicated — a pytree-prefix spec for the whole env)."""
+        return NamedSharding(self.mesh, PartitionSpec(self.data_axis))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def const_sharding(self, op_name: str):
+        """The consts placement for one op: an explicit per-op
+        ``PartitionSpec`` (pytree prefix) when configured, else
+        replicated."""
+        spec = self.const_specs.get(op_name)
+        if spec is None:
+            return self.replicated()
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), spec,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def divisible(self, env: Dict[str, Any]) -> bool:
+        """Whether every env array's batch dim divides the data axis —
+        the precondition for the sharded executable. Serving buckets
+        (pow-2 >= MIN_BUCKET) always divide a pow-2 mesh; an arbitrary
+        batch-transform length may not, and falls back to the
+        single-placement jit rather than erroring."""
+        n = self.n_shards
+        for v in env.values():
+            shape = getattr(v, "shape", None)
+            if shape and shape[0] % n:
+                return False
+        return True
+
+    def signature(self) -> Tuple:
+        """Plan-cache key component: same stages + schema on a
+        different mesh/axis must compile separate programs."""
+        return (tuple(sorted(self.mesh.shape.items())), self.data_axis,
+                tuple(sorted(self.const_specs)))
+
+
+# ---------------------------------------------------------------------------
 # DeviceTable — device-resident columns + per-stage consts
 # ---------------------------------------------------------------------------
 
@@ -303,16 +382,30 @@ class DeviceTable:
       mutation (new weights, changed fill) invalidates exactly that
       stage's device constants, nothing else. The previous epoch's
       entry is evicted eagerly so swapped-out weights don't pin HBM.
+
+    With a ``SegmentSharding`` placement, columns/feeds ship straight
+    into their declared mesh sharding (batch-dim over the data axis)
+    and consts into theirs (replicated, or the per-op override) — the
+    H2D transfer lands each buffer where the sharded program wants it,
+    so the compiled call never reshuffles inputs.
     """
 
-    def __init__(self):
+    def __init__(self, placement: Optional[SegmentSharding] = None):
         self._tables: "weakref.WeakKeyDictionary[DataTable, Dict]" = \
             weakref.WeakKeyDictionary()
         self._consts: Dict[str, Tuple[int, Any]] = {}
         self._lock = threading.Lock()
+        self.placement = placement
         self.column_ships = 0     # H2D transfers actually paid
         self.column_hits = 0      # cache hits (no reship)
         self.const_ships = 0
+
+    def _put_column(self, host: np.ndarray) -> jnp.ndarray:
+        p = self.placement
+        if p is not None and np.ndim(host) >= 1 \
+                and host.shape[0] % p.n_shards == 0:
+            return jax.device_put(host, p.env_sharding())
+        return jax.device_put(host)
 
     def column(self, table: DataTable, key: str,
                load: Callable[[DataTable], np.ndarray]) -> jnp.ndarray:
@@ -326,7 +419,7 @@ class DeviceTable:
                 self.column_hits += 1
                 return arr
         host = load(table)
-        dev = jax.device_put(host)
+        dev = self._put_column(host)
         with self._lock:
             per[key] = dev
             self.column_ships += 1
@@ -340,12 +433,42 @@ class DeviceTable:
             hit = self._consts.get(key)
             if hit is not None and hit[0] == epoch:
                 return hit[1]
-        dev = jax.tree_util.tree_map(
-            lambda a: jax.device_put(jnp.asarray(a)), op.make_consts())
+        if self.placement is not None:
+            sh = self.placement.const_sharding(op.name)
+            if isinstance(sh, NamedSharding):
+                dev = jax.tree_util.tree_map(
+                    lambda a, _s=sh: jax.device_put(jnp.asarray(a), _s),
+                    op.make_consts())
+            else:   # a pytree of NamedShardings matching the consts
+                dev = jax.tree_util.tree_map(
+                    lambda a, s: jax.device_put(jnp.asarray(a), s),
+                    op.make_consts(), sh)
+        else:
+            dev = jax.tree_util.tree_map(
+                lambda a: jax.device_put(jnp.asarray(a)),
+                op.make_consts())
         with self._lock:
             self._consts[key] = (epoch, dev)   # evicts the stale epoch
             self.const_ships += 1
         return dev
+
+    def resident_bytes(self) -> int:
+        """Actual device residency of everything this table holds:
+        the sum of PER-DEVICE shard bytes across the mesh (a replicated
+        const on 8 devices counts 8x its logical size; a sharded one
+        counts once) — the honest footprint the zoo's eviction budget
+        wants."""
+        total = 0
+        with self._lock:
+            trees = [tree for _, tree in self._consts.values()]
+            cols = [arr for per in self._tables.values()
+                    for arr in per.values()]
+        for tree in trees:
+            for leaf in jax.tree_util.tree_leaves(tree):
+                total += _shard_bytes(leaf)
+        for arr in cols:
+            total += _shard_bytes(arr)
+        return total
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -354,6 +477,18 @@ class DeviceTable:
                     "const_ships": self.const_ships,
                     "tables_cached": len(self._tables),
                     "consts_cached": len(self._consts)}
+
+
+def _shard_bytes(arr) -> int:
+    """Device bytes one array actually occupies, summed across its
+    addressable shards (replication counts per device)."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards:
+        try:
+            return sum(int(s.data.nbytes) for s in shards)
+        except Exception:  # noqa: BLE001 — deleted/donated buffer
+            return 0
+    return int(getattr(arr, "nbytes", 0))
 
 
 # ---------------------------------------------------------------------------
@@ -378,7 +513,8 @@ class FusedSegment:
     and only those are fetched (ONE D2H round trip per segment).
     """
 
-    def __init__(self, ops: List[DeviceOp], writes_live: List[str]):
+    def __init__(self, ops: List[DeviceOp], writes_live: List[str],
+                 sharding: Optional[SegmentSharding] = None):
         self.ops = list(ops)
         all_writes: Set[str] = set()
         ext: List[str] = []
@@ -392,7 +528,11 @@ class FusedSegment:
         self.writes_live = tuple(w for w in writes_live
                                  if w in all_writes)
         self.name = "+".join(type(op.stage).__name__ for op in self.ops)
-        self._jitted: Dict[bool, Callable] = {}
+        # mesh placement (SegmentSharding): the segment program compiles
+        # with EXPLICIT in_shardings/out_shardings over the mesh; None =
+        # the single-placement jit (one replica = one chip)
+        self.sharding = sharding
+        self._jitted: Dict[Tuple[bool, bool], Callable] = {}
         self._op_jitted: Dict[int, Callable] = {}
         self._lock = threading.Lock()
         self.trace_count = 0      # one per XLA compile of the fused fn
@@ -434,20 +574,57 @@ class FusedSegment:
 
         return run
 
-    def compiled(self, donate: bool) -> Callable:
-        donate = donate and _donatable()
-        fn = self._jitted.get(donate)
+    def _jit_for(self, donate: bool, sharded: bool) -> Callable:
+        key = (donate, sharded)
+        fn = self._jitted.get(key)
         if fn is None:
             with self._lock:
-                fn = self._jitted.get(donate)
+                fn = self._jitted.get(key)
                 if fn is None:
                     # creation under the lock: two racing first calls
                     # must share ONE jit wrapper or the trace counter
                     # would double-count their compiles (tracing itself
                     # happens later, at call time, outside this lock)
-                    fn = jax.jit(self._make_fn(count_traces=True),
-                                 donate_argnums=(1,) if donate else ())
-                    self._jitted[donate] = fn
+                    if sharded:
+                        fn = self._jit_sharded(donate)
+                    else:
+                        fn = jax.jit(self._make_fn(count_traces=True),
+                                     donate_argnums=(1,)
+                                     if donate else ())
+                    self._jitted[key] = fn
+        return fn
+
+    def _jit_sharded(self, donate: bool) -> Callable:
+        """The mesh-sharded program: ``jit`` with EXPLICIT
+        ``in_shardings``/``out_shardings`` (consts per their declared
+        placement, env + outputs batch-sharded over the data axis) and
+        the env buffers donated — the SNIPPETS [1]/[2] pjit pattern.
+        Shardings are declared, never inferred (audited by
+        tools/check_fusion_kernels.py)."""
+        sh = self.sharding
+        consts_in = [sh.const_sharding(op.name) for op in self.ops]
+        return jax.jit(
+            self._make_fn(count_traces=True),
+            in_shardings=(consts_in, sh.env_sharding()),
+            out_shardings=sh.env_sharding(),
+            donate_argnums=(1,) if donate else ())
+
+    def compiled(self, donate: bool) -> Callable:
+        donate = donate and _donatable()
+        if self.sharding is None:
+            fn = self._jit_for(donate, sharded=False)
+        else:
+            sharded_fn = self._jit_for(donate, sharded=True)
+            seg_sh, seg = self.sharding, self
+
+            def fn(consts, env, _sh=seg_sh, _seg=seg,
+                   _fn=sharded_fn, _donate=donate):
+                if _sh.divisible(env):
+                    return _fn(consts, env)
+                # indivisible batch (arbitrary-length batch transform):
+                # the single-placement jit, compiled + counted as usual
+                return _seg._jit_for(_donate, sharded=False)(consts, env)
+
         if not self._aot:
             return fn
         aot, seg = self._aot, self
@@ -539,7 +716,8 @@ class FusionPlan:
     per-boundary liveness sets used to prune dead host columns."""
 
     def __init__(self, stages: Sequence[Any], in_schema: Schema,
-                 final_needed: Optional[Set[str]] = None):
+                 final_needed: Optional[Set[str]] = None,
+                 sharding: Optional[SegmentSharding] = None):
         self.stages = list(stages)
         self.in_schema = in_schema
         self.final_needed = (set(final_needed)
@@ -547,7 +725,8 @@ class FusionPlan:
         self.needed = column_liveness(self.stages, in_schema, final_needed)
         self.steps: List[Any] = []          # _HostStep | FusedSegment
         self.step_boundaries: List[int] = []  # stage index AFTER each step
-        self.device_table = DeviceTable()
+        self.sharding = sharding
+        self.device_table = DeviceTable(placement=sharding)
         self.last_roundtrips = 0            # D2H fetches of the last run
         self._build()
 
@@ -562,7 +741,8 @@ class FusionPlan:
                 return
             ops = [op for _, op in run]
             live = self._live_writes(run, end_idx)
-            self.steps.append(FusedSegment(ops, live))
+            self.steps.append(FusedSegment(ops, live,
+                                           sharding=self.sharding))
             self.step_boundaries.append(end_idx)
             run.clear()
 
@@ -730,6 +910,10 @@ class FusedPipelineModel:
         # segment programs installed (serving/aot.py); the
         # serving_model_info 'aot' label
         self.aot = False
+        # mesh placement for every plan this model compiles (set by
+        # ``shard()`` — serving/sharded.py builds it): fused programs
+        # jit with explicit in/out shardings over the mesh
+        self.sharding: Optional[SegmentSharding] = None
         self._plans: Dict[Tuple, FusionPlan] = {}
         self._plan_lock = threading.Lock()
         # trace counts of evicted (stale-epoch) plans: folded into
@@ -751,7 +935,39 @@ class FusedPipelineModel:
                   final_needed: Optional[Set[str]]) -> Tuple:
         return (self._schema_sig(schema),
                 None if final_needed is None else frozenset(final_needed),
-                tuple((s.uid, stage_epoch(s)) for s in self.stages))
+                tuple((s.uid, stage_epoch(s)) for s in self.stages),
+                self.sharding.signature()
+                if self.sharding is not None else None)
+
+    def shard(self, mesh, data_axis: str = "data",
+              const_specs: Optional[Dict[str, Any]] = None,
+              ) -> "FusedPipelineModel":
+        """Make every plan this model compiles mesh-sharded: fused
+        serving programs jit with explicit ``in_shardings``/
+        ``out_shardings`` (env batch-sharded over ``data_axis``, consts
+        replicated or per ``const_specs``) and DeviceTable buffers ship
+        straight into their declared placement. Requires the serving
+        buckets to divide the axis (pow-2 buckets over a pow-2 mesh).
+        Existing plans are dropped — they were compiled for the old
+        placement. Returns self."""
+        sharding = SegmentSharding(mesh, data_axis=data_axis,
+                                   const_specs=const_specs)
+        if MIN_BUCKET % sharding.n_shards:
+            # every pow-2 serving bucket must divide the axis, i.e.
+            # the axis must divide MIN_BUCKET — a 6-wide axis would
+            # pass a naive <= check and then silently serve EVERY
+            # bucket through the unsharded fallback while metrics
+            # claim sharded=True
+            raise ValueError(
+                f"data axis {data_axis!r} has {sharding.n_shards} "
+                f"shards, which does not divide MIN_BUCKET "
+                f"{MIN_BUCKET}: serving buckets could never shard")
+        self.sharding = sharding
+        with self._plan_lock:
+            for old in self._plans.values():
+                self._retired_traces += old.jit_cache_misses
+            self._plans = {}
+        return self
 
     def plan_for(self, schema: Schema,
                  final_needed: Optional[Set[str]] = None) -> FusionPlan:
@@ -761,7 +977,8 @@ class FusedPipelineModel:
             with self._plan_lock:
                 plan = self._plans.get(key)
                 if plan is None:
-                    plan = FusionPlan(self.stages, schema, final_needed)
+                    plan = FusionPlan(self.stages, schema, final_needed,
+                                      sharding=self.sharding)
                     # param-epoch bumps leave stale keys behind; drop
                     # them so swapped-out weights don't pin device
                     # state — but retire their trace counts first
@@ -879,6 +1096,15 @@ class FusedPipelineModel:
                 "a quantize(calib) hook)")
         return FusedPipelineModel(stages, batch_size=self.batch_size)
 
+    def resident_bytes(self) -> int:
+        """Device residency of every plan's DeviceTable (consts +
+        cached columns), summed across mesh devices — the zoo's
+        per-model eviction-cost signal. 0 before the first plan ships
+        anything (callers fall back to file-size estimates)."""
+        with self._plan_lock:
+            plans = list(self._plans.values())
+        return sum(p.device_table.resident_bytes() for p in plans)
+
     def metrics(self) -> Dict[str, Any]:
         plans = list(self._plans.values())
         out: Dict[str, Any] = {
@@ -886,6 +1112,10 @@ class FusedPipelineModel:
             "plans": len(plans),
             "precision": self.precision,
         }
+        if self.sharding is not None:
+            out["sharded"] = True
+            out["mesh"] = dict(self.sharding.mesh.shape)
+            out["data_axis"] = self.sharding.data_axis
         if plans:
             # aggregate DeviceTable stats across plans (batch + serving
             # plans both count; under traffic the serving plan's

@@ -86,6 +86,13 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
 
     def _post_init(self):
         self._mesh: Optional[Mesh] = None
+        # explicit mesh sharding (set_sharding / serving/sharded.py):
+        # when set, the forward jits with DECLARED in_shardings/
+        # out_shardings (weights per their spec tree — sharded weights
+        # are how a model too big for one device serves from the mesh —
+        # inputs/outputs per in_spec/out_spec) instead of the
+        # replicate-weights + shard-batch default
+        self._sharding: Optional[Dict[str, Any]] = None
         # True on models rebuilt from an AOT artifact (serving/aot.py);
         # exported as the serving_model_info 'aot' label
         self.aot = False
@@ -139,6 +146,82 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         self._device_weights = None
         return self
 
+    def set_sharding(self, mesh: Mesh, weight_specs: Any = None,
+                     in_spec: Optional[P] = None,
+                     out_spec: Optional[P] = None) -> "TPUModel":
+        """Mesh-shard this model's serving program (the pjit pattern:
+        jit with explicit ``in_shardings``/``out_shardings`` over a
+        named mesh; GSPMD, Xu et al. 2021 / Pope et al. 2022).
+
+        - ``weight_specs``: a ``PartitionSpec``, a pytree of specs
+          matching the weights, or a callable ``(path, leaf) -> spec``
+          (see ``serving.sharded.auto_weight_specs``). Default:
+          replicated. Sharded weight leaves are how a model whose
+          weights exceed one device's memory serves from the mesh —
+          per-device resident bytes stay below the total.
+        - ``in_spec``: placement of every model input (default:
+          batch-dim over ``'data'`` when the mesh has that axis, else
+          replicated). A seq-sharded LM passes ``P(None, 'seq')``.
+        - ``out_spec``: placement of every output (default =
+          ``in_spec``); the readback gathers.
+
+        Shardings here are declared, never inferred (audited by
+        tools/check_fusion_kernels.py ``check_sharded_serving``)."""
+        if in_spec is None:
+            in_spec = P("data") if "data" in mesh.shape else P()
+        if out_spec is None:
+            out_spec = in_spec
+        weights = self.get("weights")
+        if weight_specs is None:
+            weight_specs = P()
+        if callable(weight_specs) and not isinstance(weight_specs, P):
+            flat, treedef = jax.tree_util.tree_flatten_with_path(weights)
+            specs = jax.tree_util.tree_unflatten(
+                treedef, [weight_specs(jax.tree_util.keystr(path), leaf)
+                          for path, leaf in flat])
+        elif isinstance(weight_specs, P):
+            specs = jax.tree_util.tree_map(lambda _: weight_specs,
+                                           weights)
+        else:
+            specs = weight_specs   # a full pytree of PartitionSpecs
+        w_shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        # batch-dim input sharding needs EVERY serving bucket (pow-2
+        # from MIN_BUCKET up to batchSize) to divide the axis — refuse
+        # now with the fix, not at the first small live batch that
+        # buckets to 8 rows over a non-pow-2 axis
+        if in_spec and in_spec[0] is not None:
+            n = int(mesh.shape[in_spec[0]])
+            if MIN_BUCKET % n:
+                raise ValueError(
+                    f"the {in_spec[0]!r} axis has {n} shards, which "
+                    f"does not divide the smallest serving bucket "
+                    f"({MIN_BUCKET}): small micro-batches could never "
+                    f"shard")
+            if int(self.get("batchSize")) % n:
+                raise ValueError(
+                    f"batchSize {self.get('batchSize')} does not divide "
+                    f"the {in_spec[0]!r} axis ({n} shards); pick a "
+                    f"multiple of {n}")
+        self._sharding = {
+            "mesh": mesh,
+            "weight_specs": specs,
+            "weight_shardings": w_shardings,
+            "in": NamedSharding(mesh, in_spec),
+            "in_spec": in_spec,
+            "out": NamedSharding(mesh, out_spec),
+            "out_spec": out_spec,
+        }
+        self._mesh = mesh
+        self._jitted = {}
+        self._device_weights = None
+        return self
+
+    @property
+    def sharding(self) -> Optional[Dict[str, Any]]:
+        return self._sharding
+
     def _get_mesh(self) -> Mesh:
         if self._mesh is None:
             with self._init_lock:
@@ -154,11 +237,39 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
             m = self._get_mesh()
             with self._init_lock:
                 if self._device_weights is None:
-                    repl = NamedSharding(m, P())
-                    self._device_weights = jax.tree_util.tree_map(
-                        lambda a: jax.device_put(jnp.asarray(a), repl),
-                        self.get("weights"))
+                    if self._sharding is not None:
+                        # per-leaf declared placement: sharded leaves
+                        # land split across the mesh (per-device
+                        # resident bytes < the total weight bytes)
+                        self._device_weights = jax.tree_util.tree_map(
+                            lambda a, s: jax.device_put(
+                                jnp.asarray(a), s),
+                            self.get("weights"),
+                            self._sharding["weight_shardings"])
+                    else:
+                        repl = NamedSharding(m, P())
+                        self._device_weights = jax.tree_util.tree_map(
+                            lambda a: jax.device_put(jnp.asarray(a),
+                                                     repl),
+                            self.get("weights"))
         return self._device_weights
+
+    def resident_bytes(self) -> int:
+        """Device bytes the shipped weights occupy, summed across PER-
+        DEVICE shards over the whole mesh (a replicated tree counts
+        once per device; a sharded tree counts its true split
+        footprint) — the zoo's per-model eviction-cost signal. Falls
+        back to the host estimate before the first ship."""
+        dev = self._device_weights
+        if dev is not None:
+            from mmlspark_tpu.core.fusion import _shard_bytes
+            return sum(_shard_bytes(leaf)
+                       for leaf in jax.tree_util.tree_leaves(dev))
+        host = self.get("weights")
+        if host is None:
+            return 0
+        return int(sum(int(np.asarray(a).nbytes)
+                       for a in jax.tree_util.tree_leaves(host)))
 
     def _feeds(self) -> Dict[str, str]:
         fd = self.get("feedDict")
@@ -202,9 +313,25 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                     # and only emits warnings there; donate where it pays
                     donate = (1,) if jax.default_backend() not in ("cpu",) \
                         else ()
-                    fn = jax.jit(run, donate_argnums=donate)
+                    if self._sharding is not None:
+                        fn = self._jit_sharded(run, donate)
+                    else:
+                        fn = jax.jit(run, donate_argnums=donate)
                     self._jitted["run"] = fn
         return fn
+
+    def _jit_sharded(self, run: Callable, donate: Tuple[int, ...],
+                     ) -> Callable:
+        """The mesh-sharded forward: jit with EXPLICIT in_shardings
+        (the per-leaf weight placement + the declared input spec for
+        every feed) and out_shardings, input buffers donated — never
+        inferred shardings (the sharded-serving audit contract)."""
+        sh = self._sharding
+        return jax.jit(
+            run,
+            in_shardings=(sh["weight_shardings"], sh["in"]),
+            out_shardings=sh["out"],
+            donate_argnums=donate)
 
     # -- serving shape buckets ----------------------------------------------
 
@@ -263,6 +390,10 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
         out["jit_cache_misses"] = self.jit_cache_misses
         out["precision"] = self.get("precision")
         out["aot"] = bool(self.aot)
+        if self._sharding is not None:
+            out["sharded"] = True
+            out["mesh"] = dict(self._sharding["mesh"].shape)
+            out["in_spec"] = str(self._sharding["in_spec"])
         return out
 
     # -- post-training quantization -----------------------------------------
@@ -436,7 +567,14 @@ class TPUModel(Model, HasInputCol, HasOutputCol):
                     # normalization paths can't turn them into NaNs that
                     # a cross-row computation would spread to real rows
                     arr, _ = mesh_lib.pad_to_multiple(arr, bucket, axis=0)
-                sharded, _ = mesh_lib.shard_batch(mesh, arr)
+                if self._sharding is not None:
+                    # ship straight into the DECLARED input placement
+                    # (replicated for tensor parallelism, seq-sharded
+                    # for the ring-attention LM, batch-sharded for DP)
+                    # so the sharded executable never reshuffles inputs
+                    sharded = jax.device_put(arr, self._sharding["in"])
+                else:
+                    sharded, _ = mesh_lib.shard_batch(mesh, arr)
                 if dtype == jnp.bfloat16 and not int_input:
                     sharded = sharded.astype(jnp.bfloat16)
                 inputs[model_in] = sharded

@@ -32,6 +32,24 @@ from mmlspark_tpu.core.slo import (
     Alert, AlertEvent, AlertLog, BurnRateRule, SLO, SLOMonitor,
 )
 
+# mesh-sharded serving (serving/sharded.py) resolves lazily: it pulls
+# core.fusion and therefore jax, and `import mmlspark_tpu.serving`
+# must stay host-only cheap (the PR 9 import discipline)
+_SHARDED_EXPORTS = frozenset({
+    "assert_serves_from_mesh", "auto_weight_specs",
+    "data_shard_pipeline", "device_residency", "seq_shard_lm",
+    "serving_mesh", "tensor_shard_model",
+})
+
+
+def __getattr__(name):
+    if name in _SHARDED_EXPORTS:
+        from mmlspark_tpu.serving import sharded as _sharded
+        return getattr(_sharded, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
            "BurnRateRule", "CanaryPolicy", "FlightRecorder",
            "HTTPSource",
@@ -39,6 +57,10 @@ __all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
            "PipelineHandle", "SLO", "SLOMonitor", "ServingEngine",
            "ServingFleet", "ServingUnavailable", "SharedSingleton",
            "SharedVariable", "SwapEvent", "SwapInProgress", "SwapResult",
-           "TenantQuota", "ZooEvent", "export_model", "get_recorder",
-           "json_row_scoring_pipeline", "json_scoring_pipeline",
-           "load_model", "model_key_of", "read_manifest", "serve_model"]
+           "TenantQuota", "ZooEvent", "assert_serves_from_mesh",
+           "auto_weight_specs",
+           "data_shard_pipeline", "device_residency", "export_model",
+           "get_recorder", "json_row_scoring_pipeline",
+           "json_scoring_pipeline", "load_model", "model_key_of",
+           "read_manifest", "seq_shard_lm", "serve_model",
+           "serving_mesh", "tensor_shard_model"]

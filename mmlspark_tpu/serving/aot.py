@@ -77,6 +77,10 @@ _PIPELINE = "pipeline.pkl"
 _EXAMPLE = "example.pkl"
 _EXAMPLE_REQUEST = "example_request.json"
 _XLA_CACHE = "xla_cache"
+# sharded artifacts only: the mesh axes + PartitionSpecs the programs
+# were exported with (jax.export carries shardings; the load side must
+# rebuild the same mesh shape and place inputs to match)
+_SHARDING = "sharding.pkl"
 
 
 def _jax_export():
@@ -106,13 +110,63 @@ def _avals_of(tree):
         lambda a: (tuple(a.shape), str(a.dtype)), tree)
 
 
-def _avals_to_structs(tree):
-    """The inverse: (shape, dtype) leaves -> ShapeDtypeStruct leaves."""
+def _is_aval_leaf(x) -> bool:
+    return (isinstance(x, tuple) and len(x) == 2
+            and isinstance(x[0], tuple))
+
+
+def _avals_to_structs(tree, shardings=None):
+    """The inverse: (shape, dtype) leaves -> ShapeDtypeStruct leaves.
+    ``shardings`` (a single Sharding applied to every leaf, or a
+    matching pytree) attaches the placement — sharded programs must be
+    lowered against sharding-carrying avals or jax.export resolves a
+    1-device context and refuses the multi-device call."""
     import jax
+    if shardings is None:
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf[0], np.dtype(leaf[1])),
+            tree, is_leaf=_is_aval_leaf)
+    from jax.sharding import Sharding
+    if isinstance(shardings, Sharding):
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                leaf[0], np.dtype(leaf[1]), sharding=shardings),
+            tree, is_leaf=_is_aval_leaf)
     return jax.tree_util.tree_map(
-        lambda leaf: jax.ShapeDtypeStruct(leaf[0], np.dtype(leaf[1])),
-        tree, is_leaf=lambda x: (isinstance(x, tuple) and len(x) == 2
-                                 and isinstance(x[0], tuple)))
+        lambda leaf, s: jax.ShapeDtypeStruct(
+            leaf[0], np.dtype(leaf[1]), sharding=s),
+        tree, shardings, is_leaf=_is_aval_leaf)
+
+
+def _model_sharding_blob(model) -> Optional[Dict[str, Any]]:
+    """The picklable description of a TPUModel's sharding (None when
+    unsharded): mesh axes + the PartitionSpec trees. Device handles
+    never enter the artifact — the load side rebuilds the mesh from
+    its own processes' devices."""
+    sh = getattr(model, "_sharding", None) or \
+        getattr(model, "sharding", None)
+    if not isinstance(sh, dict):
+        return None
+    return {
+        "kind": "tpu_model",
+        "axes": {str(k): int(v) for k, v in sh["mesh"].shape.items()},
+        "weight_specs": sh["weight_specs"],
+        "in_spec": sh["in_spec"],
+        "out_spec": sh["out_spec"],
+    }
+
+
+def _load_sharding_blob(art_dir: str) -> Optional[Dict[str, Any]]:
+    path = os.path.join(art_dir, _SHARDING)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def _rebuild_mesh(axes: Dict[str, int]):
+    from mmlspark_tpu.parallel import mesh as mesh_lib
+    return mesh_lib.make_mesh({k: int(v) for k, v in axes.items()})
 
 
 @contextlib.contextmanager
@@ -218,11 +272,12 @@ class _CaptureRun:
     every (weights, inputs) call so export sees EXACTLY the arrays the
     real transform path builds (coercion, padding, sharding, dtype
     casts included), while still computing through jit so transform's
-    readback works."""
+    readback works. ``jitted`` is supplied by the caller so a SHARDED
+    model's capture computes through the same explicit-shardings jit
+    the live replica would."""
 
-    def __init__(self, run: Callable):
-        import jax
-        self.jitted = jax.jit(run)
+    def __init__(self, jitted: Callable):
+        self.jitted = jitted
         self.calls: List[Tuple[Any, Dict[str, Any]]] = []
 
     def __call__(self, weights, inputs):
@@ -241,10 +296,12 @@ def _export_tpu_model(model, example, out_dir: str,
     if len(table) == 0:
         raise ValueError("export needs at least one example row")
 
-    # export clone on a SINGLE-device mesh: one replica = one chip (the
-    # fleet replicates; mesh-sharded serving is a separate item), and a
-    # multi-device trace would bake this host's device topology into
-    # the artifact
+    # export clone: a SINGLE-device mesh for plain models (one replica
+    # = one chip; the fleet replicates), or the model's OWN mesh
+    # sharding for sharded models — jax.export carries the declared
+    # shardings, so a multi-chip replica loads the artifact and serves
+    # from its mesh with zero traces, exactly like a single-chip one
+    sharding_blob = _model_sharding_blob(model)
     clone = TPUModel(modelFn=model.get("modelFn"),
                      weights=model.get("weights"),
                      feedDict=model.get("feedDict"),
@@ -254,7 +311,13 @@ def _export_tpu_model(model, example, out_dir: str,
                      inputCol=model.get("inputCol"),
                      outputCol=model.get("outputCol"),
                      precision=model.get("precision"))
-    clone.set_mesh(_single_device_mesh())
+    if sharding_blob is not None:
+        clone.set_sharding(_rebuild_mesh(sharding_blob["axes"]),
+                           weight_specs=sharding_blob["weight_specs"],
+                           in_spec=sharding_blob["in_spec"],
+                           out_spec=sharding_blob["out_spec"])
+    else:
+        clone.set_mesh(_single_device_mesh())
 
     model_fn = clone.get("modelFn")
 
@@ -264,7 +327,26 @@ def _export_tpu_model(model, example, out_dir: str,
             out = {"output": out}
         return out
 
-    capture = _CaptureRun(run)
+    def make_jit():
+        if sharding_blob is not None:
+            return clone._jit_sharded(run, donate=())
+        return jax.jit(run)
+
+    def load_shardings(rec):
+        """The (weights, inputs) sharding trees the LOAD side lowers
+        against (None/None when unsharded)."""
+        if sharding_blob is None:
+            return None, None
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+        mesh = clone._sharding["mesh"]
+        w_sh = jax.tree_util.tree_map(
+            lambda _leaf, s: NamedSharding(mesh, s),
+            rec["weights_avals"], sharding_blob["weight_specs"],
+            is_leaf=_is_aval_leaf)
+        return w_sh, NamedSharding(mesh, sharding_blob["in_spec"])
+
+    capture = _CaptureRun(make_jit())
     clone._jitted["run"] = capture      # transform uses it verbatim
     records: List[Dict[str, Any]] = []
     with _artifact_cache(out_dir):
@@ -280,14 +362,16 @@ def _export_tpu_model(model, example, out_dir: str,
             rec = {"key": sig, "weights_avals": _avals_of(weights_dev),
                    "inputs_avals": _avals_of(inputs)}
             if je is not None:
-                exp = je.export(jax.jit(run))(weights_dev, inputs)
+                exp = je.export(make_jit())(weights_dev, inputs)
                 rec["blob"] = exp.serialize()
                 # seed the cache with the LOAD-side compile (the
                 # deserialized module's HLO differs from the jit
                 # trace's, so the load path needs its own entry)
+                w_sh, in_sh = load_shardings(rec)
                 jax.jit(je.deserialize(rec["blob"]).call).lower(
-                    _avals_to_structs(rec["weights_avals"]),
-                    _avals_to_structs(rec["inputs_avals"])).compile()
+                    _avals_to_structs(rec["weights_avals"], w_sh),
+                    _avals_to_structs(rec["inputs_avals"],
+                                      in_sh)).compile()
             records.append(rec)
 
     with open(os.path.join(out_dir, _PROGRAMS), "wb") as f:
@@ -326,8 +410,41 @@ def _export_tpu_model(model, example, out_dir: str,
         "backend": _backend(),
         "jax_version": _jax_version(),
     }
+    if sharding_blob is not None:
+        manifest["sharded"] = True
+        manifest["mesh"] = sharding_blob["axes"]
+        with open(os.path.join(out_dir, _SHARDING), "wb") as f:
+            pickle.dump(sharding_blob, f)
     _write_manifest(out_dir, manifest)
     return manifest
+
+
+def _segment_shardings(seg):
+    """A sharded FusedSegment's (consts, env) in-sharding trees — the
+    same placement ``FusedSegment._jit_sharded`` declares."""
+    sh = seg.sharding
+    return ([sh.const_sharding(op.name) for op in seg.ops],
+            sh.env_sharding())
+
+
+def _segment_record_shardings(seg, rec):
+    """The sharding trees a record's avals lower against at LOAD time
+    (None/None for unsharded segments). Prefix shardings expand to
+    full trees so ``_avals_to_structs`` can zip leaf-for-leaf."""
+    import jax
+    from jax.sharding import Sharding
+    if seg.sharding is None:
+        return None, None
+    consts_in, env_sh = _segment_shardings(seg)
+    full = []
+    for sh_i, avals_i in zip(consts_in, rec["consts_avals"]):
+        if isinstance(sh_i, Sharding):
+            full.append(jax.tree_util.tree_map(
+                lambda _leaf, _s=sh_i: _s, avals_i,
+                is_leaf=_is_aval_leaf))
+        else:
+            full.append(sh_i)
+    return full, env_sh
 
 
 @contextlib.contextmanager
@@ -399,11 +516,22 @@ def _export_pipeline(pipeline, example, out_dir: str,
                    "env_avals": _avals_of(env)}
             if je is not None:
                 fn = seg._make_fn(count_traces=False)
-                exp = je.export(jax.jit(fn))(consts, env)
+                if seg.sharding is not None:
+                    # mesh-sharded segment: export the same explicit-
+                    # shardings program the live replica runs (the env
+                    # arrays captured here are already placed per spec)
+                    consts_in, env_sh = _segment_shardings(seg)
+                    jitted = jax.jit(fn, in_shardings=(consts_in,
+                                                       env_sh),
+                                     out_shardings=env_sh)
+                else:
+                    jitted = jax.jit(fn)
+                exp = je.export(jitted)(consts, env)
                 rec["blob"] = exp.serialize()
+                c_sh, e_sh = _segment_record_shardings(seg, rec)
                 jax.jit(je.deserialize(rec["blob"]).call).lower(
-                    _avals_to_structs(rec["consts_avals"]),
-                    _avals_to_structs(rec["env_avals"])).compile()
+                    _avals_to_structs(rec["consts_avals"], c_sh),
+                    _avals_to_structs(rec["env_avals"], e_sh)).compile()
             records.append(rec)
 
     with open(os.path.join(out_dir, _PROGRAMS), "wb") as f:
@@ -435,6 +563,15 @@ def _export_pipeline(pipeline, example, out_dir: str,
         "backend": _backend(),
         "jax_version": _jax_version(),
     }
+    if fused.sharding is not None:
+        sh = fused.sharding
+        axes = {str(k): int(v) for k, v in sh.mesh.shape.items()}
+        manifest["sharded"] = True
+        manifest["mesh"] = axes
+        with open(os.path.join(out_dir, _SHARDING), "wb") as f:
+            pickle.dump({"kind": "pipeline", "axes": axes,
+                         "data_axis": sh.data_axis,
+                         "const_specs": sh.const_specs}, f)
     _write_manifest(out_dir, manifest)
     return manifest
 
@@ -581,12 +718,35 @@ def _load_tpu_model(art_dir: str, manifest: Dict[str, Any]):
         modelFn=_LazyModelFn(manifest.get("int_input", False)),
         **_model_kwargs(manifest, weights))
     model._artifact_dir = art_dir
-    model.set_mesh(_single_device_mesh())
+    sharding_blob = _load_sharding_blob(art_dir) \
+        if manifest.get("sharded") else None
+    w_sh = in_sh = None
+    if sharding_blob is not None:
+        # the multi-chip replica: same mesh shape, this process's
+        # devices; the unseen-shape jit fallback is sharded too
+        mesh = _rebuild_mesh(sharding_blob["axes"])
+        model.set_sharding(mesh,
+                           weight_specs=sharding_blob["weight_specs"],
+                           in_spec=sharding_blob["in_spec"],
+                           out_spec=sharding_blob["out_spec"])
+        import jax
+        from jax.sharding import NamedSharding
+        in_sh = NamedSharding(mesh, sharding_blob["in_spec"])
+    else:
+        model.set_mesh(_single_device_mesh())
     with _artifact_cache(art_dir):
         for rec in records:
+            if sharding_blob is not None and w_sh is None:
+                # one NamedSharding tree serves every record: the
+                # specs and mesh never change between buckets
+                w_sh = jax.tree_util.tree_map(
+                    lambda _leaf, s: NamedSharding(mesh, s),
+                    rec["weights_avals"],
+                    sharding_blob["weight_specs"],
+                    is_leaf=_is_aval_leaf)
             co = _compile_record(
-                rec, (_avals_to_structs(rec["weights_avals"]),
-                      _avals_to_structs(rec["inputs_avals"])))
+                rec, (_avals_to_structs(rec["weights_avals"], w_sh),
+                      _avals_to_structs(rec["inputs_avals"], in_sh)))
             if co is not None:
                 model._aot_programs[tuple(map(tuple, rec["key"]))] = co
     if not model._aot_programs:
@@ -609,6 +769,12 @@ def _load_pipeline(art_dir: str, manifest: Dict[str, Any]):
         records = pickle.load(f)
     fused = FusedPipelineModel(meta["stages"],
                                batch_size=manifest["batch_size"])
+    sharding_blob = _load_sharding_blob(art_dir) \
+        if manifest.get("sharded") else None
+    if sharding_blob is not None:
+        fused.shard(_rebuild_mesh(sharding_blob["axes"]),
+                    data_axis=sharding_blob["data_axis"],
+                    const_specs=sharding_blob.get("const_specs"))
     plan = fused.plan_for(meta["in_schema"], meta["final_needed"])
     with _artifact_cache(art_dir):
         for rec in records:
@@ -617,9 +783,10 @@ def _load_pipeline(art_dir: str, manifest: Dict[str, Any]):
                 raise RuntimeError(
                     f"artifact step {rec['step']} is not a fused segment"
                     f" in the rebuilt plan — stage list drifted")
+            c_sh, e_sh = _segment_record_shardings(step, rec)
             co = _compile_record(
-                rec, (_avals_to_structs(rec["consts_avals"]),
-                      _avals_to_structs(rec["env_avals"])))
+                rec, (_avals_to_structs(rec["consts_avals"], c_sh),
+                      _avals_to_structs(rec["env_avals"], e_sh)))
             if co is not None:
                 step.install_aot({tuple(map(tuple, rec["key"])): co})
     fused.aot = True
@@ -631,6 +798,23 @@ def _load_pipeline(art_dir: str, manifest: Dict[str, Any]):
 # ---------------------------------------------------------------------------
 
 
+def _force_mesh_devices(manifest: Dict[str, Any]) -> None:
+    """A sharded artifact needs as many devices as its export mesh.
+    On a CPU host (tests/bench: the forced-host-device-count recipe)
+    give this process enough VIRTUAL cpu devices BEFORE first backend
+    use; on a real accelerator the topology is what it is and a
+    mismatch surfaces as jax.export's own count error."""
+    mesh = manifest.get("mesh")
+    if not mesh:
+        return
+    import math
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if "cpu" not in platforms.split(","):
+        return
+    from mmlspark_tpu.utils.jax_compat import set_cpu_device_count
+    set_cpu_device_count(math.prod(int(v) for v in mesh.values()))
+
+
 def _coldstart(art_dir: str, mode: str, port: int,
                t0: float) -> Dict[str, Any]:
     """Build a serving replica from the artifact and time process-start
@@ -640,6 +824,7 @@ def _coldstart(art_dir: str, mode: str, port: int,
     trace-at-startup replica, the baseline the AOT path retires."""
     import urllib.request
     manifest = read_manifest(art_dir)
+    _force_mesh_devices(manifest)
     if mode == "aot":
         model = load_model(art_dir)
     elif manifest["kind"] == "pipeline":
@@ -648,6 +833,15 @@ def _coldstart(art_dir: str, mode: str, port: int,
             meta = pickle.load(f)
         model = FusedPipelineModel(meta["stages"],
                                    batch_size=manifest["batch_size"])
+        blob = _load_sharding_blob(art_dir) \
+            if manifest.get("sharded") else None
+        if blob is not None:
+            # the trace-mode baseline replica shards the same way the
+            # AOT one does — the two cold starts being compared differ
+            # ONLY in where the compiles come from
+            model.shard(_rebuild_mesh(blob["axes"]),
+                        data_axis=blob["data_axis"],
+                        const_specs=blob.get("const_specs"))
     else:
         from mmlspark_tpu.models.tpu_model import TPUModel
         with open(os.path.join(art_dir, _WEIGHTS), "rb") as f:
@@ -656,7 +850,15 @@ def _coldstart(art_dir: str, mode: str, port: int,
             model_fn = pickle.load(f)
         model = TPUModel(modelFn=model_fn,
                          **_model_kwargs(manifest, weights))
-        model.set_mesh(_single_device_mesh())
+        blob = _load_sharding_blob(art_dir) \
+            if manifest.get("sharded") else None
+        if blob is not None:
+            model.set_sharding(_rebuild_mesh(blob["axes"]),
+                               weight_specs=blob["weight_specs"],
+                               in_spec=blob["in_spec"],
+                               out_spec=blob["out_spec"])
+        else:
+            model.set_mesh(_single_device_mesh())
 
     from mmlspark_tpu.core.table import DataTable
     from mmlspark_tpu.serving.fleet import json_scoring_pipeline

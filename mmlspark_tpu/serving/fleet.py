@@ -280,6 +280,12 @@ def json_scoring_pipeline(model, field: str = "features",
     model_bucket = getattr(model, "bucket_for", None)
     if callable(model_bucket):
         lam.bucket_for = model_bucket
+    # per-model device residency (summed across mesh devices) — the
+    # zoo's measured eviction cost for this stage (serving/zoo.py
+    # _duck_bytes); a sharded model reports its true split footprint
+    model_rb = getattr(model, "resident_bytes", None)
+    if callable(model_rb):
+        lam.resident_bytes = model_rb
     if drift_monitor is not None:
         lam.drift_monitor = drift_monitor
     # precision/aot labels ride the stage into the PipelineHandle so
@@ -698,6 +704,7 @@ class _FusedPipelineScorer:
         lam.metrics = self.metrics
         lam.jit_cache_miss_count = self.jit_cache_miss_count
         lam.bucket_for = self.bucket_for
+        lam.resident_bytes = self.fused.resident_bytes
         lam.precision = self.fused.precision
         lam.aot = bool(self.fused.aot)
         lam.scorer = self
@@ -853,7 +860,9 @@ class ServingFleet:
                 hedge_percentile: Optional[float] = None,
                 hedge_min_s: float = 0.02,
                 tracer=None,
-                tracing: Optional[bool] = None) -> "ServingFleet":
+                tracing: Optional[bool] = None,
+                wait_ready_s: float = 0.0,
+                ready_poll_timeout_s: float = 1.0) -> "ServingFleet":
         """A CLIENT-ONLY fleet over engines that live in OTHER
         processes (or hosts): the same round-robin + circuit-breaking
         + failover + hedging client, pointed at explicit addresses
@@ -863,6 +872,18 @@ class ServingFleet:
         context, so a request that retries/hedges across processes
         still reassembles into ONE trace from the engines' exported
         buffers (``core.trace.merge_chrome_traces``).
+
+        ``wait_ready_s`` > 0 runs a STARTUP probe: poll each address's
+        ``/healthz`` with backoff until it answers or the budget runs
+        out. Engine processes spawn slowly (a replica pays its Python/
+        jax import before it listens), and without the probe the first
+        real requests against a not-yet-listening worker burn the
+        breaker's whole failure budget — the fleet opens the circuit
+        of an engine that was never down, then serves degraded until
+        the cooldown. Probe failures touch NO breaker (breakers are
+        built after the wait); addresses still unreachable when the
+        budget ends just log — the normal breaker/failover path owns
+        them from there.
 
         Engine-management surfaces (``rolling_swap``, ``metrics``,
         ``kill_engine``) are inert on a connected client — scrape the
@@ -876,10 +897,57 @@ class ServingFleet:
         fleet._remote_addresses = [str(a).rstrip("/") for a in addresses]
         if not fleet._remote_addresses:
             raise ValueError("connect() needs at least one address")
+        if wait_ready_s > 0:
+            fleet._wait_ready(wait_ready_s, ready_poll_timeout_s)
         fleet._build_breakers(failure_threshold, breaker_cooldown)
         log.info("fleet client connected to %d remote engines: %s",
                  len(fleet._remote_addresses), fleet.addresses)
         return fleet
+
+    def _wait_ready(self, budget_s: float,
+                    probe_timeout_s: float = 1.0) -> List[str]:
+        """Bounded startup probe: poll every address's /healthz under
+        ONE shared deadline with jittered backoff (utils/resilience
+        discipline) until each answers anything at all — an HTTP
+        status means the process is listening, which is all the probe
+        establishes. Returns the addresses that never came up (logged;
+        callers' breakers take over)."""
+        from mmlspark_tpu.utils.resilience import Deadline, RetryPolicy
+        deadline = Deadline.after(float(budget_s))
+        policy = RetryPolicy(max_attempts=1_000_000, base_delay=0.05,
+                             multiplier=1.5, max_delay=0.5,
+                             name="fleet.wait_ready")
+        pending = list(self._remote_addresses)
+        not_ready: List[str] = []
+        for addr in pending:
+
+            def probe(_addr=addr):
+                timeout = max(0.05,
+                              deadline.clamp(float(probe_timeout_s)))
+                try:
+                    with urllib.request.urlopen(f"{_addr}/healthz",
+                                                timeout=timeout):
+                        pass
+                except urllib.error.HTTPError:
+                    pass   # an HTTP status = listening; ready enough
+
+            try:
+                if deadline.expired:
+                    # budget spent on earlier addresses: one immediate
+                    # probe each, no backoff — a worker that came up
+                    # meanwhile must not be written off unprobed
+                    probe()
+                else:
+                    policy.call(probe, deadline=deadline)
+            except Exception:  # noqa: BLE001 — budget spent / refused
+                not_ready.append(addr)
+        if not_ready:
+            log.warning(
+                "fleet.connect: %d/%d engines not listening after "
+                "%.1fs startup probe (%s); their breakers will own "
+                "them from here", len(not_ready), len(pending),
+                budget_s, ", ".join(not_ready))
+        return not_ready
 
     @property
     def addresses(self) -> List[str]:

@@ -205,8 +205,11 @@ class ModelZoo(ModelRegistry):
         self.max_resident = max_resident
         self.max_resident_bytes = max_resident_bytes
         if memory_probe is _DEFAULT_PROBE:
-            from mmlspark_tpu.utils.profiling import device_memory_stats
-            memory_probe = device_memory_stats
+            # MESH-wide stats: a sharded model spends memory on every
+            # device, so the live pressure signal sums bytes_in_use /
+            # bytes_limit across the mesh (utils/profiling)
+            from mmlspark_tpu.utils.profiling import mesh_memory_stats
+            memory_probe = mesh_memory_stats
         self.memory_probe = memory_probe   # None = live signal OFF
         self.memory_headroom = float(memory_headroom)
         self.failure_cooldown_s = float(failure_cooldown_s)
@@ -261,6 +264,11 @@ class ModelZoo(ModelRegistry):
         meta.setdefault("aot", True)
         meta.setdefault("buckets", manifest.get("buckets"))
         meta.setdefault("artifact_kind", manifest.get("kind"))
+        if manifest.get("sharded"):
+            # sharded manifests (serving/aot.py): activation rebuilds
+            # the mesh from these axes; surfaced in stats()/model_info
+            meta.setdefault("sharded", True)
+            meta.setdefault("mesh", manifest.get("mesh"))
         entry = ZooEntry(name, version, "artifact", art_dir, meta)
         if not entry.cost_bytes:
             entry.cost_bytes = _artifact_bytes(art_dir)
@@ -511,12 +519,27 @@ class ModelZoo(ModelRegistry):
         handle = PipelineHandle(stage, e.version)
         handle.model_name = e.name
         handle.model_key = e.key
+        # MEASURED device residency (the stage's duck-typed
+        # resident_bytes: per-device shard bytes summed across the
+        # mesh — warmup just shipped the weights/tables, so the
+        # reading is live). It replaces the static estimate (manifest
+        # file bytes) unless the registrant pinned cost_bytes
+        # explicitly — eviction pressure then reflects what a SHARDED
+        # model actually holds per device, not its disk size.
+        measured = _duck_bytes(stage)
+        cost_source = "estimate"
         with self._lock:
             e.metadata.update(extra_meta)
             if warm is not None:
                 e.metadata["warmup_compiles"] = int(warm)
-            if cost and not e.metadata.get("cost_bytes"):
+            if e.metadata.get("cost_bytes"):
+                cost_source = "metadata"
+            elif measured:
+                e.cost_bytes = int(measured)
+                cost_source = "device"
+            elif cost and not e.cost_bytes:
                 e.cost_bytes = int(cost)
+            e.metadata["cost_source"] = cost_source
             e.state = RESIDENT
             e.handle = handle
             e.failure = None
@@ -528,7 +551,8 @@ class ModelZoo(ModelRegistry):
             "activate", e.name, e.version,
             stats={"ms": round(ms, 1), "kind": e.kind,
                    "aot": bool(extra_meta.get("aot")),
-                   "cost_bytes": e.cost_bytes}))
+                   "cost_bytes": e.cost_bytes,
+                   "cost_source": cost_source}))
         log.info("zoo: activated %s in %.0f ms (%s)", key, ms, e.kind)
         self.enforce()
 

@@ -74,6 +74,42 @@ def device_memory_stats(device=None) -> Optional[dict]:
     return dict(stats) if stats else None
 
 
+def mesh_memory_stats() -> Optional[dict]:
+    """Memory stats summed across EVERY local device — the mesh-wide
+    pressure signal sharded serving needs (a model sharded over 8
+    chips spends HBM on all 8; watching device 0 alone misses 7/8 of
+    the footprint). ``bytes_in_use``/``bytes_limit``/``peak_bytes_in_use``
+    sum; ``per_device`` keeps the individual ``bytes_in_use`` readings
+    so an imbalanced placement is visible. Same safety contract as
+    ``device_memory_stats`` (None when the backend doesn't report)."""
+    import sys
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend: no sample
+        return None
+    total: dict = {}
+    per_device: dict = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — device without stats
+            stats = None
+        if not stats:
+            continue
+        for key in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use"):
+            if key in stats:
+                total[key] = total.get(key, 0) + int(stats[key])
+        per_device[str(d)] = int(stats.get("bytes_in_use", 0))
+    if not total:
+        return None
+    total["devices"] = len(per_device)
+    total["per_device"] = per_device
+    return total
+
+
 class MemorySampler:
     """Background device-memory-stats sampler: a daemon thread snapshots
     ``memory_stats()`` every ``interval_s`` into a bounded ring, so a

@@ -30,3 +30,33 @@ def cpu_mesh_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="module")
+def forced_host_device_count():
+    """The sharded-serving test module's forced-host-device-count
+    recipe (docs/sharded_serving.md): this process already runs on 8
+    virtual CPU devices (forced above, before backend init — it cannot
+    change per module), so the fixture (1) asserts the in-process mesh
+    is real and (2) exports the SAME count to the child processes the
+    sharded tests spawn (serving workers, the AOT cold-start runner)
+    via XLA_FLAGS + JAX_PLATFORMS, so their meshes match the exported
+    artifacts'. Restores the environment afterwards so other modules'
+    subprocess tests see what they always saw."""
+    n = 8
+    assert len(jax.devices()) >= n, \
+        f"expected >={n} virtual devices, got {len(jax.devices())}"
+    flag = f"--xla_force_host_platform_device_count={n}"
+    old_flags = os.environ.get("XLA_FLAGS")
+    old_platforms = os.environ.get("JAX_PLATFORMS")
+    if flag not in (old_flags or ""):
+        os.environ["XLA_FLAGS"] = ((old_flags + " ") if old_flags
+                                   else "") + flag
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    yield n
+    for key, old in (("XLA_FLAGS", old_flags),
+                     ("JAX_PLATFORMS", old_platforms)):
+        if old is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = old

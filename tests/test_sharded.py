@@ -1,0 +1,561 @@
+"""Mesh-sharded serving (serving/sharded.py, docs/sharded_serving.md).
+
+Runs on the conftest-forced 8-virtual-CPU-device mesh (the
+forced-host-device-count recipe): sharded-vs-single-device parity,
+too-big-for-one-device residency, sharded AOT artifact roundtrips
+(fresh process, zero traces), the multi-process fleet (startup probe +
+chaos kill drill), the zoo's measured device-memory accounting, and the
+sharded-program static audit.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mmlspark_tpu.core.stage import Pipeline
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.core.fusion import fuse
+from mmlspark_tpu.models.networks import build_network
+from mmlspark_tpu.models.tpu_model import TPUModel
+from mmlspark_tpu.serving import aot as AOT
+from mmlspark_tpu.serving import sharded as SH
+from mmlspark_tpu.serving.fleet import ServingFleet, json_scoring_pipeline
+from mmlspark_tpu.serving.server import HTTPSource, ServingEngine
+from mmlspark_tpu.serving.zoo import ModelZoo
+from mmlspark_tpu.stages.dataprep import (
+    CleanMissingData, FastVectorAssembler, StandardScaler,
+)
+from mmlspark_tpu.models.linear import TPULogisticRegression
+
+_WORKER = os.path.join(os.path.dirname(__file__), "serving_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _fitted_pipeline(n: int = 64):
+    rng = np.random.default_rng(0)
+    table = DataTable({
+        "a": rng.normal(size=n).astype(np.float32),
+        "b": np.where(rng.random(n) < 0.2, np.nan,
+                      rng.normal(size=n)),
+        "label": rng.integers(0, 2, n).astype(np.float64),
+    })
+    pm = Pipeline(stages=[
+        CleanMissingData(inputCols=["b"], outputCols=["b"]),
+        FastVectorAssembler(inputCols=["a", "b"], outputCol="fv"),
+        StandardScaler(inputCol="fv", outputCol="fv"),
+        TPULogisticRegression(featuresCol="fv", labelCol="label",
+                              maxIter=3),
+    ]).fit(table)
+    return pm, table
+
+
+_TP_SPEC = {"type": "transformer", "vocab_size": 2048, "dim": 64,
+            "depth": 1, "heads": 4, "max_len": 32, "num_classes": 4}
+
+
+def _tp_model(batch_size: int = 16):
+    """A Transformer classifier + its unsharded oracle twin (same
+    weights)."""
+    module = build_network(_TP_SPEC)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, _TP_SPEC["vocab_size"],
+                        size=(batch_size, 16)).astype(np.int32)
+    variables = module.init(jax.random.PRNGKey(0), toks[:1])
+    oracle = TPUModel.from_flax(module, variables, inputCol="tokens",
+                                outputCol="scores",
+                                batchSize=batch_size)
+    sharded = TPUModel.from_flax(module, variables, inputCol="tokens",
+                                 outputCol="scores",
+                                 batchSize=batch_size)
+    return oracle, sharded, toks
+
+
+class TestShardedFusedPipeline:
+    """Batch-dim data sharding of fused pipeline programs."""
+
+    def test_bit_identical_to_single_device(self,
+                                            forced_host_device_count):
+        pm, table = _fitted_pipeline()
+        plain = fuse(pm)
+        out_plain = plain.transform(table)
+        sharded = SH.data_shard_pipeline(pm, SH.serving_mesh())
+        out_sh = sharded.transform(table)
+        # batch-dim sharding never changes a row's math: f32 fused
+        # pipeline programs are BIT-identical to the 1-device oracle
+        for col in ("prediction", "probability"):
+            assert np.array_equal(np.asarray(out_plain[col]),
+                                  np.asarray(out_sh[col])), col
+        m = sharded.metrics()
+        assert m["sharded"] and m["mesh"] == {"data": 8}
+
+    def test_env_buffers_land_data_sharded(self,
+                                           forced_host_device_count):
+        pm, table = _fitted_pipeline()
+        sharded = SH.data_shard_pipeline(pm, SH.serving_mesh())
+        sharded.transform(table)
+        plan = next(iter(sharded._plans.values()))
+        seg = plan.segments[0]
+        env = seg.build_env(table, plan.device_table)
+        arr = env[seg.external_reads[0]]
+        # 64 rows over 8 devices: every shard holds 8 rows
+        shards = arr.addressable_shards
+        assert len(shards) == 8
+        assert all(s.data.shape[0] == len(table) // 8 for s in shards)
+
+    def test_indivisible_batch_falls_back(self,
+                                          forced_host_device_count):
+        pm, table = _fitted_pipeline()
+        plain_out = fuse(pm).transform(table)
+        sharded = SH.data_shard_pipeline(pm, SH.serving_mesh())
+        idx = np.arange(37)          # 37 % 8 != 0
+        out = sharded.transform(table._take_indices(idx))
+        assert np.array_equal(
+            np.asarray(out["prediction"]),
+            np.asarray(plain_out["prediction"])[:37])
+
+    def test_non_dividing_data_axis_refused(self,
+                                            forced_host_device_count):
+        # a 6-wide axis passes a naive <=MIN_BUCKET check but no pow-2
+        # bucket ever divides it — every batch would silently serve
+        # through the unsharded fallback while metrics claim sharded
+        pm, _ = _fitted_pipeline()
+        from mmlspark_tpu.parallel import mesh as mesh_lib
+        mesh6 = mesh_lib.make_mesh({"data": 6},
+                                   devices=jax.devices()[:6])
+        with pytest.raises(ValueError, match="does not divide"):
+            fuse(pm).shard(mesh6)
+        _, model, _ = _tp_model()
+        with pytest.raises(ValueError, match="smallest serving bucket"):
+            from jax.sharding import PartitionSpec as P
+            model.set_sharding(mesh6, in_spec=P("data"))
+
+    def test_mesh_wider_than_min_bucket_refused(
+            self, forced_host_device_count):
+        pm, _ = _fitted_pipeline()
+        # a 16-shard data axis could not divide the smallest bucket
+        fake_axes = {"data": 16}
+        try:
+            mesh = SH.serving_mesh(fake_axes)
+        except ValueError:
+            pytest.skip("host exposes exactly 8 virtual devices")
+        with pytest.raises(ValueError, match="MIN_BUCKET"):
+            fuse(pm).shard(mesh)
+
+
+class TestTensorShardedModel:
+    """Tensor parallelism: a model too big for one (simulated) device
+    serving from the mesh."""
+
+    def test_too_big_model_serves_through_engine(
+            self, forced_host_device_count):
+        oracle, model, toks = _tp_model()
+        table = DataTable({"tokens": toks})
+        ref = np.asarray(oracle.transform(table)["scores"])
+        SH.tensor_shard_model(model, SH.serving_mesh({"model": 8}))
+        out = np.asarray(model.transform(table)["scores"])
+        # partitioned contractions reorder float adds: pinned tolerance
+        assert np.allclose(ref, out, atol=1e-5), np.abs(ref - out).max()
+        # the too-big-for-one-device proof: no single device holds the
+        # full weight set
+        max_dev, total = SH.assert_serves_from_mesh(model)
+        assert max_dev < total
+        assert max_dev < 0.5 * total   # 8-way: far below, not epsilon
+
+        # ...and the ENGINE hot path serves it with zero steady-state
+        # recompiles through a swap under live sharded load
+        stage = json_scoring_pipeline(model, field="tokens")
+        example = {"tokens": toks[:2]}
+        stage.warmup(example)
+        source = HTTPSource(port=_free_port())
+        engine = ServingEngine(source, stage, batch_size=16,
+                               tracing=False, slo=False,
+                               flight_recorder=False).start()
+        try:
+            import urllib.request
+
+            def post_one(i):
+                body = json.dumps(
+                    {"tokens": [int(t) for t in toks[i % len(toks)]]}
+                ).encode()
+                req = urllib.request.Request(
+                    source.address, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read())
+
+            for i in range(6):
+                rep = post_one(i)
+                assert rep["prediction"] == int(ref[i % len(toks)
+                                                    ].argmax())
+            misses_before_swap = model.jit_cache_misses
+
+            # swap to a SECOND sharded version (fresh weights) while
+            # requests keep flowing
+            oracle2, model2, _ = _tp_model()
+            SH.tensor_shard_model(model2,
+                                  SH.serving_mesh({"model": 8}))
+            stage2 = json_scoring_pipeline(model2, field="tokens")
+            stop = threading.Event()
+            errors = []
+
+            def load():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        post_one(i)
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(e)
+                    i += 1
+
+            t = threading.Thread(target=load, daemon=True)
+            t.start()
+            res = engine.swap(stage2, "v2", warmup_example=example)
+            stop.set()
+            t.join(timeout=10)
+            assert res.completed, res.reason
+            assert not errors, errors[:3]
+            # zero steady-state recompiles: the OLD model compiled
+            # nothing after the swap started, and the NEW model's
+            # compiles all happened in warmup (before cutover)
+            assert model.jit_cache_misses == misses_before_swap
+            misses_after = model2.jit_cache_misses
+            for i in range(4):
+                post_one(i)
+            assert model2.jit_cache_misses == misses_after
+        finally:
+            engine.stop()
+
+    def test_auto_weight_specs(self, forced_host_device_count):
+        mesh = SH.serving_mesh({"model": 8})
+        weights = {
+            "big": np.zeros((2048, 24), np.float32),   # rows divide
+            "tiny": np.zeros((8,), np.float32),        # under min bytes
+            "odd": np.zeros((2049, 3), np.float32),    # nothing divides
+        }
+        specs = SH.auto_weight_specs(weights, mesh, axis="model")
+        from jax.sharding import PartitionSpec as P
+        assert specs["big"] == P("model", None)
+        assert specs["tiny"] == P()
+        assert specs["odd"] == P()
+
+    def test_batch_size_must_divide_data_axis(
+            self, forced_host_device_count):
+        oracle, model, _ = _tp_model()
+        mesh = SH.serving_mesh()
+        model.set("batchSize", 12)     # 12 % 8 != 0
+        with pytest.raises(ValueError, match="does not divide"):
+            model.set_sharding(mesh)
+
+
+class TestSeqShardedLM:
+    """Sequence parallelism: the Transformer-LM scoring long context
+    through the ring/Ulysses attention collective."""
+
+    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+    def test_lm_parity_pinned(self, forced_host_device_count, impl):
+        # heads divisible by the seq axis (8) — the Ulysses all_to_all
+        # shards heads after the transpose
+        spec = {"type": "transformer", "vocab_size": 256, "dim": 32,
+                "depth": 1, "heads": 8, "max_len": 128,
+                "num_classes": 0, "seq_impl": impl}
+        dense_mod = build_network(spec)
+        seq_mod = build_network({**spec, "seq_axis": "seq"})
+        rng = np.random.default_rng(1)
+        toks = rng.integers(0, 256, size=(8, 64)).astype(np.int32)
+        variables = dense_mod.init(jax.random.PRNGKey(1), toks[:1])
+        lm = SH.seq_shard_lm(seq_mod, variables,
+                             SH.serving_mesh({"seq": 8}),
+                             inputCol="tokens", outputCol="logits",
+                             batchSize=8)
+        table = DataTable({"tokens": toks})
+        out_seq = np.asarray(lm.transform(table)["logits"])
+        dense = TPUModel.from_flax(dense_mod, variables,
+                                   inputCol="tokens",
+                                   outputCol="logits", batchSize=8)
+        out_dense = np.asarray(dense.transform(table)["logits"])
+        # ring/Ulysses reorder the attention reduction: the pinned
+        # serving tolerance for the f32 LM (bf16 would widen it)
+        assert np.allclose(out_seq, out_dense, atol=5e-5), \
+            np.abs(out_seq - out_dense).max()
+
+    def test_wrong_module_refused(self, forced_host_device_count):
+        dense_mod = build_network({"type": "transformer",
+                                   "vocab_size": 64, "dim": 16,
+                                   "depth": 1, "heads": 2,
+                                   "max_len": 32})
+        variables = dense_mod.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 8), np.int32))
+        with pytest.raises(ValueError, match="seq_axis"):
+            SH.seq_shard_lm(dense_mod, variables,
+                            SH.serving_mesh({"seq": 8}))
+
+
+class TestShardedAOT:
+    """Sharded AOT artifacts: export on a mesh, load in a fresh
+    process, serve with zero JIT traces at request time."""
+
+    def test_pipeline_artifact_roundtrip(self, tmp_path,
+                                         forced_host_device_count):
+        pm, table = _fitted_pipeline()
+        fused = SH.data_shard_pipeline(pm, SH.serving_mesh(),
+                                       batch_size=64)
+        ref = np.asarray(fuse(pm).transform(table)["prediction"])
+        example = DataTable({"a": table["a"][:2], "b": table["b"][:2]})
+        art = str(tmp_path / "sharded_pipe")
+        man = AOT.export_model(fused, example, art, version="v1")
+        assert man["sharded"] and man["mesh"] == {"data": 8}
+
+        loaded = AOT.load_model(art)
+        assert loaded.aot and loaded.sharding is not None
+        stage = json_scoring_pipeline(loaded)
+        reqs = [{"entity": json.dumps(
+            {"a": float(table["a"][i]),
+             "b": float(np.nan_to_num(table["b"][i]))}).encode()}
+            for i in range(8)]
+        rt = DataTable({"id": [str(i) for i in range(8)],
+                        "request": reqs})
+        out = stage.transform(rt)
+        got = [r["prediction"] for r in out["reply"]]
+        # parity against the single-device oracle, via the AOT programs
+        # with ZERO jit traces (nan rows re-impute identically)
+        ref_rows = [int(ref[i]) for i in range(8)]
+        assert got == ref_rows
+        assert loaded.jit_cache_misses == 0
+
+    def test_fresh_process_zero_traces_and_coldstart(
+            self, tmp_path, forced_host_device_count):
+        oracle, model, toks = _tp_model()
+        SH.tensor_shard_model(model, SH.serving_mesh({"model": 8}))
+        art = str(tmp_path / "sharded_tp")
+        man = AOT.export_model(model, {"tokens": toks[:2]}, art,
+                               version="v1")
+        assert man["sharded"]
+
+        def run(mode):
+            out = subprocess.run(
+                [sys.executable, "-m", "mmlspark_tpu.serving.aot",
+                 art, "--mode", mode, "--port", str(_free_port())],
+                capture_output=True, text=True, timeout=240,
+                cwd=os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+            assert out.returncode == 0, out.stderr[-2000:]
+            return json.loads(out.stdout.strip().splitlines()[-1])
+
+        aot_res = run("aot")
+        assert aot_res["ok"]
+        # the acceptance bar: a multi-chip replica cold-starts with
+        # zero Python traces — at load AND at request time
+        assert aot_res["jit_traces_total"] == 0
+        assert aot_res["jit_traces_at_request_time"] == 0
+
+    def test_zoo_accepts_sharded_manifest_and_measures_device_cost(
+            self, tmp_path, forced_host_device_count):
+        oracle, model, toks = _tp_model()
+        SH.tensor_shard_model(model, SH.serving_mesh({"model": 8}))
+        art_root = tmp_path / "zoo"
+        art = art_root / "lm" / "v1"
+        AOT.export_model(model, {"tokens": toks[:2]}, str(art),
+                         version="v1")
+        zoo = ModelZoo(artifact_root=str(art_root), memory_probe=None)
+        try:
+            assert zoo.resolve("lm") == "lm@v1"
+            meta = zoo.lookup("lm@v1")[2]
+            assert meta.get("sharded") and meta.get("mesh") == \
+                {"model": 8}
+            zoo.get("lm@v1")       # activate (loader thread)
+            stats = zoo.stats()
+            row = next(r for r in stats["models"]
+                       if r["model"] == "lm")
+            assert row["state"] == "resident"
+            # cost = MEASURED per-device residency summed across the
+            # mesh, not the manifest file bytes
+            meta = zoo.lookup("lm@v1")[2]
+            assert meta["cost_source"] == "device"
+            total_logical = sum(
+                int(np.asarray(a).nbytes) for a in
+                jax.tree_util.tree_leaves(model.get("weights")))
+            # replicated small leaves count once per device, so the
+            # measured mesh-wide residency is at least the logical size
+            assert row["cost_bytes"] >= total_logical
+        finally:
+            zoo.close()
+
+
+class TestFleetStartupProbe:
+    """connect() must tolerate a not-yet-listening engine process."""
+
+    def test_slow_starting_worker_does_not_open_circuit(self):
+        port = _free_port()
+        p = subprocess.Popen(
+            [sys.executable, _WORKER, str(port), "0",
+             "--scorer", "echo", "--start-delay", "2.0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            fleet = ServingFleet.connect(
+                [f"http://127.0.0.1:{port}"],
+                failure_threshold=3, wait_ready_s=60.0,
+                tracing=False)
+            # the startup probe burned NO breaker budget: first post
+            # succeeds and the circuit never opened
+            rep = fleet.post({"x": 1}, timeout=30)
+            assert rep == {"echo": 1, "worker": 0}
+            assert fleet.breakers[0].state == "closed"
+            assert fleet.breakers[0].times_opened == 0
+            assert fleet.transport_errors == 0
+            fleet.post({"__shutdown__": True})
+        finally:
+            p.terminate()
+            p.wait(timeout=30)
+
+    def test_wait_ready_budget_bounded(self):
+        # nothing ever listens: the probe gives up within its budget
+        # instead of hanging, and the fleet still constructs
+        dead = f"http://127.0.0.1:{_free_port()}"
+        t0 = time.monotonic()
+        fleet = ServingFleet.connect([dead], wait_ready_s=1.0,
+                                     tracing=False)
+        assert time.monotonic() - t0 < 10.0
+        assert fleet.breakers[0].state == "closed"
+
+
+class TestMultiProcessFleet:
+    """Real engine processes behind ServingFleet.connect: identical
+    predictions across workers, chaos kill under load."""
+
+    def _spawn_workers(self, n, dim=8):
+        procs, addrs = [], []
+        for wid in range(n):
+            port = _free_port()
+            p = subprocess.Popen(
+                [sys.executable, _WORKER, str(port), str(wid),
+                 "--scorer", "linear", "--dim", str(dim),
+                 "--batch-size", "32"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            procs.append(p)
+            addrs.append(None)
+        for wid, p in enumerate(procs):
+            line = p.stdout.readline().strip()
+            parts = line.split()
+            assert parts and parts[0] == "READY", line
+            addrs[wid] = parts[2]
+        return procs, addrs
+
+    def test_chaos_kill_one_engine_under_columnar_load(self):
+        from mmlspark_tpu.core.trace import Tracer
+        nworkers, dim = 3, 8
+        procs, addrs = self._spawn_workers(nworkers, dim=dim)
+        tracer = Tracer(enabled=True)
+        try:
+            fleet = ServingFleet.connect(addrs, wait_ready_s=60.0,
+                                         failure_threshold=2,
+                                         breaker_cooldown=1.0,
+                                         tracer=tracer, tracing=True)
+            rng = np.random.default_rng(3)
+            rows = rng.normal(size=(4, dim)).astype(np.float32)
+            # every worker computes the same seeded weights: establish
+            # the expected reply once
+            expected = fleet.post_columns({"features": rows})
+            assert len(expected["prediction"]) == 4
+
+            results = {"ok": 0, "failed": 0, "wrong": 0}
+            lock = threading.Lock()
+            stop = threading.Event()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        rep = fleet.post_columns({"features": rows},
+                                                 timeout=30)
+                        ok = rep == expected
+                        with lock:
+                            results["ok" if ok else "wrong"] += 1
+                    except Exception:  # noqa: BLE001
+                        with lock:
+                            results["failed"] += 1
+
+            threads = [threading.Thread(target=client, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(1.0)
+            # SIGKILL one engine process mid-load — the crashed-
+            # process chaos shape, across a REAL process boundary
+            procs[0].send_signal(signal.SIGKILL)
+            time.sleep(3.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            total = sum(results.values())
+            assert total > 20, results
+            availability = results["ok"] / total
+            # the acceptance floor: kill one of three engines under
+            # load, availability holds >= 99% via breaker + failover
+            assert availability >= 0.99, (availability, results)
+            assert results["wrong"] == 0, results
+
+            # one trace id across the surviving legs: some logical
+            # post failed over — its trace holds BOTH the failed leg
+            # and the winning sibling under one trace id
+            traces = tracer.buffer.traces()
+            multi = [tr for tr in traces
+                     if len([s for s in tr.spans()
+                             if s.name == "client.post"]) >= 2]
+            assert multi, "no failover trace captured"
+            tr = multi[0]
+            assert len({s.trace_id for s in tr.spans()}) == 1
+            legs = [s for s in tr.spans() if s.name == "client.post"]
+            assert len({s.attrs.get("address") for s in legs}) >= 2
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=30)
+
+
+class TestShardedAudit:
+    """tools/check_fusion_kernels.py sharded-serving audit."""
+
+    def test_shipped_builders_clean(self):
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import check_fusion_kernels as CK
+        assert CK.check_sharded_serving() == []
+
+    def test_catches_inferred_shardings(self):
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import check_fusion_kernels as CK
+        bad = (
+            "def _jit_sharded(self, donate):\n"
+            "    return jax.jit(fn, donate_argnums=(1,))\n")
+        vs = CK.check_sharded_jit_source("x.py", "_jit_sharded", bad)
+        assert vs and "in_shardings" in vs[0]
+        partial = (
+            "def _jit_sharded(self, donate):\n"
+            "    return jax.jit(fn, in_shardings=(a, b))\n")
+        vs = CK.check_sharded_jit_source("x.py", "_jit_sharded",
+                                         partial)
+        assert vs and "out_shardings" in vs[0]
+        good = (
+            "def _jit_sharded(self, donate):\n"
+            "    return jax.jit(fn, in_shardings=(a, b),\n"
+            "                   out_shardings=c)\n")
+        assert CK.check_sharded_jit_source("x.py", "_jit_sharded",
+                                           good) == []

@@ -220,6 +220,87 @@ def check_ingress_kernels() -> List[str]:
     return violations
 
 
+# ---------------------------------------------------------------------------
+# sharded serving programs (mesh-sharded pjit path — serving/sharded.py)
+# ---------------------------------------------------------------------------
+
+# every function that builds a mesh-sharded serving jit. The contract:
+# each declares BOTH in_shardings and out_shardings explicitly on every
+# jax.jit call inside — sharded programs never infer placement from
+# operands (an inferred sharding silently changes when an input's
+# placement drifts, and the AOT manifest could no longer describe the
+# program it serialized).
+_SHARDED_JIT_SITES = (
+    ("mmlspark_tpu/core/fusion.py", "_jit_sharded"),
+    ("mmlspark_tpu/models/tpu_model.py", "_jit_sharded"),
+)
+
+
+def _is_jax_jit(func) -> bool:
+    return (isinstance(func, ast.Attribute) and func.attr == "jit"
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "jax")
+
+
+def check_sharded_jit_source(site: str, fn_name: str,
+                             src: str) -> List[str]:
+    """Audit ONE sharded-jit builder's source: at least one
+    ``jax.jit`` call, and every such call carries explicit
+    ``in_shardings=`` AND ``out_shardings=`` keywords."""
+    try:
+        tree = ast.parse(textwrap.dedent(src))
+    except SyntaxError:
+        return [f"{site}: unparseable sharded jit builder {fn_name}"]
+    violations: List[str] = []
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+           and n.name == fn_name]
+    if not fns:
+        return [f"{site}: sharded jit builder {fn_name!r} not found"]
+    for fn in fns:
+        jit_calls = 0
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                jit_calls += 1
+                kw = {k.arg for k in node.keywords}
+                missing = {"in_shardings", "out_shardings"} - kw
+                if missing:
+                    violations.append(
+                        f"{site}:{fn_name} (line {node.lineno}): "
+                        f"sharded program jit without explicit "
+                        f"{'/'.join(sorted(missing))} — sharded "
+                        f"serving shardings must be declared, never "
+                        f"inferred")
+        if jit_calls == 0:
+            violations.append(
+                f"{site}:{fn_name}: no jax.jit call found — the "
+                f"sharded builder contract moved; update "
+                f"_SHARDED_JIT_SITES")
+    return violations
+
+
+def check_sharded_serving() -> List[str]:
+    """The sharded-serving audit: (1) every declared sharded-jit
+    builder passes ``check_sharded_jit_source``; (2) the sharded
+    serving kernels (the seq-parallel LM apply; fused-segment kernels
+    are already registered) pass the host-round-trip rules — no
+    ``jax.device_get``/host sync inside a sharded serving kernel."""
+    import mmlspark_tpu.serving.sharded  # noqa: F401 — registers the
+    #                                      seq-LM kernel in the registry
+    violations: List[str] = []
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for rel, fn_name in _SHARDED_JIT_SITES:
+        path = os.path.join(root, rel)
+        try:
+            with open(path) as f:
+                src = f.read()
+        except OSError as e:
+            violations.append(f"{rel}: unreadable ({e})")
+            continue
+        violations.extend(check_sharded_jit_source(rel, fn_name, src))
+    return violations
+
+
 def register_known_callees() -> int:
     """Register the same-repo functions fused kernels CALL (the
     audit's transitive reach): the jitted forest walk and every GBDT
@@ -349,7 +430,10 @@ def register_representative_pipelines() -> int:
 def main() -> int:
     n = register_representative_pipelines()
     n += register_known_callees()
+    sharded_violations = check_sharded_serving()  # also registers the
+    #                                               seq-LM kernel
     violations = check_registered_kernels()
+    violations += sharded_violations
     from mmlspark_tpu.io.columnar import INGRESS_REGISTRY
     n_ingress = len(INGRESS_REGISTRY)
     violations += check_ingress_kernels()
@@ -360,7 +444,9 @@ def main() -> int:
             print("  -", v)
         return 1
     print(f"OK: {n} registered fused kernels, no host round trips; "
-          f"{n_ingress} ingress kernels, no per-row iteration")
+          f"{n_ingress} ingress kernels, no per-row iteration; "
+          f"{len(_SHARDED_JIT_SITES)} sharded jit builders declare "
+          f"explicit shardings")
     return 0
 
 
