@@ -1567,6 +1567,257 @@ def bench_sharded() -> dict:
     }
 
 
+OOC_ROWS = 10_000_000
+OOC_CHUNK = 262_144
+OOC_BUDGET_BYTES = 1_500_000_000    # 1.5 GB host budget for the
+#                                     streamed pass (RSS growth AND
+#                                     tracked bytes) — the materialized
+#                                     path provably exceeds it
+OOC_PREFETCH = 3
+
+
+def bench_ooc() -> dict:
+    """Out-of-core ingest (io/ooc.py + gbdt/sketch.py): a 10M-row
+    Featurize -> StandardScaler -> logistic scoring pass streamed
+    chunk-at-a-time through the fused pipeline under an ENFORCED host
+    memory budget — asserted from both peak-RSS growth and tracked
+    bytes — against the fully-materialized baseline (which provably
+    exceeds the budget); ingest/compute overlap fraction from the
+    ooc phase histograms; mergeable-sketch bin boundaries vs the exact
+    one-shot fit (rank drift + the measured certificate) on a
+    HIGGS-shaped 1M x 28 block; and a sketch-binned chunked GBDT train
+    vs the reservoir-sample path."""
+    import gc
+
+    from mmlspark_tpu.automl.featurize import Featurize
+    from mmlspark_tpu.core import metrics as MC
+    from mmlspark_tpu.core.fusion import fuse
+    from mmlspark_tpu.core.stage import PipelineModel
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.gbdt.binning import BinMapper
+    from mmlspark_tpu.io.ooc import (
+        ChunkedTable, current_rss_bytes, peak_rss_bytes, table_nbytes,
+    )
+    from mmlspark_tpu.models.linear import TPULogisticRegression
+    from mmlspark_tpu.stages.dataprep import StandardScaler
+
+    levels = np.asarray([f"l{i}" for i in range(8)])
+    vocab = np.asarray([f"w{i:02d}" for i in range(64)])
+
+    def make_chunk(i: int, rows: int) -> DataTable:
+        rng = np.random.default_rng(1000 + i)
+        a = rng.normal(size=rows).astype(np.float32)
+        b = np.where(rng.random(rows) < 0.1, np.nan,
+                     rng.normal(size=rows)).astype(np.float32)
+        cat = levels[rng.integers(0, len(levels), rows)].tolist()
+        toks = vocab[rng.integers(0, len(vocab),
+                                  size=(rows, 3))].tolist()
+        return DataTable({"a": a, "b": b, "cat": cat, "toks": toks})
+
+    def factory():
+        done, i = 0, 0
+        while done < OOC_ROWS:
+            rows = min(OOC_CHUNK, OOC_ROWS - done)
+            yield make_chunk(i, rows)
+            done += rows
+            i += 1
+
+    def fresh_source(depth: int = OOC_PREFETCH) -> ChunkedTable:
+        return ChunkedTable.from_generator(factory, num_rows=OOC_ROWS,
+                                           prefetch_depth=depth)
+
+    # -- fit: streaming Featurize + scaler + a sample-fitted model ------
+    print("# ooc: streaming featurize fit ...", flush=True)
+    t0 = time.perf_counter()
+    fz_model = Featurize(featureColumns=["a", "b", "cat", "toks"],
+                         numberOfFeatures=32).fit(fresh_source())
+    fit_wall = time.perf_counter() - t0
+    sample = DataTable.concat([make_chunk(0, OOC_CHUNK),
+                               make_chunk(1, OOC_CHUNK)])
+    feat_sample = fz_model.transform(sample)
+    scaler = StandardScaler(inputCol="features").fit(
+        ChunkedTable.from_table(feat_sample, chunk_rows=OOC_CHUNK))
+    scaled = scaler.transform(feat_sample)
+    rng = np.random.default_rng(0)
+    a_col = np.asarray(sample["a"], np.float64)
+    y = (a_col + rng.normal(scale=0.5, size=len(a_col)) > 0).astype(
+        np.float64)
+    logit = TPULogisticRegression(
+        featuresCol="features", labelCol="label", maxIter=10).fit(
+        scaled.with_column("label", y))
+    fused = fuse([fz_model, scaler, logit], batch_size=OOC_CHUNK)
+
+    # -- streamed pass under the budget --------------------------------
+    print("# ooc: streamed scoring pass ...", flush=True)
+    for h in MC.ooc_histograms().values():
+        h.reset()
+    gc.collect()
+    src = fresh_source()
+    rss_before = current_rss_bytes()
+    peak_before = peak_rss_bytes()
+    t0 = time.perf_counter()
+    rows = 0
+    pred_sum = 0.0
+    first_chunk_pred = None
+    for out in fused.transform_chunked(src):
+        p = np.asarray(out["prediction"])
+        if first_chunk_pred is None:
+            first_chunk_pred = p.copy()
+        rows += len(p)
+        pred_sum += float(p.sum())
+    streamed_wall = time.perf_counter() - t0
+    assert rows == OOC_ROWS
+    streamed_rss_growth = max(peak_rss_bytes(), peak_before) - rss_before
+    streamed_tracked = src.stats.tracked_peak_bytes()
+    phases = {k: h.snapshot() for k, h in MC.ooc_histograms().items()}
+    worker_s = (phases["decode"]["sum"] + phases["prepare"]["sum"]) / 1e3
+    consumer_s = phases["dispatch"]["sum"] / 1e3
+    wait_s = phases["wait"]["sum"] / 1e3
+    overlap = 0.0
+    if min(worker_s, consumer_s) > 0:
+        overlap = max(0.0, min(1.0, (worker_s + consumer_s
+                                     - streamed_wall)
+                               / min(worker_s, consumer_s)))
+    # the 1-core-visible pipelining signal: what fraction of the decode
+    # wall the consumer did NOT block for (the prefetcher ran decode
+    # while the consumer was busy — time-sliced here, truly parallel on
+    # a multi-core/TPU host where `overlap` itself becomes nonzero)
+    decode_hidden = 0.0
+    if phases["decode"]["sum"] > 0:
+        decode_hidden = max(0.0, min(1.0, 1.0 - phases["wait"]["sum"]
+                                     / phases["decode"]["sum"]))
+
+    # the budget holds on BOTH trackers, or the scenario fails loudly
+    assert streamed_tracked < OOC_BUDGET_BYTES, (
+        f"streamed tracked bytes {streamed_tracked} over budget")
+    assert streamed_rss_growth < OOC_BUDGET_BYTES, (
+        f"streamed RSS growth {streamed_rss_growth} over budget")
+
+    # -- materialized baseline (provably over the budget) --------------
+    print("# ooc: materialized baseline ...", flush=True)
+    gc.collect()
+    rss_mat0 = current_rss_bytes()
+    t0 = time.perf_counter()
+    mat = fresh_source(depth=0).materialize()
+    feats_mat = fused.transform(mat)
+    mat_wall = time.perf_counter() - t0
+    mat_pred = np.asarray(feats_mat["prediction"])
+    mat_rss_growth = peak_rss_bytes() - rss_mat0
+    mat_tracked = table_nbytes(mat) + table_nbytes(feats_mat)
+    assert np.array_equal(first_chunk_pred, mat_pred[:OOC_CHUNK]), \
+        "streamed scoring diverged from the materialized oracle"
+    assert mat_tracked > OOC_BUDGET_BYTES, (
+        f"materialized path unexpectedly fit the budget: {mat_tracked}")
+    assert mat_rss_growth > OOC_BUDGET_BYTES, (
+        f"materialized RSS growth under budget: {mat_rss_growth}")
+    pred_match = bool(abs(mat_pred.sum() - pred_sum) < 1e-6 * OOC_ROWS)
+    del mat, feats_mat, mat_pred
+    gc.collect()
+
+    # -- sketch-vs-exact bin boundaries (HIGGS-shaped 1M x 28) ----------
+    print("# ooc: sketch-vs-exact boundaries ...", flush=True)
+    hn, hf = 1_000_000, 28
+    hrng = np.random.default_rng(7)
+    H = hrng.normal(size=(hn, hf)).astype(np.float32)
+    h_chunks = [H[i:i + OOC_CHUNK] for i in range(0, hn, OOC_CHUNK)]
+    t0 = time.perf_counter()
+    m_sketch = BinMapper.fit_streaming(iter(h_chunks), max_bin=255)
+    sketch_fit_wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m_exact = BinMapper.fit(H, max_bin=255, sample_cnt=hn)
+    exact_fit_wall = time.perf_counter() - t0
+    drift = 0.0
+    for j in range(hf):
+        xs = np.sort(H[:, j].astype(np.float64))
+        ca, cb = m_sketch.upper_bounds[j], m_exact.upper_bounds[j]
+        k = min(len(ca), len(cb))
+        ra = np.searchsorted(xs, ca[:k], side="left") / hn
+        rb = np.searchsorted(xs, cb[:k], side="left") / hn
+        drift = max(drift, float(np.max(np.abs(ra - rb))))
+    assert drift <= 2 * m_sketch.sketch_eps + 2.0 / 255, (
+        f"cut drift {drift} exceeds the certificate bound")
+
+    # -- chunked sketch-binned GBDT vs the reservoir-sample path --------
+    # (HIGGS-shaped but shortened: this 1-core container pays ~15s per
+    # boosting iteration at 1M rows — the full-length wall lives in the
+    # higgs scenario; here the comparison is the BINNING path)
+    from mmlspark_tpu.gbdt.booster import train
+    gn = min(hn, 400_000)
+    hy = (H[:gn, 0] + 0.6 * H[:gn, 1] * H[:gn, 2]
+          + hrng.normal(scale=0.7, size=gn) > 0).astype(np.float64)
+
+    def gbdt_factory():
+        for i in range(0, gn, OOC_CHUNK):
+            yield H[i:min(i + OOC_CHUNK, gn)], hy[i:i + OOC_CHUNK]
+
+    gbdt = {"rows": gn, "iterations": 8}
+    for mode in ("sketch", "sample"):
+        print(f"# ooc: gbdt bin_fit={mode} ...", flush=True)
+        params = {"objective": "binary", "num_iterations": 8,
+                  "num_leaves": 63, "max_bin": 63, "seed": 0,
+                  "bin_fit": mode}
+        t0 = time.perf_counter()
+        booster = train(params, gbdt_factory, y=None)
+        wall = time.perf_counter() - t0
+        p = booster.predict(H[:200_000])
+        ys = hy[:200_000]
+        order = np.argsort(p)
+        ranks = np.empty(len(p))
+        ranks[order] = np.arange(len(p))
+        pos = ys == 1
+        auc = ((ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2)
+               / (pos.sum() * (len(p) - pos.sum())))
+        gbdt[mode] = {"train_wall_s": round(wall, 2),
+                      "holdout_auc": round(float(auc), 4)}
+
+    import jax
+    return {
+        "metric": "ooc_streamed_10m_featurize_model",
+        "backend": jax.default_backend(),
+        "rows": OOC_ROWS,
+        "chunk_rows": OOC_CHUNK,
+        "prefetch_depth": OOC_PREFETCH,
+        "budget_bytes": OOC_BUDGET_BYTES,
+        "featurize_fit_streaming_wall_s": round(fit_wall, 2),
+        "streamed": {
+            "wall_s": round(streamed_wall, 2),
+            "rss_growth_bytes": int(streamed_rss_growth),
+            "tracked_peak_bytes": int(streamed_tracked),
+            "under_budget": True,
+            "phase_s": {"decode": round(phases["decode"]["sum"] / 1e3, 2),
+                        "prepare": round(
+                            phases["prepare"]["sum"] / 1e3, 2),
+                        "dispatch": round(consumer_s, 2),
+                        "wait": round(wait_s, 2)},
+            "ingest_compute_overlap_fraction": round(overlap, 3),
+            "decode_hidden_fraction": round(decode_hidden, 3),
+        },
+        "materialized": {
+            "wall_s": round(mat_wall, 2),
+            "rss_growth_bytes": int(mat_rss_growth),
+            "tracked_bytes": int(mat_tracked),
+            "over_budget": True,
+            "prediction_sum_matches": pred_match,
+        },
+        "streamed_vs_materialized_wall": round(
+            mat_wall / max(streamed_wall, 1e-9), 3),
+        "sketch_binning_1m_x28": {
+            "sketch_eps_certificate": round(m_sketch.sketch_eps, 6),
+            "max_cut_rank_drift_vs_exact": round(drift, 6),
+            "bound_2eps": round(2 * m_sketch.sketch_eps, 6),
+            "fit_streaming_wall_s": round(sketch_fit_wall, 2),
+            "fit_exact_wall_s": round(exact_fit_wall, 2),
+            "f32_cuts_exact": bool(m_sketch.f32_cuts_exact),
+        },
+        "gbdt_chunked_1m_x28_8iter": gbdt,
+        "notes": ("CPU container, single usable core: overlap is "
+                  "bounded by the decode thread and XLA's compute "
+                  "threads timesharing one core — the phase sums and "
+                  "the budget assertions are the point; a TPU host "
+                  "overlaps host decode with device compute for real"),
+    }
+
+
 FLEET_PROCS = 4
 FLEET_LOAD_S = 10.0
 FLEET_CLIENTS = 16
@@ -1733,6 +1984,7 @@ SCENARIOS = {
     "sharded": lambda: ("secondary_sharded", bench_sharded()),
     "fleet_procs": lambda: ("secondary_fleet_procs",
                             bench_fleet_procs()),
+    "ooc": lambda: ("secondary_ooc", bench_ooc()),
 }
 
 
@@ -1743,8 +1995,8 @@ def main():
         "--scenarios", default="all",
         help="comma list from {cifar,resnet,lm,higgs,serving,swap,"
              "automl,pipeline,observability,quant,coldstart,ingress,"
-             "zoo,sharded,fleet_procs} or 'all' (the full flagship "
-             "bench)")
+             "zoo,sharded,fleet_procs,ooc} or 'all' (the full "
+             "flagship bench)")
     args = ap.parse_args()
     if args.scenarios != "all":
         if "sharded" in args.scenarios.split(",") and \

@@ -43,6 +43,10 @@ def __getattr__(name):
     if name in _FUSION_EXPORTS:
         from mmlspark_tpu.core import fusion
         return getattr(fusion, name)
+    if name == "ChunkedTable":
+        # jax-free, but lazy keeps the root import surface minimal
+        from mmlspark_tpu.io.ooc import ChunkedTable
+        return ChunkedTable
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -62,6 +66,7 @@ __all__ = [
     "PipelineModel",
     "load_stage",
     "Param",
+    "ChunkedTable",
     "DeviceOp",
     "DeviceTable",
     "FusedPipelineModel",

@@ -43,6 +43,31 @@ from mmlspark_tpu.stages.text import (
 _NUMERIC_TAGS = {F32, F64, I8, I16, I32, I64, BOOL}
 
 
+def _column_spec(c: str, f: Field, *, one_hot: bool, hash_width: int,
+                 sparse: bool, mean: float,
+                 levels: Optional[List[Any]]) -> Optional[Dict[str, Any]]:
+    """THE per-column spec switch, shared by the in-memory and
+    streaming fits — only where ``mean``/``levels`` come from differs
+    between them, so the two paths cannot drift. Returns None for
+    unsupported tags (struct/bytes/object), which both fits skip like
+    the reference drops unsupported columns."""
+    if f.tag in _NUMERIC_TAGS:
+        if f.meta.get("categorical") and one_hot:
+            n = len(f.meta.get("levels") or [])
+            return {"col": c, "kind": "onehot", "size": n}
+        return {"col": c, "kind": "numeric", "fill": mean}
+    if f.tag == STRING:
+        if one_hot:
+            return {"col": c, "kind": "string_onehot", "levels": levels}
+        return {"col": c, "kind": "string_index", "levels": levels}
+    if f.tag == LIST:
+        return {"col": c, "kind": "hash", "size": hash_width,
+                "sparse": sparse}
+    if f.tag == VECTOR:
+        return {"col": c, "kind": "vector"}
+    return None
+
+
 def _distinct_levels(col) -> List[Any]:
     """Non-None distinct values of a string column, sorted when
     comparable — the vectorized fit-side level scan. String columns with
@@ -108,6 +133,10 @@ class Featurize(Estimator):
         return [self.get("outputCol")]
 
     def fit(self, table: DataTable) -> "FeaturizeModel":
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                return self._fit_streaming(table)
         t0 = time.perf_counter()
         cols = self.get_or_none("featureColumns")
         if cols is None:
@@ -116,33 +145,20 @@ class Featurize(Estimator):
         specs: List[Dict[str, Any]] = []
         for c in cols:
             f = table.schema[c]
+            mean = 0.0
+            levels: Optional[List[Any]] = None
             if f.tag in _NUMERIC_TAGS:
                 col = np.asarray(table[c], dtype=np.float64)
                 finite = col[np.isfinite(col)]
                 mean = float(finite.mean()) if finite.size else 0.0
-                if f.meta.get("categorical") and \
-                        self.get("oneHotEncodeCategoricals"):
-                    n = len(f.meta.get("levels") or [])
-                    specs.append({"col": c, "kind": "onehot", "size": n})
-                else:
-                    specs.append({"col": c, "kind": "numeric",
-                                  "fill": mean})
             elif f.tag == STRING:
                 levels = _distinct_levels(table[c])
-                if self.get("oneHotEncodeCategoricals"):
-                    specs.append({"col": c, "kind": "string_onehot",
-                                  "levels": levels})
-                else:
-                    specs.append({"col": c, "kind": "string_index",
-                                  "levels": levels})
-            elif f.tag == LIST:
-                specs.append({"col": c, "kind": "hash",
-                              "size": self._hash_width(),
-                              "sparse": self.get("sparse")})
-            elif f.tag == VECTOR:
-                specs.append({"col": c, "kind": "vector"})
-            # other tags (struct/bytes/object) are skipped, like the
-            # reference drops unsupported columns
+            spec = _column_spec(
+                c, f, one_hot=self.get("oneHotEncodeCategoricals"),
+                hash_width=self._hash_width(),
+                sparse=self.get("sparse"), mean=mean, levels=levels)
+            if spec is not None:
+                specs.append(spec)
         MC.automl_histograms()["featurize_fit"].observe(
             (time.perf_counter() - t0) * 1e3)
         from mmlspark_tpu.core.trace import get_tracer
@@ -151,6 +167,64 @@ class Featurize(Estimator):
                                  "specs": len(specs)})
         return FeaturizeModel(specs=specs,
                               outputCol=self.get("outputCol"))
+
+    def _fit_streaming(self, chunked) -> "FeaturizeModel":
+        """One bounded-memory pass over a ChunkedTable: every fit
+        statistic is streaming/mergeable — numeric impute means from
+        per-chunk finite sums (f64), string levels from per-chunk
+        distinct-set unions (same sorted-when-comparable discipline as
+        ``_distinct_levels``), everything else from the schema. The
+        resulting specs match the in-memory ``fit`` on the same rows
+        (means to f64 summation order)."""
+        t0 = time.perf_counter()
+        schema = chunked.schema
+        out_col = self.get("outputCol")
+        cols = self.get_or_none("featureColumns")
+        if cols is None:
+            cols = [c for c in schema.names if c != out_col]
+        sums: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        levels: Dict[str, Dict[Any, None]] = {}
+        num_cols = [c for c in cols if schema[c].tag in _NUMERIC_TAGS]
+        str_cols = [c for c in cols if schema[c].tag == STRING]
+        n_chunks = 0
+        for chunk in chunked.chunks():
+            n_chunks += 1
+            for c in num_cols:
+                col = np.asarray(chunk[c], dtype=np.float64)
+                finite = col[np.isfinite(col)]
+                sums[c] = sums.get(c, 0.0) + float(finite.sum())
+                counts[c] = counts.get(c, 0) + int(finite.size)
+            for c in str_cols:
+                seen = levels.setdefault(c, {})
+                for v in _distinct_levels(chunk[c]):
+                    seen.setdefault(v, None)
+        specs: List[Dict[str, Any]] = []
+        for c in cols:
+            f = schema[c]
+            mean = (sums.get(c, 0.0) / counts[c]
+                    if counts.get(c) else 0.0)
+            lv: Optional[List[Any]] = None
+            if f.tag == STRING:
+                lv = list(levels.get(c, {}).keys())
+                try:
+                    lv = sorted(lv)
+                except TypeError:
+                    pass
+            spec = _column_spec(
+                c, f, one_hot=self.get("oneHotEncodeCategoricals"),
+                hash_width=self._hash_width(),
+                sparse=self.get("sparse"), mean=mean, levels=lv)
+            if spec is not None:
+                specs.append(spec)
+        MC.automl_histograms()["featurize_fit"].observe(
+            (time.perf_counter() - t0) * 1e3)
+        from mmlspark_tpu.core.trace import get_tracer
+        get_tracer().emit("automl.featurize_fit", t0,
+                          attrs={"columns": len(cols),
+                                 "specs": len(specs),
+                                 "chunks": n_chunks})
+        return FeaturizeModel(specs=specs, outputCol=out_col)
 
 
 def _spec_width(spec: Dict[str, Any], table: DataTable) -> int:
@@ -401,6 +475,13 @@ class FeaturizeModel(Model):
         # all parts float32: device stages consume f32/bf16 anyway, and a
         # single float64 part would upcast the whole concatenate (doubling
         # the wide hashed block's footprint)
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                # spill-aware transform: a lazy per-chunk map — the
+                # (N, D) features matrix only ever exists chunk-sized
+                return table.map(self.transform,
+                                 label=f"{table.label}|featurize")
         t0 = time.perf_counter()
         specs = self.get("specs") or []
         if any(s["kind"] == "hash" and s.get("sparse") for s in specs):

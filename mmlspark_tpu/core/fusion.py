@@ -858,6 +858,99 @@ class FusionPlan:
                               if end_idx < len(self.needed) else None)
         return cur
 
+    def execute_chunked(self, chunk_iter, prefetch_depth: int = 2):
+        """Run the plan chunk-at-a-time over an iterator of DataTable
+        chunks, OVERLAPPING host ingest with device compute: a prefetch
+        worker (utils/prefetch) runs each chunk's host prefix stages,
+        Feed kernels (string codes / token hashing) and H2D enqueue —
+        everything up to the first fused segment's dispatch — while the
+        consumer thread dispatches + fetches the PREVIOUS chunk's
+        program. Per-chunk walls land in the ``ooc_ingest_phase_ms``
+        phases (prepare = worker side, wait = consumer blocked time,
+        dispatch = consumer side); yields one output DataTable per
+        chunk, so peak host residency is the chunks in flight, never
+        the whole table. Mesh-sharded plans keep the worker HOST-ONLY
+        (feeds/stages but no device_put): their dispatches carry
+        collectives, and a worker-thread H2D racing a collective can
+        starve XLA's in-process rendezvous on small CPU hosts."""
+        from mmlspark_tpu.core import metrics as MC
+        hists = MC.ooc_histograms()
+        # a mesh-sharded plan's dispatch carries collectives: a
+        # worker-thread device_put racing them can starve XLA's
+        # in-process rendezvous on small CPU hosts (the documented
+        # SyncPrefetcher hazard) — so only UNSHARDED plans enqueue H2D
+        # from the worker; sharded plans keep the worker host-only and
+        # ship on the consumer thread
+        worker_ships = self.sharding is None
+
+        def prepare(table: DataTable):
+            t0 = time.perf_counter()
+            cur = table
+            pos = 0
+            env = consts = None
+            while pos < len(self.steps):
+                step = self.steps[pos]
+                end_idx = self.step_boundaries[pos]
+                if not isinstance(step, _HostStep):
+                    if worker_ships:
+                        env = step.build_env(cur, self.device_table)
+                        consts = step.consts_list(self.device_table)
+                    break
+                cur = step.stage.transform(cur)
+                cur = prune_table(cur, self.needed[end_idx]
+                                  if end_idx < len(self.needed)
+                                  else None)
+                pos += 1
+            hists["prepare"].observe((time.perf_counter() - t0) * 1e3)
+            return cur, pos, env, consts
+
+        def finish(cur: DataTable, pos: int, env, consts) -> DataTable:
+            for i in range(pos, len(self.steps)):
+                step = self.steps[i]
+                end_idx = self.step_boundaries[i]
+                if isinstance(step, _HostStep):
+                    cur = step.stage.transform(cur)
+                else:
+                    if env is None:   # segments after the first
+                        env = step.build_env(cur, self.device_table)
+                        consts = step.consts_list(self.device_table)
+                    out = step.compiled(donate=False)(consts, env)
+                    cur = self._materialize(cur, step, out)
+                    env = consts = None
+                cur = prune_table(cur, self.needed[end_idx]
+                                  if end_idx < len(self.needed)
+                                  else None)
+            return cur
+
+        if prefetch_depth <= 0:
+            for chunk in chunk_iter:
+                cur, pos, env, consts = prepare(chunk)
+                t1 = time.perf_counter()
+                result = finish(cur, pos, env, consts)
+                hists["dispatch"].observe(
+                    (time.perf_counter() - t1) * 1e3)
+                yield result
+            return
+
+        from mmlspark_tpu.utils.prefetch import ThreadedPrefetcher
+        feed = ThreadedPrefetcher(chunk_iter, prepare,
+                                  depth=prefetch_depth)
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    cur, pos, env, consts = next(feed)
+                except StopIteration:
+                    return
+                t1 = time.perf_counter()
+                hists["wait"].observe((t1 - t0) * 1e3)
+                result = finish(cur, pos, env, consts)
+                hists["dispatch"].observe(
+                    (time.perf_counter() - t1) * 1e3)
+                yield result
+        finally:
+            feed.close()
+
     def _execute_segment_staged(self, table: DataTable,
                                 segment: FusedSegment) -> DataTable:
         """One op at a time with a FULL host round trip between ops —
@@ -1009,6 +1102,51 @@ class FusedPipelineModel:
         (one dispatch + host round trip per stage) — bit-identical to
         ``transform``; what the fused speedup is measured against."""
         return self.plan_for(table.schema).execute(table, staged=True)
+
+    def transform_chunked(self, chunked,
+                          prefetch_depth: Optional[int] = None):
+        """Out-of-core transform: run a ``io.ooc.ChunkedTable`` through
+        the fused plan chunk-at-a-time (``FusionPlan.execute_chunked``
+        — host decode/feeds of chunk k+1 overlap device compute of
+        chunk k on a prefetch worker). Returns a lazy ChunkedTable of
+        transformed chunks, bit-identical per chunk to
+        ``transform(chunk)``; nothing materializes the whole table.
+        ``prefetch_depth`` defaults to the source's depth knob."""
+        from mmlspark_tpu.io.ooc import ChunkedTable
+        if not isinstance(chunked, ChunkedTable):
+            raise TypeError(
+                "transform_chunked expects an io.ooc.ChunkedTable; "
+                "use transform() for in-memory DataTables")
+        depth = (chunked.prefetch_depth if prefetch_depth is None
+                 else max(0, int(prefetch_depth)))
+        model = self
+        in_schema = chunked.schema
+        try:
+            out_schema: Optional[Schema] = \
+                self.transform_schema(in_schema)
+        except Exception:  # noqa: BLE001 — schema-opaque stage: peek
+            out_schema = None
+
+        def factory():
+            # re-resolve per pass: a stage mutation between passes must
+            # hit the epoch-keyed plan cache, not a stale plan
+            plan = model.plan_for(chunked.schema)
+            it = chunked.chunks(prefetch_depth=0)
+            # the raw source records depth 0, but execute_chunked's OWN
+            # prefetcher holds `depth` prepared chunks in flight — put
+            # the effective depth on the source stats so its
+            # tracked_peak_bytes() bounded-memory certificate counts
+            # every buffered chunk
+            chunked.stats.depth = max(chunked.stats.depth, depth)
+            return plan.execute_chunked(it, prefetch_depth=depth)
+
+        # the inner pipeline already prefetches; depth 0 on the result
+        # avoids a third buffering layer when callers iterate it
+        return ChunkedTable(factory, schema=out_schema,
+                            num_rows=chunked.num_rows,
+                            prefetch_depth=0,
+                            label=f"{chunked.label}|fused",
+                            instrument=False)
 
     def transform_schema(self, schema: Schema) -> Schema:
         for stage in self.stages:

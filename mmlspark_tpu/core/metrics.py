@@ -556,6 +556,29 @@ def ingress_decode_histograms() -> Dict[str, LatencyHistogram]:
 
 
 # ---------------------------------------------------------------------------
+# out-of-core ingest phase histograms (io/ooc.py)
+# ---------------------------------------------------------------------------
+
+# per-chunk wall milliseconds of the chunked ingest pipeline: decode
+# (source read — Arrow IPC batch / mmap slice / generator build, on the
+# prefetch worker), prepare (host prefix stages + fused-feed kernels +
+# H2D enqueue of the next chunk, also on the worker), wait (how long
+# the consumer actually BLOCKED on the prefetch queue — near-zero when
+# ingest fully hides behind compute), dispatch (consumer-side fused
+# dispatch + fetch + trailing host stages per chunk). The overlap
+# fraction the out-of-core benches report is computed from these:
+# worker-side wall + consumer-side wall vs the measured end-to-end
+# wall (docs/out_of_core.md).
+OOC_PHASES = ("decode", "prepare", "wait", "dispatch")
+_OOC_HISTS: Dict[str, LatencyHistogram] = histogram_set(*OOC_PHASES)
+
+
+def ooc_histograms() -> Dict[str, LatencyHistogram]:
+    """The process-wide out-of-core ingest phase histogram family."""
+    return _OOC_HISTS
+
+
+# ---------------------------------------------------------------------------
 # feature-drift counters (serving-time vs fit-time statistics)
 # ---------------------------------------------------------------------------
 

@@ -147,6 +147,10 @@ def process_families(r: PromRenderer, tracer: Any = None) -> None:
         r.histogram("pipeline_fusion_phase_ms",
                     "fused-pipeline per-phase wall milliseconds "
                     "(core/fusion.py)", hist, {"phase": phase})
+    for phase, hist in MC.ooc_histograms().items():
+        r.histogram("ooc_ingest_phase_ms",
+                    "out-of-core chunked ingest per-phase wall "
+                    "milliseconds (io/ooc.py)", hist, {"phase": phase})
     for phase, hist in MC.ingress_histograms().items():
         r.histogram("serving_ingress_phase_ms",
                     "serving ingress per-phase wall milliseconds "

@@ -304,6 +304,14 @@ class PipelineModel(Model):
         # with_column copy. Output is IDENTICAL — only columns that
         # could never reach the final table are pruned. For fused
         # device execution of the same stages, see ``fused()``.
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                # out-of-core: lazy per-chunk walk (fused chunked
+                # execution with ingest/compute overlap lives on
+                # FusedPipelineModel.transform_chunked)
+                return table.map(self.transform,
+                                 label=f"{table.label}|pipeline")
         from mmlspark_tpu.core.fusion import column_liveness, prune_table
         stages = self.get_stages()
         # single-entry liveness cache: the walk is constant for a fixed

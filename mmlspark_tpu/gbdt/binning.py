@@ -82,6 +82,26 @@ def _fanout_feature_blocks(run, j0: int, j1: int, n_rows: int,
         run(j0, j1)
 
 
+def _chunk_matrix(chunk) -> np.ndarray:
+    """Coerce one stream element to a raw (N, F) feature block:
+    ndarray passes through, ``(X, y[, w])`` shard tuples take X, and
+    DataTable-likes densify their features column via the shared
+    ``features_matrix`` coercion (per-CHUNK — never the whole table)."""
+    if isinstance(chunk, np.ndarray):
+        X = chunk
+    elif isinstance(chunk, (tuple, list)):
+        X = np.asarray(chunk[0])
+    else:
+        from mmlspark_tpu.core.table import DataTable, features_matrix
+        if isinstance(chunk, DataTable):
+            X = features_matrix(chunk, "features")
+        else:
+            X = np.asarray(chunk)
+    if X.ndim != 2:
+        raise ValueError(f"chunk must be 2-D (N, F); got shape {X.shape}")
+    return X
+
+
 class BinMapper:
     """Per-feature quantile bin boundaries.
 
@@ -109,6 +129,9 @@ class BinMapper:
         # there, but training bins must be reproducible across ingest
         # paths).
         self.f32_cuts_exact = bool(f32_cuts_exact)
+        # measured rank-error certificate when the boundaries came from
+        # a streaming sketch fit (fit_streaming); 0.0 = exact fit
+        self.sketch_eps = 0.0
 
     @property
     def num_features(self) -> int:
@@ -161,6 +184,71 @@ class BinMapper:
                 bounds, ((j, hold[:, j]) for j in range(f)))
         return BinMapper(bounds, max_bin, f32_values_safe=safe,
                          f32_cuts_exact=f32_exact)
+
+    @staticmethod
+    def fit_streaming(chunks, max_bin: int = 255, b: int = 512,
+                      sketches: Optional[List] = None) -> "BinMapper":
+        """Fit bin boundaries in ONE bounded-memory pass over a chunk
+        stream — the out-of-core / distributed analog of ``fit`` (which
+        must see a full (N, F) matrix at once).
+
+        ``chunks`` yields raw feature blocks: (N, F) ndarrays, ``(X,
+        y[, w])`` shard tuples (the booster's streaming shape), or
+        DataTable-likes exposing a 2-D ``features`` array are all
+        accepted via ``_chunk_matrix``. Each feature accumulates into a
+        mergeable :class:`~mmlspark_tpu.gbdt.sketch.QuantileSketch`
+        (GK/Chen-&-Guestrin-style summary, O(b·log n) memory); cuts
+        come from the merged summary's equal-frequency walk, which is
+        BIT-IDENTICAL to ``fit`` while the sketches stay exact (small/
+        single-chunk data); otherwise every cut's rank sits within
+        2 × the measured rank-error certificate of its equal-frequency
+        target (certificate exposed as ``mapper.sketch_eps``; the 2×
+        comes from cuts landing at gap midpoints — see
+        ``QuantileSketch.cuts``).
+
+        Multi-host data-parallel fits pass per-host ``sketches`` lists
+        (already merged across hosts — see
+        ``booster._multihost_sketch_mapper``) instead of a chunk
+        stream, so hosts agree on boundaries by exchanging sketches,
+        never rows.
+
+        f32 discipline matches ``fit``: an all-float32 stream gets
+        f32-SNAPPED cuts (``_snap_cuts_f32``), keeping the on-device
+        bucketize path eligible (``f32_cuts_exact``); any f64 chunk
+        keeps conservative f64 host binning."""
+        from mmlspark_tpu.gbdt.sketch import QuantileSketch
+        f32_exact = True
+        if sketches is None:
+            sketches = []
+            seen = False
+            for chunk in chunks:
+                X = _chunk_matrix(chunk)
+                if not sketches:
+                    sketches = [QuantileSketch(b=b)
+                                for _ in range(X.shape[1])]
+                elif X.shape[1] != len(sketches):
+                    raise ValueError(
+                        f"chunk has {X.shape[1]} features; expected "
+                        f"{len(sketches)}")
+                seen = True
+                f32_exact = f32_exact and X.dtype == np.float32
+                for j, sk in enumerate(sketches):
+                    sk.update(X[:, j])
+            if not seen:
+                raise ValueError("empty chunk stream")
+        else:
+            f32_exact = False   # merged/wire sketches carry no dtype
+        bounds: List[np.ndarray] = []
+        for sk in sketches:
+            cut = sk.cuts(max_bin)
+            bounds.append(_snap_cuts_f32(cut)
+                          if f32_exact and len(cut) else cut)
+        mapper = BinMapper(bounds, max_bin, f32_values_safe=f32_exact,
+                           f32_cuts_exact=f32_exact)
+        # the measured rank-error certificate of the fit (0.0 = exact)
+        mapper.sketch_eps = max((sk.eps() for sk in sketches),
+                                default=0.0)
+        return mapper
 
     @staticmethod
     def fit_sparse(csr, max_bin: int = 255, sample_cnt: int = 200_000,
@@ -405,14 +493,17 @@ class BinMapper:
         return {"max_bin": self.max_bin,
                 "f32_values_safe": self.f32_values_safe,
                 "f32_cuts_exact": self.f32_cuts_exact,
+                "sketch_eps": self.sketch_eps,
                 "upper_bounds": [u.tolist() for u in self.upper_bounds]}
 
     @staticmethod
     def from_json(d: dict) -> "BinMapper":
-        return BinMapper([np.asarray(u) for u in d["upper_bounds"]],
-                         d["max_bin"],
-                         f32_values_safe=d.get("f32_values_safe", False),
-                         f32_cuts_exact=d.get("f32_cuts_exact", False))
+        m = BinMapper([np.asarray(u) for u in d["upper_bounds"]],
+                      d["max_bin"],
+                      f32_values_safe=d.get("f32_values_safe", False),
+                      f32_cuts_exact=d.get("f32_cuts_exact", False))
+        m.sketch_eps = float(d.get("sketch_eps", 0.0))
+        return m
 
 
 # ---------------------------------------------------------------------------

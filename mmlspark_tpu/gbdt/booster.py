@@ -82,6 +82,15 @@ DEFAULTS: Dict[str, Any] = {
     # host binning; 'on' asks for device binning and warns (falling
     # back) when ineligible
     "device_binning": "auto",
+    # how streaming/multi-host ingest fits bin boundaries: 'sample' =
+    # the reservoir-sample-then-fit discipline (LightGBM
+    # bin_construct_sample_cnt analog; boundaries from <=200k rows);
+    # 'sketch' = BinMapper.fit_streaming — a mergeable quantile sketch
+    # sees EVERY row in one bounded-memory pass (Chen & Guestrin §3.3 /
+    # GK), and multi-host fits agree by exchanging per-host sketches
+    # instead of gathering sample rows. Dense in-memory input ignores
+    # this (one-shot fit sees everything already).
+    "bin_fit": "sample",
     # keep the device-resident training state (binned matrix, running
     # scores, forest buffer) on the returned Booster so
     # boost_more(data=None) continues boosting EXACTLY where train()
@@ -507,16 +516,77 @@ def _reservoir_rows(shard_iter, cap: int, seed: int) -> np.ndarray:
     return buf
 
 
+def _multihost_sketch_mapper(X, streaming: bool, max_bin: int,
+                             nproc: int) -> BinMapper:
+    """Distributed bin-boundary agreement WITHOUT gathering rows: each
+    host folds its LOCAL data into per-feature mergeable quantile
+    sketches (gbdt/sketch.py), the fixed-shape sketch summaries are
+    allgathered bit-exactly (f64 as uint32 pairs, like the row wire
+    below), and every host merges the SAME per-host summaries in
+    process order — so all hosts derive identical cuts from statistics
+    of EVERY row, at O(F · width) wire bytes instead of O(sample · F)
+    rows (the Chen & Guestrin §3.3 distributed-sketch recipe)."""
+    from jax.experimental import multihost_utils
+    from mmlspark_tpu.gbdt.sketch import QuantileSketch
+    from mmlspark_tpu.core.sparse import CSRMatrix
+    wire_width = 512
+    sketches: List[QuantileSketch] = []
+
+    def absorb(block: np.ndarray) -> None:
+        block = np.asarray(block)
+        if not sketches:
+            sketches.extend(QuantileSketch()
+                            for _ in range(block.shape[1]))
+        for j, sk in enumerate(sketches):
+            sk.update(block[:, j])
+
+    if streaming:
+        if not (isinstance(X, (list, tuple)) or callable(X)):
+            raise ValueError(
+                "multi-host streaming GBDT needs a replayable shard "
+                "sequence (list or zero-arg factory), not a one-shot "
+                "generator: bin boundaries must be agreed across hosts "
+                "before any shard is binned")
+        fac = X if callable(X) else (lambda: iter(X))
+        for shard in fac():
+            absorb(shard[0])
+    elif isinstance(X, CSRMatrix):
+        # bounded densification (the CSR fit path keeps no dense copy)
+        step = max(1, (64 << 20) // max(1, X.shape[1] * 8))
+        for i in range(0, X.shape[0], step):
+            absorb(X.take(np.arange(i, min(i + step, X.shape[0])))
+                   .toarray())
+        if not sketches:
+            absorb(np.empty((0, X.shape[1])))
+    else:
+        absorb(np.asarray(X))
+    wire = np.stack([sk.to_wire(wire_width) for sk in sketches])
+    as_u32 = np.ascontiguousarray(wire, dtype=np.float64).view(np.uint32)
+    gathered = np.ascontiguousarray(np.asarray(
+        multihost_utils.process_allgather(as_u32)))
+    gathered = gathered.reshape(nproc, *as_u32.shape).view(np.float64)
+    merged = [QuantileSketch.from_wire(gathered[0, j])
+              for j in range(len(sketches))]
+    for h in range(1, nproc):
+        for j, sk in enumerate(merged):
+            sk.merge(QuantileSketch.from_wire(gathered[h, j]))
+    return BinMapper.fit_streaming([], max_bin=max_bin, sketches=merged)
+
+
 def _multihost_mapper(X, streaming: bool, max_bin: int, seed: int,
-                      nproc: int) -> BinMapper:
+                      nproc: int, bin_fit: str = "sample") -> BinMapper:
     """Identical bin boundaries on every host: each host reservoir- or
     choice-samples its LOCAL shard, the samples are allgathered, and
     every host fits the SAME mapper on the gathered rows — the
     distributed BinMapper agreement LightGBM reaches inside its native
     allreduce ring (ref: TrainUtils.scala:207 LGBM_NetworkInit +
-    LGBM_DatasetCreateFromMat)."""
+    LGBM_DatasetCreateFromMat). With ``bin_fit='sketch'`` hosts instead
+    exchange mergeable quantile-sketch summaries built over ALL their
+    rows (``_multihost_sketch_mapper``) — no row ever crosses hosts."""
     from jax.experimental import multihost_utils
     from mmlspark_tpu.core.sparse import CSRMatrix
+    if bin_fit == "sketch":
+        return _multihost_sketch_mapper(X, streaming, max_bin, nproc)
     cap = max(1000, _RESERVOIR_CAP // nproc)
     rng = np.random.default_rng(seed)
     if streaming:
@@ -559,18 +629,23 @@ def _multihost_mapper(X, streaming: bool, max_bin: int, seed: int,
 
 
 def _bin_stream(shards, max_bin: int, seed: int,
-                mapper: Optional[BinMapper] = None):
+                mapper: Optional[BinMapper] = None,
+                bin_fit: str = "sample"):
     """Streaming ingestion: ``shards`` yields (X, y[, w]) tuples; only
     the int32 binned matrix is retained on host, so the raw floats never
     need to fit in RAM at once.
 
     Bin-boundary fidelity (LightGBM samples across the WHOLE dataset):
-    replayable inputs (list/tuple or zero-arg factory) get an exact
-    two-pass treatment — reservoir-sample all shards, fit, then bin.
-    One-shot generators can only be binned with boundaries from the
-    first shard; a reservoir accumulated alongside then MEASURES the
-    drift a skewed shard order introduced and warns loudly when the
-    first-shard boundaries disagree with full-stream boundaries."""
+    replayable inputs (list/tuple or zero-arg factory) get a two-pass
+    treatment — with ``bin_fit='sample'`` reservoir-sample all shards
+    then fit; with ``bin_fit='sketch'`` run ``BinMapper.fit_streaming``
+    so the mergeable quantile sketch sees EVERY row (boundaries within
+    the sketch's measured rank-error certificate of an all-rows exact
+    fit, instead of exact-on-a-200k-sample) — then bin. One-shot
+    generators can only be binned with boundaries from the first shard;
+    a reservoir accumulated alongside then MEASURES the drift a skewed
+    shard order introduced and warns loudly when the first-shard
+    boundaries disagree with full-stream boundaries."""
     replayable = isinstance(shards, (list, tuple)) or callable(shards)
     factory = (shards if callable(shards)
                else (lambda: iter(shards)) if replayable else None)
@@ -578,6 +653,10 @@ def _bin_stream(shards, max_bin: int, seed: int,
     forced = mapper is not None
     if forced:
         stream = factory() if replayable else shards
+    elif replayable and bin_fit == "sketch":
+        mapper = BinMapper.fit_streaming(
+            (s[0] for s in factory()), max_bin=max_bin)
+        stream = factory()
     elif replayable:
         sample = _reservoir_rows(factory(), _RESERVOIR_CAP, seed)
         mapper = BinMapper.fit(sample, max_bin=max_bin, seed=seed)
@@ -726,6 +805,16 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # carefully so dense list-of-lists and mislabeled generators get a
     # clear error instead of a confusing unpack/object-cast failure.
     from mmlspark_tpu.core.sparse import CSRMatrix as _CSRMatrix
+    from mmlspark_tpu.io.ooc import ChunkedTable as _ChunkedTable
+    if isinstance(X, _ChunkedTable):
+        # out-of-core ingest (io/ooc.py): chunks carry features+label
+        # columns; adapt to the replayable (X, y) shard-factory shape.
+        # Chunk decode runs on the source's prefetch worker.
+        if y is not None:
+            raise ValueError(
+                "pass labels inside the ChunkedTable (label column), "
+                "not as a separate y")
+        X = X.as_xy()
     streaming = y is None and not isinstance(X, (np.ndarray, _CSRMatrix))
     if streaming and isinstance(X, (list, tuple)):
         try:
@@ -772,7 +861,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             "model on its local data. Use parallelism='data' for one "
             "globally-trained forest.", proc_info.process_count)
     forced_mapper = (_multihost_mapper(
-        X, streaming, p["max_bin"], p["seed"], proc_info.process_count)
+        X, streaming, p["max_bin"], p["seed"], proc_info.process_count,
+        bin_fit=p["bin_fit"])
         if multi_host else None)
     if bin_mapper is not None:
         if multi_host or multi_host_fp:
@@ -790,7 +880,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
             # fail fast — before consuming the (possibly huge) stream
             raise ValueError("init_model warm start requires dense X")
         mapper, bins_np, y, w_base = _bin_stream(
-            X, p["max_bin"], p["seed"], mapper=forced_mapper)
+            X, p["max_bin"], p["seed"], mapper=forced_mapper,
+            bin_fit=p["bin_fit"])
         n, f = bins_np.shape
     else:
         from mmlspark_tpu.core.sparse import CSRMatrix

@@ -76,6 +76,14 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         "bin raw features on device ('auto' = when the mapper's cuts "
         "are f32-exact, i.e. float32 input, and the input is dense "
         "single-host; host binning is the fallback)", default="auto")
+    binFit = EnumParam(
+        ["sample", "sketch"],
+        "streaming/multi-host bin-boundary fit: 'sample' = reservoir-"
+        "sample then exact fit (<=200k rows decide boundaries); "
+        "'sketch' = mergeable quantile sketch over EVERY row in one "
+        "bounded-memory pass (gbdt/sketch.py; multi-host fits merge "
+        "per-host sketches instead of gathering rows). In-memory dense "
+        "fits ignore this", default="sample")
     validationData = TableParam("held-out table for early stopping",
                                 default=None)
     initModelString = StringParam(
@@ -114,6 +122,7 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
             "top_k": self.get("topK"),
             "boost_chunk": self.get("boostChunk"),
             "device_binning": self.get("deviceBinning"),
+            "bin_fit": self.get("binFit"),
         }
 
     def _features_matrix(self, table: DataTable) -> np.ndarray:
@@ -157,6 +166,10 @@ class TPUBoostClassifier(Estimator, _BoostParams):
                                 default="rawPrediction")
 
     def fit(self, table: DataTable) -> "TPUBoostClassificationModel":
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                return self._fit_chunked(table)
         X, y, w, valid = self._fit_arrays(table)
         classes = np.unique(y)
         num_class = len(classes)
@@ -178,6 +191,50 @@ class TPUBoostClassifier(Estimator, _BoostParams):
         # seed the cache with the LIVE booster: the frozen BinMapper and
         # (with keepTrainingData) the retained device state ride along
         # for boost_more; a reloaded model parses the string instead
+        model._booster = booster
+        for name in ("featuresCol", "predictionCol", "probabilityCol",
+                     "rawPredictionCol"):
+            model.set(name, self.get(name))
+        return model
+
+    def _fit_chunked(self, chunked) -> "TPUBoostClassificationModel":
+        """Out-of-core fit: chunks stream through ``train()``'s shard
+        ingest (the raw float matrix never materializes; with
+        binFit='sketch' the bin boundaries come from a one-pass
+        mergeable sketch over every row). One extra label-scan pass
+        determines the class count."""
+        classes: np.ndarray = np.empty(0)
+        for chunk in chunked.chunks():
+            y = np.asarray(chunk[self.get_label_col()], np.float64)
+            classes = np.union1d(classes, np.unique(y))
+        num_class = len(classes)
+        if not np.array_equal(classes, np.arange(num_class)):
+            raise ValueError(
+                f"labels must be 0..K-1 integers, got {classes[:10]}; "
+                f"use ValueIndexer / TrainClassifier for raw labels")
+        params = self._train_params()
+        if num_class > 2:
+            params["objective"] = "multiclass"
+            params["num_class"] = num_class
+        else:
+            params["objective"] = "binary"
+        if self.get("initModelString"):
+            raise ValueError(
+                "init-model warm start requires an in-memory table "
+                "(streaming ingest cannot warm-start)")
+        vt = self.get_or_none("validationData")
+        valid = None
+        if vt is not None:
+            valid = (self._features_matrix(vt),
+                     np.asarray(vt.column(self.get_label_col()),
+                                dtype=np.float64))
+        fac = chunked.as_xy(self.get_features_col(),
+                            self.get_label_col(),
+                            self.get_or_none("weightCol"))
+        booster = train(params, fac, y=None, valid=valid)
+        model = TPUBoostClassificationModel(
+            modelString=booster.model_to_string(),
+            numClasses=num_class)
         model._booster = booster
         for name in ("featuresCol", "predictionCol", "probabilityCol",
                      "rawPredictionCol"):
@@ -334,11 +391,35 @@ class TPUBoostRegressor(Estimator, _BoostParams):
                                       default=1.5)
 
     def fit(self, table: DataTable) -> "TPUBoostRegressionModel":
-        X, y, w, valid = self._fit_arrays(table)
         params = self._train_params()
         params["objective"] = self.get("objective")
         params["alpha"] = self.get("alpha")
         params["tweedie_variance_power"] = self.get("tweedieVariancePower")
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                # out-of-core fit through train()'s streaming ingest
+                if self.get("initModelString"):
+                    raise ValueError(
+                        "init-model warm start requires an in-memory "
+                        "table (streaming ingest cannot warm-start)")
+                vt = self.get_or_none("validationData")
+                valid = None
+                if vt is not None:
+                    valid = (self._features_matrix(vt),
+                             np.asarray(vt.column(self.get_label_col()),
+                                        dtype=np.float64))
+                fac = table.as_xy(self.get_features_col(),
+                                  self.get_label_col(),
+                                  self.get_or_none("weightCol"))
+                booster = train(params, fac, y=None, valid=valid)
+                model = TPUBoostRegressionModel(
+                    modelString=booster.model_to_string())
+                model._booster = booster
+                for name in ("featuresCol", "predictionCol"):
+                    model.set(name, self.get(name))
+                return model
+        X, y, w, valid = self._fit_arrays(table)
         booster = train(params, X, y, sample_weight=w, valid=valid,
                         init_model=self.get("initModelString") or None)
         model = TPUBoostRegressionModel(modelString=booster.model_to_string())

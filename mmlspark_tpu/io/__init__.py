@@ -1,5 +1,6 @@
-"""IO readers (ref: src/io/src/main/scala/Readers.scala:14-46) and the
-columnar serving-ingress codecs (io/columnar.py)."""
+"""IO readers (ref: src/io/src/main/scala/Readers.scala:14-46), the
+columnar serving-ingress codecs (io/columnar.py), and the out-of-core
+chunked ingest layer (io/ooc.py)."""
 
 from mmlspark_tpu.io.binary import read_binary_files
 from mmlspark_tpu.io.columnar import (
@@ -7,7 +8,11 @@ from mmlspark_tpu.io.columnar import (
     encode_columns, negotiate,
 )
 from mmlspark_tpu.io.image import read_images, write_images
+from mmlspark_tpu.io.ooc import (
+    ChunkedTable, table_nbytes, write_arrow_ipc,
+)
 
-__all__ = ["CodecError", "ColumnarBatch", "StagingPool",
+__all__ = ["ChunkedTable", "CodecError", "ColumnarBatch", "StagingPool",
            "decode_columnar", "encode_columns", "negotiate",
-           "read_binary_files", "read_images", "write_images"]
+           "read_binary_files", "read_images", "table_nbytes",
+           "write_arrow_ipc", "write_images"]

@@ -279,17 +279,25 @@ class TPULearner(Estimator, HasFeaturesCol, HasLabelCol):
             if not callable(table) and iter(table) is table:
                 raise ValueError(
                     "streaming fit() needs to replay shards every epoch: "
-                    "pass a sequence of DataTables or a zero-arg callable "
-                    "returning a fresh iterator, not a one-shot generator")
+                    "pass a sequence of DataTables, an io.ooc."
+                    "ChunkedTable, or a zero-arg callable returning a "
+                    "fresh iterator, not a one-shot generator")
             factory = table if callable(table) else (lambda: iter(table))
             # one metadata pass: count rows AND grab the first shard for
             # shapes/schema (IO-backed factories pay this pass once, not
-            # twice)
-            n, first_shard = 0, None
-            for t in factory():
-                if first_shard is None:
-                    first_shard = t
-                n += len(t)
+            # twice). A ChunkedTable that already knows its row count
+            # skips the counting decode pass entirely (spill-aware
+            # feed: epochs then replay the chunk stream, each chunk
+            # decoding on the prefetch worker while the device steps).
+            from mmlspark_tpu.io.ooc import ChunkedTable as _Chunked
+            if isinstance(table, _Chunked) and table.num_rows:
+                n, first_shard = table.num_rows, table.peek()
+            else:
+                n, first_shard = 0, None
+                for t in factory():
+                    if first_shard is None:
+                        first_shard = t
+                    n += len(t)
             if n == 0:
                 raise ValueError("empty shard stream")
             x0, y0 = table_to_xy(first_shard, fcol, lcol, input_shape)

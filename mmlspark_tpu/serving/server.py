@@ -491,7 +491,11 @@ class HTTPSource:
         for p in range(port, port + port_scan):
             try:
                 self.server = Server((host, p), Handler)
-                self.port = p
+                # read the BOUND port back from the socket: port=0 asks
+                # the OS for an ephemeral port (the collision-proof
+                # choice for tests/fleets on shared hosts), and the
+                # scan's requested p is not the truth there
+                self.port = self.server.server_address[1]
                 break
             except OSError as e:  # port taken — scan upward (ref :234)
                 last_err = e

@@ -42,8 +42,23 @@ class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
         return [self.get_output_col()]
 
     def fit(self, table: DataTable) -> "ValueIndexerModel":
-        col = table[self.get_input_col()]
-        levels = table.distinct_values(self.get_input_col())
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                # streaming dictionary build: per-chunk distinct-set
+                # union in first-seen order, sorted below exactly like
+                # the in-memory scan
+                seen: Dict[Any, None] = {}
+                for chunk in table.chunks():
+                    for v in chunk.distinct_values(self.get_input_col()):
+                        seen.setdefault(v, None)
+                levels = list(seen.keys())
+            else:
+                raise TypeError(
+                    f"ValueIndexer.fit expects a DataTable or "
+                    f"ChunkedTable; got {type(table).__name__}")
+        else:
+            levels = table.distinct_values(self.get_input_col())
         # nulls are not levels (ref: ValueIndexer verifies non-null)
         levels = [v for v in levels if v is not None]
         try:
@@ -293,7 +308,16 @@ class SummarizeData(Transformer):
                         "Missing_Value_Count")]
         return Schema(fields)
 
+    # distinct-count cap for the chunked path: past this the streaming
+    # union stops and Unique_Value_Count reports NaN instead of
+    # materializing an unbounded value set on the host
+    _CHUNKED_UNIQUE_CAP = 1_000_000
+
     def transform(self, table: DataTable) -> DataTable:
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                return self._transform_chunked(table)
         rows: List[Dict[str, Any]] = []
         for name in table.column_names:
             col = table[name]
@@ -334,6 +358,165 @@ class SummarizeData(Transformer):
                         row[label] = float(np.quantile(x, q))
             rows.append(row)
         return DataTable.from_rows(rows)
+
+    def _transform_chunked(self, chunked) -> DataTable:
+        """Summary stats in one bounded-memory pass over a
+        ChunkedTable: count/missing/min/max/moments stream exactly
+        (central-moment merge, Pébay combine formulas); percentiles go
+        through the mergeable quantile sketch (gbdt/sketch.py) instead
+        of ``np.quantile`` over a materialized column, so summarizing
+        never forces the table into RAM. Sketch percentiles answer
+        within the sketch's measured rank-error certificate (exact
+        until its first compaction); the exact path's ``np.quantile``
+        interpolates BETWEEN order stats, the sketch returns an
+        observed value — equal at scale, not bit-equal."""
+        from mmlspark_tpu.gbdt.sketch import QuantileSketch
+        names = list(chunked.schema.names)
+        num: Dict[str, _StreamingMoments] = {}
+        sketches: Dict[str, QuantileSketch] = {}
+        missing: Dict[str, int] = {n: 0 for n in names}
+        uniques: Dict[str, Any] = {n: set() for n in names}
+        # NaN is counted ONCE like the exact path's np.unique — each
+        # chunk's nan floats would otherwise enter the set as distinct
+        # objects (nan != nan), inflating the count by #chunks
+        nan_seen: Dict[str, bool] = {n: False for n in names}
+        n_rows = 0
+        cap = self._CHUNKED_UNIQUE_CAP
+        want_pct = self.get("percentiles")
+        for chunk in chunked.chunks():
+            n_rows += len(chunk)
+            for name in names:
+                col = chunk[name]
+                is_num = isinstance(col, np.ndarray) and col.ndim == 1 \
+                    and np.issubdtype(col.dtype, np.number)
+                if is_num:
+                    x = col.astype(np.float64)
+                    missing[name] += int(np.sum(~np.isfinite(x)))
+                    finite = x[np.isfinite(x)]
+                    num.setdefault(
+                        name, _StreamingMoments()).update(finite)
+                    if want_pct:
+                        sketches.setdefault(
+                            name, QuantileSketch()).update(finite)
+                else:
+                    missing[name] += sum(1 for v in col if v is None)
+                u = uniques.get(name)
+                if u is not None:
+                    try:
+                        if is_num:
+                            vals = np.unique(col)
+                            if np.issubdtype(vals.dtype, np.floating):
+                                nans = np.isnan(vals)
+                                nan_seen[name] |= bool(nans.any())
+                                vals = vals[~nans]
+                            u.update(vals.tolist())
+                        else:
+                            u.update(chunk.distinct_values(name))
+                    except TypeError:   # unhashable values
+                        uniques[name] = None
+                        continue
+                    if len(u) > cap:
+                        uniques[name] = None   # bounded: report NaN
+        rows: List[Dict[str, Any]] = []
+        for name in names:
+            row: Dict[str, Any] = {"Feature": name}
+            if self.get("counts"):
+                u = uniques.get(name)
+                n_u = (len(u) + int(nan_seen[name])
+                       if u is not None else None)
+                row.update(Count=float(n_rows),
+                           Unique_Value_Count=(float(n_u)
+                                               if n_u is not None
+                                               else float("nan")),
+                           Missing_Value_Count=float(missing[name]))
+            mom = num.get(name)
+            if mom is not None and mom.n > 0:
+                if self.get("basic"):
+                    row.update(Max=mom.max, Min=mom.min,
+                               Mean=mom.mean,
+                               Range=mom.max - mom.min)
+                if self.get("sample") and mom.n > 1:
+                    row.update(
+                        Sample_Variance=mom.variance,
+                        Sample_Standard_Deviation=mom.std,
+                        Sample_Skewness=mom.skewness,
+                        Sample_Kurtosis=mom.kurtosis)
+                if want_pct:
+                    sk = sketches[name]
+                    for q, label in ((0.5, "Median"), (0.25, "P25"),
+                                     (0.75, "P75"), (0.05, "P5"),
+                                     (0.95, "P95")):
+                        row[label] = sk.query(q)
+            rows.append(row)
+        return DataTable.from_rows(rows)
+
+
+class _StreamingMoments:
+    """Mergeable count/mean/M2..M4 + min/max over finite values —
+    chunk-wise central-moment combine (Pébay, SAND2008-6212), the
+    streaming backbone of ``SummarizeData``'s chunked path."""
+
+    def __init__(self):
+        self.n = 0
+        self._mean = 0.0
+        self._m2 = self._m3 = self._m4 = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def update(self, x: np.ndarray) -> None:
+        nb = int(x.size)
+        if nb == 0:
+            return
+        mb = float(x.mean())
+        d = x - mb
+        m2b = float((d ** 2).sum())
+        m3b = float((d ** 3).sum())
+        m4b = float((d ** 4).sum())
+        self.min = min(self.min, float(x.min()))
+        self.max = max(self.max, float(x.max()))
+        na, ma = self.n, self._mean
+        if na == 0:
+            self.n, self._mean = nb, mb
+            self._m2, self._m3, self._m4 = m2b, m3b, m4b
+            return
+        n = na + nb
+        delta = mb - ma
+        self._mean = ma + delta * nb / n
+        m2a, m3a, m4a = self._m2, self._m3, self._m4
+        self._m2 = m2a + m2b + delta ** 2 * na * nb / n
+        self._m3 = (m3a + m3b
+                    + delta ** 3 * na * nb * (na - nb) / n ** 2
+                    + 3.0 * delta * (na * m2b - nb * m2a) / n)
+        self._m4 = (m4a + m4b
+                    + delta ** 4 * na * nb
+                    * (na * na - na * nb + nb * nb) / n ** 3
+                    + 6.0 * delta ** 2
+                    * (na * na * m2b + nb * nb * m2a) / n ** 2
+                    + 4.0 * delta * (na * m3b - nb * m3a) / n)
+        self.n = n
+
+    @property
+    def mean(self) -> float:
+        return self._mean
+
+    @property
+    def variance(self) -> float:
+        """Sample variance (ddof=1)."""
+        return self._m2 / (self.n - 1) if self.n > 1 else 0.0
+
+    @property
+    def std(self) -> float:
+        return float(np.sqrt(self.variance))
+
+    @property
+    def skewness(self) -> float:
+        s = self.std
+        return float((self._m3 / self.n) / (s ** 3 + 1e-300))
+
+    @property
+    def kurtosis(self) -> float:
+        s = self.std
+        return float((self._m4 / self.n) / (s ** 4 + 1e-300) - 3.0)
 
 
 def _skew(x: np.ndarray) -> float:
@@ -590,6 +773,10 @@ class StandardScaler(Estimator, HasInputCol, HasOutputCol):
 
     def fit(self, table: DataTable) -> "StandardScalerModel":
         from mmlspark_tpu.core.table import features_matrix
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                return self._fit_streaming(table)
         col = table[self.get_input_col()]
         if isinstance(col, np.ndarray) and col.ndim == 1:
             X = np.asarray(col, dtype=np.float64)[:, None]
@@ -608,6 +795,55 @@ class StandardScaler(Estimator, HasInputCol, HasOutputCol):
             mu=mu.astype(np.float32), sd=sd.astype(np.float32),
             scalarInput=scalar)
         model.set("inputCol", self.get_input_col())
+        model.set("outputCol", self.get_output_col())
+        return model
+
+    def _fit_streaming(self, chunked) -> "StandardScalerModel":
+        """One bounded-memory pass over a ChunkedTable: per-chunk
+        (count, mean, M2) merge via the parallel-Welford combine (the
+        DriftMonitor discipline) — numerically stable where a naive
+        Σx²-Σx would cancel, and equal to the in-memory fit's
+        mean/population-std to f64 merge order (identical at the f32
+        boundary dtype the model stores)."""
+        from mmlspark_tpu.core.table import features_matrix
+        in_col = self.get_input_col()
+        tag = chunked.schema[in_col].tag
+        scalar = tag not in (VECTOR,)
+        n_tot = 0
+        mean = m2 = None
+        for chunk in chunked.chunks():
+            col = chunk[in_col]
+            if scalar and isinstance(col, np.ndarray) and col.ndim == 1:
+                X = np.asarray(col, dtype=np.float64)[:, None]
+            else:
+                X = features_matrix(chunk, in_col)
+                scalar = False
+            nc = X.shape[0]
+            if nc == 0:
+                continue
+            mc = X.mean(axis=0)
+            m2c = ((X - mc) ** 2).sum(axis=0)
+            if mean is None:
+                n_tot, mean, m2 = nc, mc, m2c
+            else:
+                delta = mc - mean
+                n_new = n_tot + nc
+                mean = mean + delta * (nc / n_new)
+                m2 = m2 + m2c + delta ** 2 * (n_tot * nc / n_new)
+                n_tot = n_new
+        if mean is None or n_tot == 0:
+            raise ValueError("empty chunk stream")
+        mu = mean
+        sd = np.sqrt(m2 / n_tot)
+        sd = np.where(sd < 1e-12, 1.0, sd)
+        if not self.get("withMean"):
+            mu = np.zeros_like(mu)
+        if not self.get("withStd"):
+            sd = np.ones_like(sd)
+        model = StandardScalerModel(
+            mu=mu.astype(np.float32), sd=sd.astype(np.float32),
+            scalarInput=scalar)
+        model.set("inputCol", in_col)
         model.set("outputCol", self.get_output_col())
         return model
 
@@ -636,6 +872,11 @@ class StandardScalerModel(Model, HasInputCol, HasOutputCol):
         return np.stack([np.asarray(v, dtype=np.float32) for v in col])
 
     def transform(self, table: DataTable) -> DataTable:
+        if not isinstance(table, DataTable):
+            from mmlspark_tpu.io.ooc import ChunkedTable
+            if isinstance(table, ChunkedTable):
+                return table.map(self.transform,
+                                 label=f"{table.label}|scaler")
         x = self._load(table)
         mu = np.asarray(self.get("mu"), np.float32)
         sd = np.asarray(self.get("sd"), np.float32)

@@ -219,10 +219,14 @@ class TestServing:
                  "x": json.loads(r["entity"].decode())["x"]}
                 for r in table["request"]])
 
-        e1 = serve_model(Lambda.apply(handle), port=18994, batch_size=4)
-        e2 = serve_model(Lambda.apply(handle), port=18996, batch_size=4)
+        # ephemeral ports (port=0, bound address read back from the
+        # socket): a fixed port pair flaked under ambient load when
+        # another process grabbed one of the ports mid-test
+        e1 = serve_model(Lambda.apply(handle), port=0, batch_size=4)
+        e2 = serve_model(Lambda.apply(handle), port=0, batch_size=4)
         try:
             assert e1.source.port != e2.source.port
+            assert e1.source.port > 0 and e2.source.port > 0
             results = {}
 
             def client(i):

@@ -221,6 +221,118 @@ def check_ingress_kernels() -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# out-of-core ingest hot paths (io/ooc.py + the chunked consumers)
+# ---------------------------------------------------------------------------
+
+# the chunked-ingest promise: bounded memory. Nothing on a ChunkedTable
+# hot path may materialize the whole stream — no ``.materialize()``, no
+# ``to_numpy()``/``to_pylist()`` column pulls, no full-stream
+# ``np.concatenate``/``vstack``/``stack``/``DataTable.concat`` — unless
+# the line carries the explicit acknowledgment (chunk-LOCAL decode and
+# the bounded sketch buffers are the sanctioned cases).
+_OOC_MARK = "# ooc:materialize-ok"
+_OOC_ATTR_CALLS = {"materialize", "to_numpy", "to_pylist", "toarray",
+                   "concat"}
+_OOC_NP_CALLS = {"concatenate", "vstack", "hstack", "stack"}
+
+# (dotted module, qualname) of every audited hot-path function
+_OOC_HOT_PATHS = (
+    ("mmlspark_tpu.io.ooc", "ChunkedTable._instrumented"),
+    ("mmlspark_tpu.io.ooc", "ChunkedTable.chunks"),
+    ("mmlspark_tpu.io.ooc", "ChunkedTable.map"),
+    ("mmlspark_tpu.io.ooc", "ChunkedTable.as_xy"),
+    ("mmlspark_tpu.io.ooc", "ChunkedTable.materialize"),
+    ("mmlspark_tpu.io.ooc", "_record_batch_to_table"),
+    ("mmlspark_tpu.core.fusion", "FusionPlan.execute_chunked"),
+    ("mmlspark_tpu.core.fusion",
+     "FusedPipelineModel.transform_chunked"),
+    ("mmlspark_tpu.gbdt.binning", "BinMapper.fit_streaming"),
+    ("mmlspark_tpu.gbdt.sketch", "QuantileSketch.update"),
+    ("mmlspark_tpu.gbdt.sketch", "QuantileSketch._flush"),
+    ("mmlspark_tpu.gbdt.sketch", "QuantileSketch.summary"),
+    ("mmlspark_tpu.automl.featurize", "Featurize._fit_streaming"),
+    ("mmlspark_tpu.stages.dataprep", "StandardScaler._fit_streaming"),
+    ("mmlspark_tpu.stages.dataprep",
+     "SummarizeData._transform_chunked"),
+)
+
+
+def _resolve_qualname(module: str, qualname: str):
+    import importlib
+    obj = importlib.import_module(module)
+    for part in qualname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def check_ooc_source(name: str, src: str, first: int,
+                     lines: List[str]) -> List[str]:
+    """No-materialize audit of ONE chunked hot-path function."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return [f"{name}: unparseable ooc hot-path source"]
+    violations: List[str] = []
+
+    def line_ok(lineno: int) -> bool:
+        idx = lineno - 1
+        return 0 <= idx < len(lines) and _OOC_MARK in lines[idx]
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        bad = None
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _OOC_ATTR_CALLS:
+                bad = f"materializing call '.{func.attr}()'"
+            elif func.attr in _OOC_NP_CALLS and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id in ("np", "numpy"):
+                bad = f"full-stream 'np.{func.attr}()'"
+        elif isinstance(func, ast.Name) and func.id == "list" \
+                and node.args:
+            # list(...chunks...) would buffer the whole stream; other
+            # list() uses (schema names, dict keys) are fine
+            arg = node.args[0]
+            chunky = (isinstance(arg, ast.Call)
+                      and isinstance(arg.func, ast.Attribute)
+                      and arg.func.attr == "chunks") or (
+                isinstance(arg, ast.Name) and "chunk" in arg.id)
+            if chunky:
+                bad = "stream buffering 'list()'"
+        if bad is not None and not line_ok(node.lineno):
+            violations.append(
+                f"{name} (line {first + node.lineno - 1}): {bad} on a "
+                f"ChunkedTable hot path (chunk-local use is "
+                f"acknowledged with '{_OOC_MARK}')")
+    return violations
+
+
+def check_ooc_ingest() -> List[str]:
+    """The no-materialize audit across every registered chunked
+    hot path (empty = clean)."""
+    violations: List[str] = []
+    for module, qualname in _OOC_HOT_PATHS:
+        try:
+            fn = _resolve_qualname(module, qualname)
+        except (ImportError, AttributeError) as e:
+            violations.append(f"{module}.{qualname}: unresolvable ({e})")
+            continue
+        fn = inspect.unwrap(fn)
+        try:
+            lines, first = inspect.getsourcelines(fn)
+        except OSError as e:
+            violations.append(
+                f"{module}.{qualname}: unreadable source ({e})")
+            continue
+        violations.extend(check_ooc_source(
+            f"{module}.{qualname}",
+            textwrap.dedent("".join(lines)), first, lines))
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # sharded serving programs (mesh-sharded pjit path — serving/sharded.py)
 # ---------------------------------------------------------------------------
 
@@ -379,6 +491,14 @@ def register_representative_pipelines() -> int:
     ]).fit(table)
     fuse(pm).plan_for(table.schema)
 
+    # the chunked ingest path drives the SAME registered kernels —
+    # plan one ChunkedTable pass so the host-sync audit provably
+    # covers the feeds the out-of-core path ships per chunk
+    from mmlspark_tpu.io.ooc import ChunkedTable
+    for _ in fuse(pm).transform_chunked(
+            ChunkedTable.from_table(table.drop("label"), chunk_rows=32)):
+        pass
+
     # (N,1) feature matrix via assembler keeps the fit happy
     lin = Pipeline(stages=[
         FastVectorAssembler(inputCols=["a"], outputCol="fv2"),
@@ -437,6 +557,7 @@ def main() -> int:
     from mmlspark_tpu.io.columnar import INGRESS_REGISTRY
     n_ingress = len(INGRESS_REGISTRY)
     violations += check_ingress_kernels()
+    violations += check_ooc_ingest()
     if violations:
         print(f"{len(violations)} kernel violation(s) across {n} fused "
               f"+ {n_ingress} ingress registered kernels:")
@@ -446,7 +567,8 @@ def main() -> int:
     print(f"OK: {n} registered fused kernels, no host round trips; "
           f"{n_ingress} ingress kernels, no per-row iteration; "
           f"{len(_SHARDED_JIT_SITES)} sharded jit builders declare "
-          f"explicit shardings")
+          f"explicit shardings; {len(_OOC_HOT_PATHS)} chunked hot "
+          f"paths never materialize the stream")
     return 0
 
 
