@@ -1964,6 +1964,156 @@ def bench_fleet_procs() -> dict:
     }
 
 
+def bench_continuous() -> dict:
+    """Closed-loop continuous training under drift (ref: TFX/Baylor
+    continuous pipelines, KDD'17): a served logistic scorer, an
+    injected distribution shift, and the ContinuousTrainer running the
+    full drift -> refit -> shadow -> canary -> cutover loop
+    autonomously while clients hammer the engine.
+
+    Reports the numbers the robustness claim hangs on: loop reaction
+    time (drift onset -> candidate serving), serving p99 during the
+    refit/cutover window vs steady state (training must not perturb
+    the request path), and the shadow-gate quality delta that justified
+    the promotion."""
+    import threading
+    import urllib.request
+
+    from mmlspark_tpu.core.metrics import DriftMonitor
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.models.linear import TPULogisticRegression
+    from mmlspark_tpu.serving import (
+        CanaryPolicy, ContinuousTrainer, GatePolicy, ModelRegistry,
+        TriggerPolicy, json_scoring_pipeline, serve_model,
+    )
+
+    import jax
+
+    d, shift = 8, 3.0
+    rng = np.random.default_rng(0)
+    w_true = np.linspace(1.0, -1.0, d)
+
+    def blobs(n, mu):
+        X = rng.normal(size=(n, d)) + mu
+        y = (X @ w_true > mu * w_true.sum()).astype(np.float64)
+        return X, y
+
+    X0, y0 = blobs(2000, 0.0)
+    est = TPULogisticRegression(maxIter=80)
+    base = est.fit(DataTable({"features": X0, "label": y0}))
+    dm = DriftMonitor.from_matrix(
+        X0, feature_names=[f"f{i}" for i in range(d)])
+    engine = serve_model(json_scoring_pipeline(base, drift_monitor=dm),
+                         port=21900, batch_size=32, workers=2,
+                         version="base")
+    registry = ModelRegistry()
+
+    def refit(window, active):
+        tab = window.materialize()
+        m = est.partial_fit(tab, getattr(active, "model", None))
+        ndm = DriftMonitor.from_matrix(
+            np.asarray(tab["features"]),
+            feature_names=[f"f{i}" for i in range(d)])
+        return json_scoring_pipeline(m, drift_monitor=ndm)
+
+    trainer = ContinuousTrainer(
+        engine, refit, registry=registry,
+        triggers=TriggerPolicy(max_mean_delta_sigma=2.0,
+                               min_window_rows=256, cooldown_s=1.0,
+                               watch_slo_alerts=False),
+        gate=GatePolicy(shadow_rows=512),
+        canary=CanaryPolicy(fraction=0.5, min_batches=3,
+                            decision_timeout_s=30),
+        warmup_example={"features": [0.0] * d},
+        poll_interval_s=0.05)
+
+    lat_steady, lat_refit = [], []
+    errors = [0]
+    phase = {"mu": 0.0, "sink": lat_steady}
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    def client(tid):
+        crng = np.random.default_rng(100 + tid)
+        while not stop.is_set():
+            x = crng.normal(size=d) + phase["mu"]
+            body = json.dumps({"features": list(x)}).encode()
+            req = urllib.request.Request(
+                engine.source.address, data=body,
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    r.read()
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    phase["sink"].append(dt)
+            except Exception:  # noqa: BLE001 — availability metric
+                errors[0] += 1
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(4)]
+    trainer.start()
+    for t in threads:
+        t.start()
+    time.sleep(3.0)    # steady state on the base model
+
+    # -- drift onset: traffic shifts, labeled rows reach the window ----
+    with lock:
+        phase["mu"] = shift
+        phase["sink"] = lat_refit
+    Xs, ys = blobs(2000, shift)
+    drift_onset = time.perf_counter()
+    for lo in range(0, 2000, 250):
+        trainer.ingest(DataTable({"features": Xs[lo:lo + 250],
+                                  "label": ys[lo:lo + 250]}))
+    deadline = time.monotonic() + 120
+    while trainer.promotions < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    reaction_s = time.perf_counter() - drift_onset
+    time.sleep(1.0)    # tail of the cutover window
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    promoted = trainer.promotions >= 1
+    shadow = next((e for e in registry.events
+                   if getattr(e, "kind", "") == "shadow_pass"), None)
+    verdict = dict(shadow.stats) if shadow is not None else {}
+    status = trainer.status()
+    trainer.stop()
+    engine.stop()
+
+    def p(v, q):
+        return float(np.percentile(v, q)) if v else float("nan")
+
+    return {
+        "metric": "continuous_loop_reaction_s",
+        "value": round(reaction_s, 2),
+        "unit": "s (drift onset -> refit candidate serving live)",
+        "promoted": promoted,
+        "active_version": "ct-1" if promoted else "base",
+        "serving_p99_ms": {
+            "steady": round(p(lat_steady, 99), 2),
+            "during_refit_cutover": round(p(lat_refit, 99), 2),
+        },
+        "serving_p50_ms": {
+            "steady": round(p(lat_steady, 50), 2),
+            "during_refit_cutover": round(p(lat_refit, 50), 2),
+        },
+        "requests": {"steady": len(lat_steady),
+                     "during_refit_cutover": len(lat_refit),
+                     "failed": errors[0]},
+        "gate": {k: verdict.get(k) for k in
+                 ("quality_candidate", "quality_baseline",
+                  "quality_delta", "divergence", "nan_rate",
+                  "shadow_rows")},
+        "trigger": status.get("last_trigger"),
+        "cycles": status.get("cycles"),
+        "backend": jax.default_backend(),
+    }
+
+
 # scenario registry for --scenarios (cheap subsets of the full bench:
 # the serving/lifecycle numbers are measurable on any backend, the
 # training-throughput scenarios only mean anything on the TPU chip)
@@ -1985,6 +2135,8 @@ SCENARIOS = {
     "fleet_procs": lambda: ("secondary_fleet_procs",
                             bench_fleet_procs()),
     "ooc": lambda: ("secondary_ooc", bench_ooc()),
+    "continuous": lambda: ("secondary_continuous",
+                           bench_continuous()),
 }
 
 
@@ -1995,8 +2147,8 @@ def main():
         "--scenarios", default="all",
         help="comma list from {cifar,resnet,lm,higgs,serving,swap,"
              "automl,pipeline,observability,quant,coldstart,ingress,"
-             "zoo,sharded,fleet_procs,ooc} or 'all' (the full "
-             "flagship bench)")
+             "zoo,sharded,fleet_procs,ooc,continuous} or 'all' (the "
+             "full flagship bench)")
     args = ap.parse_args()
     if args.scenarios != "all":
         if "sharded" in args.scenarios.split(",") and \

@@ -97,6 +97,12 @@ def _event_dict(event: Any) -> Dict[str, Any]:
         val = getattr(event, key, None)
         if val is not None:
             out[key] = val
+    # decision evidence rides along: SwapEvent carries the canary
+    # numbers, the control-plane events (serving/controlplane.py) carry
+    # the gate verdict — a quarantine bundle must be self-explanatory
+    stats = getattr(event, "stats", None)
+    if isinstance(stats, dict) and stats:
+        out["stats"] = stats
     return out
 
 

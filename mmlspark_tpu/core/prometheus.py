@@ -349,3 +349,76 @@ def drift_families(r: PromRenderer, monitor: Any,
     r.gauge("serving_drift_null_rate",
             "NaN/inf rate across served feature cells",
             summary["null_rate"], base)
+    # per-feature drift scores, cardinality-capped: only the top
+    # DRIFT_FEATURE_CAP features by score get their own series (wide
+    # models would otherwise mint thousands); the overflow folds into
+    # feature="_other" carrying the worst remaining score, so a drift
+    # outside the top set still moves a series
+    import numpy as np
+    snap = monitor.snapshot()
+    seen = np.asarray(snap["count"]) > 0
+    sigma = np.sqrt(np.asarray(snap["ref_var"], dtype=np.float64))
+    scores = np.where(
+        seen,
+        np.abs((np.asarray(snap["mean"]) - np.asarray(snap["ref_mean"]))
+               / sigma),
+        0.0)
+    names = monitor.feature_names
+    order = np.argsort(scores)[::-1]
+    top = [int(i) for i in order[:DRIFT_FEATURE_CAP]]
+    for i in top:
+        label = str(names[i]) if names else f"f{i}"
+        r.gauge("serving_drift_score",
+                "per-feature |mean shift| in fit-time sigma units "
+                '(top-K by score; overflow folds into feature="_other")',
+                float(scores[i]), {**base, "feature": label})
+    rest = order[DRIFT_FEATURE_CAP:]
+    if len(rest):
+        r.sample("serving_drift_score", float(scores[rest[0]]),
+                 {**base, "feature": "_other"})
+
+
+# per-feature drift series cap — the "model" label discipline applied
+# to features: a bounded exposition no matter how wide the model is
+DRIFT_FEATURE_CAP = 16
+
+
+def controlplane_families(r: PromRenderer, trainer: Any) -> None:
+    """Continuous-training control-loop families (serving/
+    controlplane.py): loop counters, health gauges, and the per-phase
+    wall histograms of the trainer thread."""
+    from mmlspark_tpu.core import metrics as MC
+    st = trainer.status()
+    r.counter("serving_controlplane_cycles_total",
+              "refit cycles triggered (drift/SLO/forced)",
+              st["cycles"])
+    r.counter("serving_controlplane_refits_total",
+              "incremental refits completed", st["refits"])
+    r.counter("serving_controlplane_refit_failures_total",
+              "refit attempts that exhausted retries",
+              st["refit_failures"])
+    r.counter("serving_controlplane_promotions_total",
+              "candidates promoted through canary cutover",
+              st["promotions"])
+    r.counter("serving_controlplane_quarantines_total",
+              "candidates quarantined by the gate or canary rollback",
+              st["quarantines"])
+    r.gauge("serving_controlplane_degraded",
+            "1 while training is unhealthy (circuit open or trainer "
+            "thread dead) and serving runs the frozen model",
+            1 if st["degraded"] else 0)
+    r.gauge("serving_controlplane_circuit_open",
+            "1 while the refit circuit breaker is open",
+            1 if st["circuit_open"] else 0)
+    r.gauge("serving_controlplane_window_rows",
+            "labeled rows currently held in the replay window",
+            st["window"]["rows"])
+    r.info("serving_controlplane_info",
+           "control-loop state + last trigger (labels)",
+           {"state": st["state"],
+            "last_trigger": str(st["last_trigger"] or "")})
+    for phase, hist in MC.controlplane_histograms().items():
+        r.histogram("serving_controlplane_phase_ms",
+                    "continuous-training per-phase wall milliseconds "
+                    "on the dedicated trainer thread",
+                    hist, {"phase": phase})

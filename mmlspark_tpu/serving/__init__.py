@@ -8,6 +8,11 @@ PartitionConsolidator.scala).
 from mmlspark_tpu.serving.admission import (
     AdmissionController, TenantQuota,
 )
+from mmlspark_tpu.serving.controlplane import (
+    ContinuousTrainer, GatePolicy, IngestDriver, PromoteEvent,
+    QuarantineEvent, RefitPolicy, RetrainEvent, ShadowEvent,
+    TriggerPolicy,
+)
 from mmlspark_tpu.serving.aot import (
     export_model, load_model, read_manifest,
 )
@@ -51,13 +56,18 @@ def __getattr__(name):
 
 
 __all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
-           "BurnRateRule", "CanaryPolicy", "FlightRecorder",
-           "HTTPSource",
+           "BurnRateRule", "CanaryPolicy", "ContinuousTrainer",
+           "FlightRecorder", "GatePolicy", "HTTPSource",
+           "IngestDriver",
            "ModelRegistry", "ModelZoo", "PartitionConsolidator",
-           "PipelineHandle", "SLO", "SLOMonitor", "ServingEngine",
-           "ServingFleet", "ServingUnavailable", "SharedSingleton",
+           "PipelineHandle", "PromoteEvent", "QuarantineEvent",
+           "RefitPolicy", "RetrainEvent",
+           "SLO", "SLOMonitor", "ServingEngine",
+           "ServingFleet", "ServingUnavailable", "ShadowEvent",
+           "SharedSingleton",
            "SharedVariable", "SwapEvent", "SwapInProgress", "SwapResult",
-           "TenantQuota", "ZooEvent", "assert_serves_from_mesh",
+           "TenantQuota", "TriggerPolicy", "ZooEvent",
+           "assert_serves_from_mesh",
            "auto_weight_specs",
            "data_shard_pipeline", "device_residency", "export_model",
            "get_recorder", "json_row_scoring_pipeline",

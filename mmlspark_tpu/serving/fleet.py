@@ -228,16 +228,34 @@ def json_scoring_pipeline(model, field: str = "features",
         # when the system is under the stress the monitor watches for
         if drift_monitor is not None:
             drift_monitor.observe(feats)
-        preds = np.asarray(scored[model.get("outputCol")]).argmax(-1)
+        # reply values: a TPUModel emits a score matrix (reply the
+        # argmax class); a fitted estimator model (linear/GBDT — the
+        # continuous-training refit path serves these directly) already
+        # emits one prediction per row
+        try:
+            out_col = model.get("outputCol")
+        except Exception:  # noqa: BLE001 — not a TPUModel-style stage
+            out_col = None
+        if out_col is not None and out_col in scored.column_names:
+            preds = np.asarray(scored[out_col]).argmax(-1)
+        else:
+            get_pcol = getattr(model, "get_prediction_col", None)
+            pcol = get_pcol() if callable(get_pcol) else "prediction"
+            preds = np.asarray(scored[pcol])
         _state["dim"] = feats.shape[1]
+
+        def scalar(v):
+            f = float(v)
+            return int(f) if f.is_integer() else f
+
         replies = []
         for s, e, codec in prepped.spans:
             if codec == "json":
-                replies.append({reply_field: int(preds[s])})
+                replies.append({reply_field: scalar(preds[s])})
             else:
                 # columnar requests reply one value PER ROW they carried
                 replies.append(
-                    {reply_field: [int(p) for p in preds[s:e]]})
+                    {reply_field: [scalar(p) for p in preds[s:e]]})
         return table.with_column("reply", replies)
 
     def handle(table: DataTable) -> DataTable:
@@ -251,6 +269,11 @@ def json_scoring_pipeline(model, field: str = "features",
     lam = Lambda.apply(handle)
     lam.prepare_batch = decode
     lam.execute_prepared = execute
+    # the wrapped model itself: the continuous-training control plane
+    # (serving/controlplane.py) shadow-scores candidates through
+    # pipeline.model.predict/transform, and refit hooks warm-start
+    # from the live model
+    lam.model = model
     # pad/device hists + jit_cache_misses — TPUModel has the hook;
     # other Model types serve fine without it
     stage_metrics = getattr(model, "metrics", None)

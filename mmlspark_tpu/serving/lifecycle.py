@@ -337,26 +337,44 @@ class SwapController:
         if failed:
             self.breaker.record_failure()
             if self.breaker.state != CircuitBreaker.CLOSED:
-                self._resolve("breach:error_rate")
+                # the reason carries observed-vs-threshold so the
+                # SwapEvent / rollback line / quarantine bundle is
+                # self-explanatory without cross-referencing the policy
+                with self._lock:
+                    nf, ok = self.canary_failed, self.canary_ok
+                calls = nf + ok
+                rate = nf / max(calls, 1)
+                self._resolve(
+                    f"breach:error_rate observed={rate:.2f} "
+                    f"({nf}/{calls} failed) >= "
+                    f"threshold={self.policy.error_rate:.2f} or "
+                    f"{self.policy.consecutive_failures} consecutive")
             return
         self.breaker.record_success()
         with self._lock:
             self.canary_ok += 1
             enough = self.canary_ok >= self.policy.min_batches
-        if self._latency_breached():
-            self._resolve("breach:latency")
+        latency_reason = self._latency_breached()
+        if latency_reason is not None:
+            self._resolve(latency_reason)
         elif enough:
             self._resolve("promote")
 
-    def _latency_breached(self) -> bool:
+    def _latency_breached(self) -> Optional[str]:
+        """None while healthy, else the full ``breach:latency ...``
+        reason with observed p50s vs the allowed ratio."""
         ratio = self.policy.latency_ratio
         if ratio is None:
-            return False
+            return None
         c, s = self.canary_hist.summary(), self.stable_hist.summary()
         if c.get("count", 0) < self.policy.min_batches or \
                 s.get("count", 0) < self.policy.min_batches:
-            return False
-        return c["p50"] > ratio * max(s["p50"], 1e-9)
+            return None
+        if c["p50"] > ratio * max(s["p50"], 1e-9):
+            return (f"breach:latency canary_p50={c['p50']:.2f}ms > "
+                    f"allowed={ratio:.2f}x stable_p50="
+                    f"{s['p50']:.2f}ms")
+        return None
 
     def _resolve(self, decision: str) -> None:
         with self._lock:
@@ -369,7 +387,12 @@ class SwapController:
         default: an engine that stopped producing canary observations
         — killed mid-swap, starved of traffic — must not promote)."""
         if not self._decided.wait(timeout):
-            self._resolve("breach:decision_timeout")
+            with self._lock:
+                ok, nf = self.canary_ok, self.canary_failed
+            self._resolve(
+                f"breach:decision_timeout after {timeout:.0f}s "
+                f"(canary_ok={ok}/{self.policy.min_batches} needed, "
+                f"failed={nf})")
         return self.decision or "breach:decision_timeout"
 
     def stats(self) -> Dict[str, Any]:
@@ -384,6 +407,15 @@ class SwapController:
             }
         out["canary_p50_ms"] = self.canary_hist.summary().get("p50")
         out["stable_p50_ms"] = self.stable_hist.summary().get("p50")
+        # the policy thresholds the decision was judged against, so the
+        # SwapEvent stats pair every observed value with its limit
+        out["thresholds"] = {
+            "error_rate": self.policy.error_rate,
+            "consecutive_failures": self.policy.consecutive_failures,
+            "min_batches": self.policy.min_batches,
+            "latency_ratio": self.policy.latency_ratio,
+            "decision_timeout_s": self.policy.decision_timeout_s,
+        }
         if self.last_error:
             out["last_error"] = self.last_error
         return out

@@ -160,6 +160,12 @@ class HTTPSource:
         # probe behind /debug/bundle
         self.slo = None
         self.bundle_probe: Optional[Callable[..., Dict[str, Any]]] = None
+        # set by ContinuousTrainer.start(): () -> control-loop status
+        # dict (serving/controlplane.py); a degraded loop (circuit open
+        # or dead trainer thread) degrades /healthz but stays HTTP 200
+        # — training death must never take serving down
+        self.controlplane_probe: Optional[
+            Callable[[], Dict[str, Any]]] = None
         self._pending: Dict[str, _ParkedRequest] = {}
         self._lock = threading.Lock()
         self._new_rid = _request_id_factory()
@@ -322,13 +328,26 @@ class HTTPSource:
                         slo_status = source.slo.status()
                     except Exception:  # noqa: BLE001 — stats stay partial
                         slo_status = {"error": "slo probe failed"}
-                # DEGRADED: alive and serving, but an SLO is burning —
+                cp_status: Optional[Dict[str, Any]] = None
+                if source.controlplane_probe is not None:
+                    try:
+                        cp_status = source.controlplane_probe()
+                    except Exception:  # noqa: BLE001 — stats stay
+                        cp_status = {"error": "controlplane probe "
+                                              "failed",
+                                     "degraded": True}
+                # DEGRADED: alive and serving, but an SLO is burning or
+                # the continuous-training loop is unhealthy (circuit
+                # open / trainer thread dead — frozen-model serving) —
                 # stays HTTP 200 (a degraded engine must keep taking
                 # traffic; pulling it from the LB would turn a burn
                 # into an outage) with the machine-readable verdict
                 status = "ok" if healthy else "unhealthy"
                 if healthy and slo_status is not None and \
                         slo_status.get("degraded"):
+                    status = "degraded"
+                if healthy and cp_status is not None and \
+                        cp_status.get("degraded"):
                     status = "degraded"
                 with source._lock:
                     stats = {
@@ -344,6 +363,8 @@ class HTTPSource:
                     stats["metrics"] = metrics
                 if slo_status is not None:
                     stats["slo"] = slo_status
+                if cp_status is not None:
+                    stats["controlplane"] = cp_status
                 self._send_json(200 if healthy else 503, stats)
 
             def do_POST(self):  # noqa: N802 (http.server API)
@@ -1873,6 +1894,15 @@ class ServingEngine:
             from mmlspark_tpu.core.prometheus import slo_families
             try:
                 slo_families(r, self.slo)
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
+        cp = self.__dict__.get("controlplane")
+        if cp is not None:
+            from mmlspark_tpu.core.prometheus import (
+                controlplane_families,
+            )
+            try:
+                controlplane_families(r, cp)
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
         pipeline_families(r, active.pipeline)

@@ -1,0 +1,740 @@
+"""Closed-loop continuous training (serving/controlplane.py) + chaos.
+
+The tentpole suite for the drift -> refit -> shadow -> canary ->
+cutover loop: autonomous promotion under injected distribution shift,
+poisoned refits (label flip / NaN) quarantined with evidence bundles,
+trainer-death isolation (serving frozen, /healthz degraded but 200),
+SIGKILL mid-cutover on a fleet (>=99% availability, zero wrong
+replies, ordered registry timeline), replay-window consistency under
+concurrent append+replay, and the check_control_loop AST audit.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.metrics import DriftMonitor
+from mmlspark_tpu.core.table import DataTable
+from mmlspark_tpu.io.ooc import ReplayWindow
+from mmlspark_tpu.models.linear import TPULogisticRegression
+from mmlspark_tpu.serving import (
+    CanaryPolicy, ContinuousTrainer, GatePolicy, ModelRegistry,
+    RefitPolicy, ServingFleet, TriggerPolicy, json_scoring_pipeline,
+    serve_model,
+)
+from mmlspark_tpu.stages.basic import Lambda
+
+D = 6
+RNG_SEED = 7
+
+
+def _blobs(n=600, d=D, seed=RNG_SEED, shift=0.0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)) + shift
+    w = np.linspace(1.0, -1.0, d)
+    y = (X @ w > shift * w.sum()).astype(np.float64)
+    return X, y
+
+
+def _post(addr, payload, timeout=10.0):
+    req = urllib.request.Request(
+        addr, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _get(addr, path, timeout=10.0):
+    with urllib.request.urlopen(addr + path, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _serve_linear(port, maxIter=60):
+    """A fitted logistic model behind HTTP with its fit-time drift
+    monitor attached — the standard continuous-training target."""
+    X, y = _blobs()
+    est = TPULogisticRegression(maxIter=maxIter)
+    base = est.fit(DataTable({"features": X, "label": y}))
+    dm = DriftMonitor.from_matrix(
+        X, feature_names=[f"f{i}" for i in range(D)])
+    pipe = json_scoring_pipeline(base, drift_monitor=dm)
+    engine = serve_model(pipe, port=port, batch_size=16, workers=2,
+                         version="base")
+    return engine, est, (X, y)
+
+
+def _partial_fit_refit(est):
+    """The canonical refit hook: warm-start partial_fit over the
+    materialized window, fresh drift monitor rebuilt from the window,
+    rewrapped for serving."""
+    def refit(window, active):
+        tab = window.materialize()
+        m = est.partial_fit(tab, getattr(active, "model", None))
+        ndm = DriftMonitor.from_matrix(
+            np.asarray(tab["features"]),
+            feature_names=[f"f{i}" for i in range(D)])
+        return json_scoring_pipeline(m, drift_monitor=ndm)
+    return refit
+
+
+def _trainer(engine, refit, **kw):
+    kw.setdefault("triggers", TriggerPolicy(
+        max_mean_delta_sigma=2.0, min_window_rows=64,
+        cooldown_s=0.3, watch_slo_alerts=False))
+    kw.setdefault("gate", GatePolicy(shadow_rows=256, min_rows=32))
+    kw.setdefault("canary", CanaryPolicy(
+        fraction=0.5, min_batches=3, decision_timeout_s=20))
+    kw.setdefault("warmup_example", {"features": [0.0] * D})
+    kw.setdefault("poll_interval_s", 0.05)
+    return ContinuousTrainer(engine, refit, **kw)
+
+
+class _Traffic:
+    """Background shifted-traffic stream against one engine."""
+
+    def __init__(self, addr, shift=3.0, n_threads=2):
+        self.addr = addr
+        self.shift = shift
+        self.ok = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._run, args=(i,), daemon=True)
+            for i in range(n_threads)]
+
+    def _run(self, tid):
+        rng = np.random.default_rng(1000 + tid)
+        while not self._stop.is_set():
+            x = rng.normal(size=D) + self.shift
+            try:
+                status, _ = _post(self.addr, {"features": list(x)},
+                                  timeout=10)
+                with self._lock:
+                    self.ok += status == 200
+            except Exception:  # noqa: BLE001 — availability metric
+                with self._lock:
+                    self.errors += 1
+            time.sleep(0.002)
+
+    def __enter__(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10)
+
+
+def _wait(pred, timeout=30.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# replay window (satellite: concurrent append+replay consistency)
+# ---------------------------------------------------------------------------
+
+
+class TestReplayWindow:
+    def _chunk(self, value, rows=17):
+        return DataTable({
+            "features": np.full((rows, D), float(value)),
+            "label": np.full(rows, float(value))})
+
+    def test_bounded_eviction_keeps_newest(self):
+        win = ReplayWindow(max_rows=50)
+        for i in range(10):
+            win.append(self._chunk(i, rows=17))
+        assert win.rows <= 50
+        assert win.appended_rows == 170
+        assert win.evicted_chunks > 0
+        tab = win.snapshot().materialize()
+        # only the NEWEST chunks survive eviction (17*3 > 50, so the
+        # window holds the last two whole chunks)
+        assert set(np.asarray(tab["label"])) == {8.0, 9.0}
+
+    def test_single_oversized_chunk_is_kept(self):
+        win = ReplayWindow(max_rows=10)
+        win.append(self._chunk(1, rows=64))
+        assert win.rows == 64    # never evict down to an empty window
+
+    def test_snapshot_is_immutable_and_replayable(self):
+        win = ReplayWindow(max_rows=1000)
+        win.append(self._chunk(1))
+        win.append(self._chunk(2))
+        snap = win.snapshot()
+        win.append(self._chunk(3))
+        # the snapshot replays the SAME bounded view twice, unaffected
+        # by appends that landed after it was taken
+        for _ in range(2):
+            tab = snap.materialize()
+            assert len(tab) == 34
+            assert set(np.asarray(tab["label"])) == {1.0, 2.0}
+
+    def test_tail_returns_newest_rows_in_order(self):
+        win = ReplayWindow(max_rows=1000)
+        for i in range(5):
+            win.append(self._chunk(i, rows=10))
+        tail = win.tail(25)
+        vals = [float(t["label"][0]) for t in tail]
+        # newest whole chunks under the row cap (20 <= 25 < 30),
+        # oldest-to-newest order preserved for concat
+        assert vals == [3.0, 4.0]
+        assert win.tail(1)[0]["label"][0] == 4.0    # >=1 chunk always
+
+    def test_concurrent_append_replay_never_torn(self):
+        """The control loop reads (snapshot + tail) while the ingest
+        driver appends: every replay must see whole chunks only (a
+        chunk is homogeneous here — any mixed-value chunk is a tear)
+        and stay within the bound."""
+        win = ReplayWindow(max_rows=400)
+        stop = threading.Event()
+        tears = []
+        bounds = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                win.append(self._chunk(i % 97, rows=23))
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                snap = win.snapshot()
+                total = 0
+                for chunk in snap.chunks(prefetch_depth=0):
+                    col = np.asarray(chunk["label"])
+                    if len(set(col.tolist())) > 1:
+                        tears.append(col)
+                    total += len(col)
+                bounds.append(total)
+                for t in win.tail(100):
+                    col = np.asarray(t["label"])
+                    if len(set(col.tolist())) > 1:
+                        tears.append(col)
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not tears, f"torn chunk observed: {tears[:1]}"
+        assert bounds and max(bounds) <= 400 + 23, max(bounds)
+        # eviction really ran while replays were in flight
+        assert win.evicted_chunks > 0
+
+
+# ---------------------------------------------------------------------------
+# the AST audit (satellite: check_control_loop)
+# ---------------------------------------------------------------------------
+
+
+def _load_checker(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "tools", "check_fusion_kernels.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestControlLoopAudit:
+    def test_shipped_control_loop_clean(self):
+        mod = _load_checker("cfk_cl_pos")
+        assert mod.check_control_loop() == []
+
+    def test_state_write_outside_funnel_flagged(self):
+        mod = _load_checker("cfk_cl_neg1")
+        bad = (
+            "class T:\n"
+            "    def _transition(self, s, e):\n"
+            "        self.state = s\n"
+            "        self._record(e)\n"
+            "    def _record(self, e):\n"
+            "        self.registry.record_event(e)\n"
+            "    def handle(self):\n"
+            "        self.state = 'degraded'\n")
+        v = mod.check_control_loop_source(bad, name="bad")
+        assert len(v) == 1 and "'handle'" in v[0]
+        assert "_transition" in v[0]
+
+    def test_refit_call_outside_trainer_thread_flagged(self):
+        mod = _load_checker("cfk_cl_neg2")
+        bad = (
+            "class T:\n"
+            "    def _transition(self, s, e):\n"
+            "        self.state = s\n"
+            "        self._record(e)\n"
+            "    def _record(self, e):\n"
+            "        self.registry.record_event(e)\n"
+            "    def _batcher_helper(self):\n"
+            "        return self.est.partial_fit(self.tab)\n"
+            "    def _cycle(self):\n"
+            "        return self.refit(self.win, self.active)\n")
+        v = mod.check_control_loop_source(bad, name="bad")
+        assert len(v) == 1 and "partial_fit" in v[0]
+        assert "_batcher_helper" in v[0]    # _cycle is allowlisted
+
+    def test_unrecorded_transition_flagged(self):
+        mod = _load_checker("cfk_cl_neg3")
+        bad = (
+            "class T:\n"
+            "    def _transition(self, s, e):\n"
+            "        self.state = s\n"    # forgets to record
+            "    def _record(self, e):\n"
+            "        self.registry.record_event(e)\n")
+        v = mod.check_control_loop_source(bad, name="bad")
+        assert len(v) == 1
+        assert "timeline" in v[0]
+
+    def test_recorder_without_registry_flagged(self):
+        mod = _load_checker("cfk_cl_neg4")
+        bad = (
+            "class T:\n"
+            "    def _transition(self, s, e):\n"
+            "        self.state = s\n"
+            "        self._record(e)\n"
+            "    def _record(self, e):\n"
+            "        self.history.append(e)\n")    # never record_event
+        v = mod.check_control_loop_source(bad, name="bad")
+        assert len(v) == 1
+        assert "record_event" in v[0]
+
+
+# ---------------------------------------------------------------------------
+# the chaos soak (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+
+class TestContinuousLoopSoak:
+    def test_autonomous_drift_refit_canary_cutover(self):
+        """Injected distribution shift -> drift trigger -> incremental
+        refit on the trainer thread -> shadow gate pass -> canary ->
+        cutover, fully autonomous; the registry timeline holds every
+        decision in order and the steady-state serving path compiles
+        nothing."""
+        import jax.monitoring as jmon
+        engine, est, (X, y) = _serve_linear(20200)
+        registry = ModelRegistry()
+        tr = _trainer(engine, _partial_fit_refit(est),
+                      registry=registry)
+        compile_events = []
+        watching = {"on": False}
+        jmon.register_event_listener(
+            lambda name, **kw: compile_events.append(name)
+            if watching["on"] and "compil" in name else None)
+        try:
+            tr.start()
+            with _Traffic(engine.source.address, shift=3.0) as load:
+                # labeled shifted rows arrive out of band
+                Xs, ys = _blobs(n=400, seed=11, shift=3.0)
+                for lo in range(0, 400, 50):
+                    tr.ingest(DataTable({
+                        "features": Xs[lo:lo + 50],
+                        "label": ys[lo:lo + 50]}))
+                assert _wait(lambda: tr.promotions >= 1, timeout=60), \
+                    f"no promotion: {tr.status()} {tr.history}"
+                assert engine.model_version == "ct-1"
+                assert load.errors == 0, \
+                    f"{load.errors} failed during the loop"
+                # zero steady-state recompiles on the serving path
+                watching["on"] = True
+                for i in range(30):
+                    status, body = _post(
+                        engine.source.address,
+                        {"features": list(Xs[i % len(Xs)])})
+                    assert status == 200 and "prediction" in body
+                watching["on"] = False
+                assert compile_events == [], compile_events
+            # drift watch restarted: the promoted pipeline's fresh
+            # monitor took over and the loop settled (no retrigger spin)
+            assert tr.cycles == 1, tr.status()
+            # every decision on ONE ordered registry timeline
+            kinds = [(type(e).__name__, e.kind)
+                     for e in registry.events]
+            expected = [("RetrainEvent", "loop_started"),
+                        ("RetrainEvent", "triggered"),
+                        ("RetrainEvent", "refit_ok"),
+                        ("ShadowEvent", "shadow_pass"),
+                        ("PromoteEvent", "promote_started"),
+                        ("SwapEvent", "completed"),
+                        ("PromoteEvent", "promoted")]
+            it = iter(kinds)
+            assert all(k in it for k in expected), (expected, kinds)
+            ats = [e.at for e in registry.events]
+            assert ats == sorted(ats)
+            trig = next(e for e in registry.events
+                        if getattr(e, "kind", "") == "triggered")
+            assert trig.reason.startswith("drift:")
+            assert ">=" in trig.reason    # observed vs threshold
+            # the exposition carries the loop + per-feature drift
+            text = engine.metrics_text()
+            assert "serving_controlplane_promotions_total 1" in text
+            assert 'serving_drift_score{feature="' in text
+            assert "serving_controlplane_phase_ms" in text
+        finally:
+            tr.stop()
+            engine.stop()
+        # loop_stopped landed too (stop() transitions through the
+        # funnel like everything else)
+        assert registry.events[-1].kind == "loop_stopped"
+
+    def test_poisoned_refit_label_flip_quarantined(self):
+        """A label-flipped refit produces a confidently-wrong model:
+        the quality gate quarantines it — never promoted — and the
+        evidence bundle carries the gate verdict."""
+        engine, est, (X, y) = _serve_linear(20210)
+        registry = ModelRegistry()
+
+        def poisoned(window, active):
+            tab = window.materialize()
+            flipped = DataTable({
+                "features": np.asarray(tab["features"]),
+                "label": 1.0 - np.asarray(tab["label"])})
+            return json_scoring_pipeline(
+                TPULogisticRegression(maxIter=200).fit(flipped))
+
+        tr = _trainer(engine, poisoned, registry=registry)
+        try:
+            tr.start()
+            Xs, ys = _blobs(n=300, seed=13)
+            tr.ingest(DataTable({"features": Xs, "label": ys}))
+            tr.trigger_now("poison-drill")
+            assert _wait(lambda: tr.quarantines >= 1, timeout=60), \
+                tr.status()
+            assert tr.promotions == 0
+            assert engine.model_version == "base"    # never promoted
+            q = tr.quarantined["ct-1"]
+            assert q["verdict"]["pass"] is False
+            assert q["verdict"]["reason"].startswith(
+                "gate:quality_delta")
+            assert q["verdict"]["quality_candidate"] < \
+                q["verdict"]["quality_baseline"]
+            # the flight-recorder bundle contains the gate verdict
+            bundle = q["bundle"]
+            assert bundle is not None
+            assert bundle["reason"].startswith("quarantine:ct-1:gate")
+            recorded = [ev for evs in bundle["events"].values()
+                        for ev in evs
+                        if ev.get("kind") == "quarantined"]
+            assert recorded, bundle["events"].keys()
+            assert recorded[0]["stats"]["quality_delta"] < -0.02
+            # and the timeline shows fail, not promote
+            kinds = [getattr(e, "kind", "") for e in registry.events]
+            assert "quarantined" in kinds
+            assert "promoted" not in kinds
+        finally:
+            tr.stop()
+            engine.stop()
+
+    def test_poisoned_refit_nan_quarantined(self):
+        """A NaN-emitting candidate dies at the nan_rate floor."""
+        engine, est, _ = _serve_linear(20220)
+
+        class _NaNModel:
+            def predict(self, X):
+                return np.full(len(X), np.nan)
+
+        tr = _trainer(engine,
+                      lambda w, a: types.SimpleNamespace(
+                          model=_NaNModel()))
+        try:
+            tr.start()
+            Xs, ys = _blobs(n=200, seed=17)
+            tr.ingest(DataTable({"features": Xs, "label": ys}))
+            tr.trigger_now("nan-drill")
+            assert _wait(lambda: tr.quarantines >= 1, timeout=60), \
+                tr.status()
+            verdict = tr.quarantined["ct-1"]["verdict"]
+            assert verdict["reason"].startswith("gate:nan_rate")
+            assert verdict["nan_rate"] == 1.0
+            assert engine.model_version == "base"
+        finally:
+            tr.stop()
+            engine.stop()
+
+    def test_refit_failures_open_circuit_serving_frozen(self):
+        """Repeated refit failures: retries with backoff inside the
+        cycle, then the circuit opens — /healthz degrades (HTTP 200),
+        serving continues on the frozen model."""
+        engine, est, (X, y) = _serve_linear(20230)
+        attempts = []
+
+        def broken(window, active):
+            attempts.append(1)
+            raise RuntimeError("trainer backend down")
+
+        tr = _trainer(
+            engine, broken,
+            refit_policy=RefitPolicy(max_attempts=2, backoff_s=0.01,
+                                     circuit_after=2,
+                                     circuit_reset_s=120.0))
+        try:
+            tr.start()
+            Xs, ys = _blobs(n=200, seed=19)
+            tr.ingest(DataTable({"features": Xs, "label": ys}))
+            tr.trigger_now("fail-1")
+            assert _wait(lambda: tr.refit_failures >= 1, timeout=30)
+            tr.trigger_now("fail-2")
+            assert _wait(lambda: tr.circuit_open, timeout=30), \
+                tr.status()
+            assert len(attempts) == 4    # 2 cycles x 2 attempts
+            st = tr.status()
+            assert st["state"] == "degraded" and st["degraded"]
+            # training death never takes serving down: frozen model
+            # still answers, /healthz says degraded with HTTP 200
+            status, body = _post(engine.source.address,
+                                 {"features": list(X[0])})
+            assert status == 200 and "prediction" in body
+            hstatus, health = _get(engine.source.address, "/healthz")
+            assert hstatus == 200
+            assert health["status"] == "degraded"
+            assert health["controlplane"]["circuit_open"]
+            assert engine.model_version == "base"
+            kinds = [getattr(e, "kind", "") for e in tr.history]
+            assert "circuit_open" in kinds
+            assert kinds.count("refit_failed") == 2
+        finally:
+            tr.stop()
+            engine.stop()
+
+    def test_trainer_death_isolation(self):
+        """Chaos: the trainer thread dies abruptly. The engine keeps
+        serving the frozen model; /healthz reports the control plane
+        degraded but stays HTTP 200."""
+        engine, est, (X, y) = _serve_linear(20240)
+        tr = _trainer(engine, _partial_fit_refit(est))
+        try:
+            tr.start()
+            assert _wait(lambda: tr.status()["trainer_alive"],
+                         timeout=10)
+            tr.kill_trainer()
+            assert _wait(
+                lambda: not tr.status()["trainer_alive"], timeout=10)
+            st = tr.status()
+            assert st["degraded"]
+            # request path unaffected: replies keep flowing promptly
+            t0 = time.perf_counter()
+            for i in range(20):
+                status, body = _post(engine.source.address,
+                                     {"features": list(X[i])})
+                assert status == 200 and "prediction" in body
+            assert (time.perf_counter() - t0) < 10
+            hstatus, health = _get(engine.source.address, "/healthz")
+            assert hstatus == 200
+            assert health["status"] == "degraded"
+            assert health["controlplane"]["trainer_alive"] is False
+        finally:
+            tr.stop()
+            engine.stop()
+
+    def test_sigkill_mid_cutover_fleet_stays_available(self):
+        """SIGKILL (engine.kill(), the in-process crash analog) lands
+        mid-canary during an autonomous promotion: the fleet fails over
+        (>=99% availability), every reply is correct (zero wrong
+        replies), and the registry timeline stays consistent and
+        ordered — the cycle ends in quarantine with the swap evidence,
+        never a phantom promote."""
+        def versioned(version):
+            def handle(table):
+                return table.with_column("reply", [
+                    {"echo": json.loads(r["entity"].decode())["x"],
+                     "v": version}
+                    for r in table["request"]])
+            return Lambda.apply(handle)
+
+        fleet = ServingFleet(versioned("v1"), n_engines=2,
+                             base_port=20260, batch_size=4, workers=1,
+                             max_wait_ms=2.0, failure_threshold=3,
+                             breaker_cooldown=30.0)
+        registry = ModelRegistry()
+        engine = fleet.engines[0]
+        tr = _trainer(
+            engine, lambda w, a: versioned("v2"),
+            registry=registry,
+            predict_fn=lambda pipe, Xm: np.zeros(len(Xm)),
+            # a long canary keeps the cutover IN FLIGHT so the kill
+            # lands mid-swap; the timeout bounds the test
+            canary=CanaryPolicy(fraction=0.5, min_batches=10_000,
+                                decision_timeout_s=3.0),
+            warmup_example=None)
+        results = {}
+        stop_load = threading.Event()
+
+        def client(cid, n=400):
+            for j in range(n):
+                if stop_load.is_set():
+                    return
+                key = cid * 100000 + j
+                try:
+                    body = fleet.post({"x": key}, timeout=5.0)
+                    results[key] = (body.get("echo") == key
+                                    and body.get("v") in ("v1", "v2"))
+                except Exception:  # noqa: BLE001 — availability metric
+                    results[key] = False
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        try:
+            tr.start()
+            Xs, ys = _blobs(n=200, seed=23)
+            tr.ingest(DataTable({"features": Xs,
+                                 "label": np.zeros(200)}))
+            for t in threads:
+                t.start()
+            tr.trigger_now("chaos-drill")
+            assert _wait(lambda: engine.swap_state == "canary",
+                         timeout=30), (engine.swap_state, tr.status())
+            engine.kill()    # SIGKILL mid-cutover
+            # the cycle must complete: canary cannot promote on a dead
+            # engine — decision timeout -> rollback -> quarantine
+            assert _wait(lambda: tr.quarantines + tr.promotions >= 1,
+                         timeout=30), tr.status()
+            for t in threads:
+                t.join(timeout=60)
+            assert not any(t.is_alive() for t in threads)
+        finally:
+            stop_load.set()
+            tr.stop()
+            fleet.stop_all()
+        total = len(results)
+        ok = sum(results.values())
+        assert total >= 1000
+        assert ok / total >= 0.99, f"availability {ok}/{total}"
+        # zero wrong replies is implied by ok counting echo+version
+        # correctness, not just HTTP success
+        assert tr.promotions == 0
+        assert tr.quarantines == 1
+        reason = tr.quarantined["ct-1"]["verdict"]["reason"]
+        assert reason.startswith("canary:breach:")
+        # consistent ordered timeline: every decision present, in
+        # order, with the rolled-back swap between promote_started and
+        # quarantined
+        kinds = [(type(e).__name__, getattr(e, "kind", ""))
+                 for e in registry.events]
+        expected = [("RetrainEvent", "triggered"),
+                    ("RetrainEvent", "refit_ok"),
+                    ("ShadowEvent", "shadow_pass"),
+                    ("PromoteEvent", "promote_started"),
+                    ("SwapEvent", "rolled_back"),
+                    ("QuarantineEvent", "quarantined")]
+        it = iter(kinds)
+        assert all(k in it for k in expected), (expected, kinds)
+        ats = [e.at for e in registry.events]
+        assert ats == sorted(ats)
+
+    def test_idempotent_recovery_after_restart(self):
+        """A restarted trainer resumes the version sequence from the
+        registry (no collisions) and carries quarantine verdicts
+        through state_dict()/load_state()."""
+        engine, est, _ = _serve_linear(20250)
+        registry = ModelRegistry()
+        registry.register("ct-3", object())    # survived the crash
+        tr1 = _trainer(engine, _partial_fit_refit(est),
+                       registry=registry)
+        tr1.quarantined["ct-2"] = {
+            "verdict": {"pass": False, "reason": "gate:nan_rate"},
+            "bundle": None, "at": 0.0}
+        tr1.quarantines = 1
+        try:
+            tr1.start()
+            state = tr1.state_dict()
+            tr1.stop()
+            # "engine restart": a fresh trainer on the same registry
+            tr2 = _trainer(engine, _partial_fit_refit(est),
+                           registry=registry, state=state)
+            tr2._sync_version_counter()
+            # next version continues PAST both the registry (ct-3) and
+            # the carried counter — never reissues a burned name
+            assert tr2._next_version() == "ct-4"
+            assert tr2.quarantines == 1
+            assert tr2.quarantined["ct-2"]["verdict"]["reason"] == \
+                "gate:nan_rate"
+            # and a start() on the restarted trainer is idempotent
+            # about the baseline registration
+            tr2.start()
+            assert registry.versions().count("base") == 1
+            tr2.stop()
+        finally:
+            engine.stop()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (satellite: per-feature drift + loop families)
+# ---------------------------------------------------------------------------
+
+
+class TestDriftExposition:
+    def test_per_feature_scores_capped_with_overflow_fold(self):
+        from mmlspark_tpu.core.prometheus import (
+            DRIFT_FEATURE_CAP, PromRenderer, drift_families,
+        )
+        d = DRIFT_FEATURE_CAP + 9
+        mon = DriftMonitor(np.zeros(d), np.ones(d),
+                           feature_names=[f"f{i}" for i in range(d)])
+        X = np.zeros((200, d))
+        X[:, 3] = 5.0    # f3 is the drifted feature
+        mon.observe(X)
+        r = PromRenderer()
+        drift_families(r, mon)
+        text = r.render()
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("serving_drift_score{")]
+        # top-K + exactly one _other fold, never one-per-feature
+        assert len(lines) == DRIFT_FEATURE_CAP + 1, lines
+        assert sum('feature="_other"' in ln for ln in lines) == 1
+        f3 = [ln for ln in lines if 'feature="f3"' in ln]
+        assert f3 and float(f3[0].split()[-1]) == pytest.approx(
+            5.0, rel=0.01)
+
+    def test_few_features_no_overflow_series(self):
+        from mmlspark_tpu.core.prometheus import (
+            PromRenderer, drift_families,
+        )
+        mon = DriftMonitor(np.zeros(4), np.ones(4),
+                           feature_names=list("abcd"))
+        mon.observe(np.ones((10, 4)))
+        r = PromRenderer()
+        drift_families(r, mon)
+        text = r.render()
+        assert 'feature="a"' in text
+        # no overflow fold when everything fits under the cap (the
+        # HELP line may mention it; no SERIES must carry it)
+        assert 'serving_drift_score{feature="_other"}' not in text
+
+    def test_controlplane_families_render(self):
+        from mmlspark_tpu.core.prometheus import (
+            PromRenderer, controlplane_families,
+        )
+        fake = types.SimpleNamespace(status=lambda: {
+            "state": "idle", "degraded": False, "circuit_open": False,
+            "cycles": 3, "refits": 2, "refit_failures": 1,
+            "promotions": 2, "quarantines": 1, "last_trigger": "drift:x",
+            "window": {"rows": 128}})
+        r = PromRenderer()
+        controlplane_families(r, fake)
+        text = r.render()
+        assert "serving_controlplane_promotions_total 2" in text
+        assert "serving_controlplane_quarantines_total 1" in text
+        assert "serving_controlplane_degraded 0" in text
+        assert "serving_controlplane_window_rows 128" in text
+        assert 'state="idle"' in text
+        assert "serving_controlplane_phase_ms" in text

@@ -193,7 +193,10 @@ class TestEngineSwap:
                 policy=CanaryPolicy(fraction=0.5, min_batches=2,
                                     decision_timeout_s=0.5))
             assert res.rolled_back
-            assert res.reason == "breach:decision_timeout"
+            assert res.reason.startswith("breach:decision_timeout")
+            # the reason is self-explanatory: observed evidence counts
+            # vs the promote threshold travel in the string itself
+            assert "canary_ok=0/2 needed" in res.reason
             assert engine.model_version == "v1"
         finally:
             engine.stop()

@@ -333,6 +333,167 @@ def check_ooc_ingest() -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# continuous-training control loop (serving/controlplane.py)
+# ---------------------------------------------------------------------------
+
+# The control-loop discipline, statically enforced:
+#   1. every `self.state` write happens inside the `_transition` funnel
+#      (or `__init__`, the pre-loop initial value) — so no state change
+#      can skip the timeline;
+#   2. `_transition` calls `_record`, and `_record` calls
+#      `record_event` — so the funnel actually lands the event on the
+#      registry timeline;
+#   3. refit/validation work (`refit`/`partial_fit`/`boost_more`/
+#      `_run_refit`/`_shadow_and_gate`) is invoked ONLY from the
+#      registered trainer-thread callsites;
+#   4. the serving hot-path loops (batcher/worker/execute/supervisor)
+#      never call into refit/validation — training on the request path
+#      is the failure mode the dedicated trainer thread exists to
+#      prevent.
+_CONTROL_STATE_FUNNEL = "_transition"
+_CONTROL_RECORDER = "_record"
+_CONTROL_STATE_WRITERS = frozenset({_CONTROL_STATE_FUNNEL, "__init__"})
+_REFIT_CALL_NAMES = frozenset({
+    "refit", "partial_fit", "boost_more", "_run_refit",
+    "_shadow_and_gate",
+})
+# trainer-thread callsites allowed to invoke refit/validation work
+_TRAINER_ALLOWLIST = frozenset({
+    "_cycle", "_run_refit", "_shadow_and_gate",
+})
+# serving hot-path functions that must stay training-free (the
+# forbidden set adds the cycle entrypoint + fit: a hot loop must not
+# even *start* a training cycle synchronously)
+_SERVING_HOT_LOOPS = (
+    ("mmlspark_tpu.serving.server", "ServingEngine._batcher_loop"),
+    ("mmlspark_tpu.serving.server", "ServingEngine._worker_loop"),
+    ("mmlspark_tpu.serving.server", "ServingEngine._execute_batch"),
+    ("mmlspark_tpu.serving.server", "ServingEngine._supervise"),
+)
+_SERVING_FORBIDDEN = _REFIT_CALL_NAMES | {"_cycle", "fit"}
+
+
+def _call_name(func) -> str:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _walk_with_owner(tree):
+    """Yield (innermost_function_name, node) over the tree."""
+    stack: List[str] = []
+
+    def visit(node):
+        is_fn = isinstance(node, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))
+        if is_fn:
+            stack.append(node.name)
+        owner = stack[-1] if stack else "<module>"
+        yield owner, node
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+        if is_fn:
+            stack.pop()
+
+    yield from visit(tree)
+
+
+def check_control_loop_source(src: str, first: int = 1,
+                              name: str = "serving/controlplane.py",
+                              ) -> List[str]:
+    """The control-loop discipline audit over ONE module source (rules
+    1-3 above). Exposed at source level so the tier-1 tests can feed it
+    positive and negative examples."""
+    try:
+        tree = ast.parse(textwrap.dedent(src))
+    except SyntaxError:
+        return [f"{name}: unparseable control-loop source"]
+    violations: List[str] = []
+    record_calls_in: dict = {}    # func name -> set of callee names
+    for owner, node in _walk_with_owner(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and t.attr == "state" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" \
+                        and owner not in _CONTROL_STATE_WRITERS:
+                    violations.append(
+                        f"{name} (line {first + node.lineno - 1}): "
+                        f"'self.state' written in {owner!r} — every "
+                        f"loop state change must go through "
+                        f"{_CONTROL_STATE_FUNNEL!r} so its timeline "
+                        f"event is recorded")
+        if isinstance(node, ast.Call):
+            callee = _call_name(node.func)
+            record_calls_in.setdefault(owner, set()).add(callee)
+            if callee in _REFIT_CALL_NAMES and \
+                    owner not in _TRAINER_ALLOWLIST:
+                violations.append(
+                    f"{name} (line {first + node.lineno - 1}): "
+                    f"refit/validation call {callee!r} from "
+                    f"{owner!r} — training work runs only on the "
+                    f"trainer thread (allowlist: "
+                    f"{sorted(_TRAINER_ALLOWLIST)})")
+    funnel_calls = record_calls_in.get(_CONTROL_STATE_FUNNEL, set())
+    if _CONTROL_RECORDER not in funnel_calls and \
+            "record_event" not in funnel_calls:
+        violations.append(
+            f"{name}: {_CONTROL_STATE_FUNNEL!r} no longer records its "
+            f"event ({_CONTROL_RECORDER!r}/'record_event' not called) "
+            f"— transitions would vanish from the registry timeline")
+    recorder_calls = record_calls_in.get(_CONTROL_RECORDER, set())
+    if recorder_calls and "record_event" not in recorder_calls:
+        violations.append(
+            f"{name}: {_CONTROL_RECORDER!r} does not call "
+            f"'record_event' — events never reach the registry")
+    return violations
+
+
+def check_control_loop() -> List[str]:
+    """Rules 1-3 over the real serving/controlplane.py, plus rule 4
+    over the engine's serving hot loops (empty = clean)."""
+    import importlib
+    mod = importlib.import_module("mmlspark_tpu.serving.controlplane")
+    src = inspect.getsource(mod)
+    violations = check_control_loop_source(src)
+    for module, qualname in _SERVING_HOT_LOOPS:
+        try:
+            fn = _resolve_qualname(module, qualname)
+        except (ImportError, AttributeError) as e:
+            violations.append(f"{module}.{qualname}: unresolvable "
+                              f"({e}) — update _SERVING_HOT_LOOPS")
+            continue
+        fn = inspect.unwrap(fn)
+        try:
+            lines, fl = inspect.getsourcelines(fn)
+        except OSError as e:
+            violations.append(
+                f"{module}.{qualname}: unreadable source ({e})")
+            continue
+        try:
+            tree = ast.parse(textwrap.dedent("".join(lines)))
+        except SyntaxError:
+            violations.append(
+                f"{module}.{qualname}: unparseable hot-loop source")
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                callee = _call_name(node.func)
+                if callee in _SERVING_FORBIDDEN:
+                    violations.append(
+                        f"{module}.{qualname} (line "
+                        f"{fl + node.lineno - 1}): refit/validation "
+                        f"call {callee!r} on a serving hot loop — "
+                        f"training must never run on batcher/worker "
+                        f"threads")
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # sharded serving programs (mesh-sharded pjit path — serving/sharded.py)
 # ---------------------------------------------------------------------------
 
@@ -558,6 +719,7 @@ def main() -> int:
     n_ingress = len(INGRESS_REGISTRY)
     violations += check_ingress_kernels()
     violations += check_ooc_ingest()
+    violations += check_control_loop()
     if violations:
         print(f"{len(violations)} kernel violation(s) across {n} fused "
               f"+ {n_ingress} ingress registered kernels:")
@@ -568,7 +730,9 @@ def main() -> int:
           f"{n_ingress} ingress kernels, no per-row iteration; "
           f"{len(_SHARDED_JIT_SITES)} sharded jit builders declare "
           f"explicit shardings; {len(_OOC_HOT_PATHS)} chunked hot "
-          f"paths never materialize the stream")
+          f"paths never materialize the stream; control loop "
+          f"transitions all recorded, {len(_SERVING_HOT_LOOPS)} "
+          f"serving hot loops training-free")
     return 0
 
 

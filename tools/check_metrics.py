@@ -71,7 +71,7 @@ RESERVED_SUFFIXES = ("_total", "_bucket", "_sum", "_count")
 
 # label keys that identify an unbounded population: any family carrying
 # one must declare its cardinality story in CAPPED_FAMILIES
-UNBOUNDED_LABEL_KEYS = {"model", "version", "tenant"}
+UNBOUNDED_LABEL_KEYS = {"model", "version", "tenant", "feature"}
 
 # families allowed to carry unbounded-identity labels, because their
 # renderers are hard-capped at the source:
@@ -85,6 +85,10 @@ CAPPED_FAMILIES = {
     # capped identity space (docs/observability.md)
     "serving_slo_model_burn_rate",
     "serving_slo_alert_active",
+    # drift exposition: per-feature scores capped at DRIFT_FEATURE_CAP
+    # (top-K by score), overflow folds into feature="_other"
+    # (core/prometheus.py drift_families)
+    "serving_drift_score",
 }
 
 # dynamic (f-string) family names, with their FULL expected expansions —
