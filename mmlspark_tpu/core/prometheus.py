@@ -261,6 +261,49 @@ def zoo_families(r: PromRenderer, zoo: Any,
                     hist, {**base, "model": label})
 
 
+def placement_families(r: PromRenderer, placement: Any,
+                       labels: Optional[Dict[str, Any]] = None) -> None:
+    """The fleet placement plane's families (serving/placement.py):
+    plan size and churn (full totals), per-model replica counts — the
+    label space HARD-CAPPED at ``REPLICA_LABEL_CAP`` highest-replica
+    models, overflow summed into ``model="_other"`` (the
+    serving_model_latency_ms discipline) — the plan-rebuild latency
+    histogram, and stale-route fallbacks."""
+    from mmlspark_tpu.serving.placement import REPLICA_LABEL_CAP
+    base = dict(labels or {})
+    s = placement.stats()
+    r.gauge("serving_placement_models",
+            "models in the current placement plan", s["models"], base)
+    r.gauge("serving_placement_assignments",
+            "total (model, engine) assignment pairs in the plan",
+            s["assignments"], base)
+    r.counter("serving_placement_rebuilds_total",
+              "placement plan rebuilds", s["rebuilds"], base)
+    r.counter("serving_placement_stale_routes_total",
+              "model-keyed requests routed without a plan entry "
+              "(fallback to any engine + lazy activation)",
+              s["stale_routes"], base)
+    replicas = sorted(placement.replica_counts().items(),
+                      key=lambda kv: (-kv[1], kv[0]))
+    other = 0
+    for i, (model, count) in enumerate(replicas):
+        if i < REPLICA_LABEL_CAP:
+            r.gauge("serving_placement_replicas",
+                    "engines assigned per model (cardinality-capped: "
+                    'overflow models fold into model="_other")',
+                    count, {**base, "model": model})
+        else:
+            other += count
+    if other:
+        r.gauge("serving_placement_replicas",
+                "engines assigned per model (cardinality-capped: "
+                'overflow models fold into model="_other")',
+                other, {**base, "model": "_other"})
+    r.histogram("serving_placement_rebuild_ms",
+                "placement plan rebuild latency",
+                placement.rebuild_hist, base)
+
+
 def slo_families(r: PromRenderer, monitor: Any,
                  labels: Optional[Dict[str, Any]] = None) -> None:
     """The windowed SLO engine's families (core/slo.py): per-objective

@@ -65,6 +65,9 @@ import numpy as np
 CT_JSON = "application/json"
 CT_MSGPACK_COLUMNS = "application/x-msgpack-columns"
 CT_ARROW_STREAM = "application/vnd.apache.arrow.stream"
+# the body is a tiny control message; the MCOL frame itself lives in a
+# shared-memory segment the control message points into (io/shm.py)
+CT_SHM_COLUMNS = "application/x-shm-columns"
 
 # codec name -> content type (the negotiation table; "json" is the
 # oracle and the default for anything unrecognized — old clients never
@@ -73,6 +76,7 @@ CODEC_CONTENT_TYPES: Dict[str, str] = {
     "json": CT_JSON,
     "msgpack": CT_MSGPACK_COLUMNS,
     "arrow": CT_ARROW_STREAM,
+    "shm": CT_SHM_COLUMNS,
 }
 _CT_TO_CODEC = {v: k for k, v in CODEC_CONTENT_TYPES.items()}
 
@@ -346,7 +350,9 @@ def _decode_msgpack_columns(body: bytes) -> ColumnarBatch:
                     "msgpack header but msgpack is unavailable")
             header = mp.unpackb(hdr_bytes, raw=False)
         else:
-            header = json.loads(hdr_bytes.decode("utf-8"))
+            # bytes() tolerates a memoryview body (the shm path decodes
+            # frames in place over the shared segment)
+            header = json.loads(bytes(hdr_bytes).decode("utf-8"))
     except CodecError:
         raise
     except Exception as e:  # noqa: BLE001 — malformed header
@@ -482,16 +488,26 @@ def _decode_arrow(body: bytes) -> ColumnarBatch:
 register_ingress_kernel(_decode_arrow, "ingress.decode_arrow")
 
 
+def _decode_shm(body: bytes) -> ColumnarBatch:
+    """Lazy delegate: the shared-memory transport imports only when a
+    shm-negotiated request actually arrives (keeps ``import
+    mmlspark_tpu.serving`` host-only cheap)."""
+    from mmlspark_tpu.io import shm as _shm
+    return _shm.decode_control(body)
+
+
 _DECODERS: Dict[str, Callable[[bytes], ColumnarBatch]] = {
     "msgpack": _decode_msgpack_columns,
     "arrow": _decode_arrow,
+    "shm": _decode_shm,
 }
 
 
 def decode_columnar(codec: str, body: Optional[bytes]) -> ColumnarBatch:
-    """Decode one request body under ``codec`` (``"msgpack"`` or
-    ``"arrow"``). Raises ``CodecError`` on anything malformed — the
-    engine turns that into a 400 for this request only."""
+    """Decode one request body under ``codec`` (``"msgpack"``,
+    ``"arrow"``, or ``"shm"``). Raises ``CodecError`` on anything
+    malformed — the engine turns that into a 400 for this request
+    only."""
     fn = _DECODERS.get(codec)
     if fn is None:
         raise CodecError(f"unknown columnar codec {codec!r}")

@@ -46,11 +46,25 @@ _SHARDED_EXPORTS = frozenset({
     "serving_mesh", "tensor_shard_model",
 })
 
+# the multi-host fabric (serving/placement.py, io/shm.py) resolves
+# lazily too: shm pulls numpy + the columnar codecs, and neither
+# belongs on the import path of a client that never opts in
+_FABRIC_EXPORTS = {
+    "PlacementController": ("mmlspark_tpu.serving.placement",),
+    "PlacementEvent": ("mmlspark_tpu.serving.placement",),
+    "ShmRing": ("mmlspark_tpu.io.shm",),
+    "shm_available": ("mmlspark_tpu.io.shm",),
+}
+
 
 def __getattr__(name):
     if name in _SHARDED_EXPORTS:
         from mmlspark_tpu.serving import sharded as _sharded
         return getattr(_sharded, name)
+    if name in _FABRIC_EXPORTS:
+        import importlib
+        mod = importlib.import_module(_FABRIC_EXPORTS[name][0])
+        return getattr(mod, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
 
@@ -60,7 +74,8 @@ __all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
            "FlightRecorder", "GatePolicy", "HTTPSource",
            "IngestDriver",
            "ModelRegistry", "ModelZoo", "PartitionConsolidator",
-           "PipelineHandle", "PromoteEvent", "QuarantineEvent",
+           "PipelineHandle", "PlacementController", "PlacementEvent",
+           "PromoteEvent", "QuarantineEvent",
            "RefitPolicy", "RetrainEvent",
            "SLO", "SLOMonitor", "ServingEngine",
            "ServingFleet", "ServingUnavailable", "ShadowEvent",
@@ -73,4 +88,5 @@ __all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
            "get_recorder", "json_row_scoring_pipeline",
            "json_scoring_pipeline", "load_model", "model_key_of",
            "read_manifest", "seq_shard_lm", "serve_model",
-           "serving_mesh", "tensor_shard_model"]
+           "serving_mesh", "shm_available", "ShmRing",
+           "tensor_shard_model"]

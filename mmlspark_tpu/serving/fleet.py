@@ -46,6 +46,10 @@ from mmlspark_tpu.utils.resilience import CircuitBreaker
 
 log = get_logger("serving.fleet")
 
+# sentinel: "this batch should ride HTTP instead" from the shm rung —
+# distinct from any engine reply (which is always a dict)
+_SHM_DECLINED = object()
+
 
 class ServingUnavailable(RuntimeError):
     """Every candidate engine failed at the transport level (or was
@@ -775,7 +779,8 @@ class ServingFleet:
                  version: str = "v0", tracer=None,
                  tracing: Optional[bool] = None,
                  zoo=None, admission=None,
-                 slo=None, flight_recorder=None):
+                 slo=None, flight_recorder=None,
+                 shm_transport: bool = False):
         # the multi-model plane: ONE zoo (and one admission controller)
         # shared by every engine — models are process-resident, so the
         # device-memory budget and tenant quotas are fleet-wide
@@ -784,6 +789,7 @@ class ServingFleet:
         self._init_client(tracer=tracer, tracing=tracing,
                           hedge_percentile=hedge_percentile,
                           hedge_min_s=hedge_min_s)
+        self.shm_transport = bool(shm_transport)
         port = base_port
         try:
             for _ in range(n_engines):
@@ -849,6 +855,21 @@ class ServingFleet:
         self._columnar_ok = True
         self.columnar_retry_cooldown_s = 60.0
         self._columnar_retry_at = 0.0
+        # shared-memory transport negotiation: the SAME cooldown
+        # discipline, one more rung up the ladder. shm -> HTTP+msgpack
+        # -> per-row JSON; each rung remembers a rejection for a
+        # cooldown, then re-probes. The shm rung only exists when the
+        # client opted in (co-located deployments; io/shm.py).
+        # the fleet-wide placement plane (serving/placement.py);
+        # attach_placement wires a controller in
+        self.placement = None
+        self.shm_transport = False
+        self._shm_ok = True
+        self.shm_retry_cooldown_s = 60.0
+        self._shm_retry_at = 0.0
+        self._shm_ring = None
+        self._shm_lock = threading.Lock()
+        self._shm_fallbacks = 0
         # itertools.count: next() is atomic under the GIL, so
         # concurrent client threads can't tear the round-robin
         self._next = itertools.count()
@@ -885,7 +906,8 @@ class ServingFleet:
                 tracer=None,
                 tracing: Optional[bool] = None,
                 wait_ready_s: float = 0.0,
-                ready_poll_timeout_s: float = 1.0) -> "ServingFleet":
+                ready_poll_timeout_s: float = 1.0,
+                shm_transport: bool = False) -> "ServingFleet":
         """A CLIENT-ONLY fleet over engines that live in OTHER
         processes (or hosts): the same round-robin + circuit-breaking
         + failover + hedging client, pointed at explicit addresses
@@ -917,6 +939,7 @@ class ServingFleet:
         fleet._init_client(tracer=tracer, tracing=tracing,
                            hedge_percentile=hedge_percentile,
                            hedge_min_s=hedge_min_s)
+        fleet.shm_transport = bool(shm_transport)
         fleet._remote_addresses = [str(a).rstrip("/") for a in addresses]
         if not fleet._remote_addresses:
             raise ValueError("connect() needs at least one address")
@@ -1390,6 +1413,19 @@ class ServingFleet:
         n = len(self.addresses)
         start = next(self._next)
         order = [(start + k) % n for k in range(n)]
+        if self.placement is not None and model:
+            # the placement plane: assigned engines first (round-robin
+            # WITHIN the replica set), the rest of the fleet behind
+            # them — a stale plan or a dying replica set falls through
+            # to any engine, where the zoo's lazy activation takes over
+            self.placement.record_request(model)
+            self.placement.rebuild()        # rate-limited internally
+            preferred = [i for i in self.placement.engines_for(model)
+                         if 0 <= i < n]
+            if preferred:
+                k = start % len(preferred)
+                head = preferred[k:] + preferred[:k]
+                order = head + [i for i in order if i not in set(head)]
         max_tries = n if idempotent else 1
         attempts: List[Dict[str, Any]] = []
         tried: set = set()
@@ -1542,6 +1578,15 @@ class ServingFleet:
         doomed columnar attempt (the PR 2 stale-connection retry
         discipline applied to content negotiation)."""
         from mmlspark_tpu.io import columnar as CIN
+        if self.shm_transport and (
+                self._shm_ok
+                or time.monotonic() >= self._shm_retry_at):
+            result = self._post_columns_shm(columns, timeout,
+                                            idempotent, model=model,
+                                            tenant=tenant,
+                                            priority=priority)
+            if result is not _SHM_DECLINED:
+                return result
         try_columnar = (self._columnar_ok
                         or time.monotonic() >= self._columnar_retry_at)
         if try_columnar:
@@ -1576,6 +1621,104 @@ class ServingFleet:
                         "fallback path for %.0fs before re-probing",
                         self.columnar_retry_cooldown_s)
         return out
+
+    def _ensure_shm_ring(self):
+        """Lazily create this client's shared-memory ring (io/shm.py);
+        the client OWNS the segment and unlinks it in stop_all/
+        close_shm."""
+        with self._shm_lock:
+            if self._shm_ring is None:
+                from mmlspark_tpu.io import shm as SHM
+                self._shm_ring = SHM.ShmRing()
+            return self._shm_ring
+
+    def _shm_declined(self, cooldown: bool) -> Any:
+        """Record one shm->HTTP fallback; with ``cooldown`` the shm
+        rung stays down for ``shm_retry_cooldown_s`` (negotiation
+        verdict), without it the next call retries shm immediately
+        (transient local condition: ring full, frame too big)."""
+        with self._stats_lock:
+            self._shm_fallbacks += 1
+        if cooldown:
+            self._shm_ok = False
+            self._shm_retry_at = (time.monotonic()
+                                  + self.shm_retry_cooldown_s)
+            log.warning("engine does not accept the shm transport; "
+                        "using HTTP bodies for %.0fs before re-probing",
+                        self.shm_retry_cooldown_s)
+        return _SHM_DECLINED
+
+    def _post_columns_shm(self, columns: Dict[str, Any],
+                          timeout: float, idempotent: bool,
+                          model: Optional[str] = None,
+                          tenant: Optional[str] = None,
+                          priority: Optional[int] = None) -> Any:
+        """The shared-memory rung: frame the columns into a ring slot
+        (one staged copy, no body bytes) and post only the tiny control
+        message. Returns ``_SHM_DECLINED`` when this batch should ride
+        HTTP instead (ring full / frame too big / engine rejected the
+        codec); ``ServingUnavailable`` and app-level errors propagate —
+        they are not negotiation failures."""
+        from mmlspark_tpu.io import shm as SHM
+        try:
+            ring = self._ensure_shm_ring()
+            ctrl, ct, token = ring.write(columns)
+        except (SHM.ShmBackpressure, SHM.ShmCapacity):
+            return self._shm_declined(cooldown=False)
+        except Exception:  # noqa: BLE001 — no /dev/shm, perms, ...
+            return self._shm_declined(cooldown=True)
+        clean = True
+        try:
+            result = self.post(ctrl, timeout=timeout,
+                               idempotent=idempotent,
+                               content_type=ct, model=model,
+                               tenant=tenant, priority=priority)
+            self._shm_ok = True   # (re-)probe succeeded
+            return result
+        except urllib.error.HTTPError as e:
+            # the engine REPLIED (it is done with the slot): 400/415 =
+            # cannot attach / stale / explicit no; 500 = a pre-shm
+            # engine that parsed the control message as an ordinary
+            # JSON request and choked at the app level — all three are
+            # negotiation verdicts, fall back (the columnar-rung
+            # discipline); other app-level errors surface unchanged
+            if e.code in (400, 415, 500):
+                return self._shm_declined(cooldown=True)
+            raise
+        except Exception:
+            # transport failure / total outage: an engine may still be
+            # mid-read on the slot — quarantine it, don't reuse soon
+            clean = False
+            raise
+        finally:
+            ring.release(token, clean=clean)
+
+    def attach_placement(self, controller=None, **kwargs):
+        """Wire a fleet-wide ``PlacementController`` (serving/
+        placement.py) into the client: model-keyed posts route to the
+        model's assigned engines first. Pass a controller, or kwargs to
+        build one over this fleet's zoo and engine count. Returns the
+        controller."""
+        if controller is None:
+            from mmlspark_tpu.serving.placement import (
+                PlacementController,
+            )
+            controller = PlacementController(
+                self.zoo, n_engines=len(self.addresses), **kwargs)
+        self.placement = controller
+        return controller
+
+    def close_shm(self) -> None:
+        """Unlink this client's shm ring (owner side) and drop any
+        engine-side attachments living in this process."""
+        with self._shm_lock:
+            ring, self._shm_ring = self._shm_ring, None
+        if ring is not None:
+            ring.close()
+        import sys
+        shm_mod = sys.modules.get("mmlspark_tpu.io.shm")
+        if shm_mod is not None:
+            shm_mod.close_attachments()
 
     def _post_columns_json(self, columns: Dict[str, Any],
                            timeout: float,
@@ -1735,6 +1878,14 @@ class ServingFleet:
                 zoo_families(r, self.zoo)
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
+        if self.placement is not None:
+            # the placement plane is fleet-level by construction: one
+            # controller, one family set
+            from mmlspark_tpu.core.prometheus import placement_families
+            try:
+                placement_families(r, self.placement)
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         if self.engines:
             for key in self.engines[0].hists:
                 merged = LatencyHistogram.merged(
@@ -1746,12 +1897,41 @@ class ServingFleet:
             # (model hists, jit misses, drift) are already fleet-wide
             pipeline_families(r, self.engines[0].pipeline)
         with self._stats_lock:
-            transport, hedged = self.transport_errors, \
-                self.hedged_requests
+            transport, hedged, shm_fb = (self.transport_errors,
+                                         self.hedged_requests,
+                                         self._shm_fallbacks)
         r.counter("serving_fleet_transport_errors_total",
                   "client-side transport failures", transport)
         r.counter("serving_fleet_hedged_requests_total",
                   "tail-latency hedge requests fired", hedged)
+        # shared-memory transport: process-wide counters (io/shm.py) —
+        # rendered only once the transport has actually loaded, so a
+        # fleet that never negotiated shm pays no import
+        import sys as _sys
+        shm_mod = _sys.modules.get("mmlspark_tpu.io.shm")
+        if shm_mod is not None or shm_fb:
+            st = shm_mod.stats() if shm_mod is not None else {}
+            att = (shm_mod.attached_count()
+                   if shm_mod is not None else 0)
+            r.gauge("serving_shm_segments",
+                    "shared-memory segments this process maps "
+                    "(owned ring + engine-side attachments)",
+                    att + (1 if self._shm_ring is not None else 0))
+            r.counter("serving_shm_batches_total",
+                      "columnar batches carried over shared memory",
+                      st.get("batches", 0))
+            r.counter("serving_shm_bytes_total",
+                      "columnar frame bytes placed in shared memory",
+                      st.get("bytes", 0))
+            r.counter("serving_shm_stale_slots_total",
+                      "shm decodes rejected by a generation mismatch",
+                      st.get("gen_mismatch", 0))
+            r.counter("serving_shm_segments_reaped_total",
+                      "dead owners' segments unlinked by a survivor",
+                      st.get("reaped", 0))
+            r.counter("serving_shm_fallbacks_total",
+                      "batches that fell back from shm to HTTP bodies",
+                      shm_fb)
         process_families(r, tracer=self.tracer)
         return r.render()
 
@@ -1853,6 +2033,7 @@ class ServingFleet:
     def stop_all(self) -> None:
         for e in self.engines:
             e.stop()
+        self.close_shm()
 
 
 class PartitionConsolidator(Transformer):

@@ -1,0 +1,318 @@
+"""Fleet-wide placement plane (serving/placement.py): demand-driven
+replica counts, residency-aware balanced packing, sticky assignments,
+stale-route fallback, fleet-global eviction through the zoo's
+invariants, and the placement events on the registry timeline."""
+
+import json
+import time
+
+import pytest
+
+from mmlspark_tpu.serving.placement import (
+    PlacementController, PlacementEvent,
+)
+from mmlspark_tpu.serving.zoo import RESIDENT, UNLOADED, ModelZoo, ZooEvent
+from mmlspark_tpu.stages.basic import Lambda
+
+
+def echo_stage(tag):
+    def handle(table):
+        replies = []
+        for r in table["request"]:
+            row = (json.loads(r["entity"].decode())
+                   if r.get("entity") else {})
+            replies.append({"served_by": tag, "x": row.get("x")})
+        return table.with_column("reply", replies)
+    return Lambda.apply(handle)
+
+
+def fresh_zoo(n_models=4, **kw):
+    kw.setdefault("memory_probe", None)
+    zoo = ModelZoo(**kw)
+    for i in range(n_models):
+        zoo.register_factory(f"m{i}", "v1",
+                             (lambda i=i: echo_stage(f"m{i}")))
+    return zoo
+
+
+class _Recorder:
+    """A zoo stand-in: the event timeline plus residency-cost rows."""
+
+    def __init__(self, costs=None):
+        self.events = []
+        self._costs = dict(costs or {})
+
+    def record_event(self, event):
+        self.events.append(event)
+
+    def stats(self):
+        rows = [{"model": k.partition("@")[0],
+                 "version": k.partition("@")[2] or "v1",
+                 "cost_bytes": v} for k, v in self._costs.items()]
+        return {"models": rows}
+
+
+def _drive(ctl, model, n):
+    for _ in range(n):
+        ctl.record_request(model)
+
+
+class TestPlacementController:
+    def test_hot_gets_replicas_cold_gets_one(self):
+        ctl = PlacementController(None, n_engines=4, hot_share=0.5)
+        _drive(ctl, "hot", 30)
+        _drive(ctl, "cold", 1)
+        ctl.rebuild(force=True)
+        counts = ctl.replica_counts()
+        assert counts["hot"] >= 2
+        assert counts["cold"] == 1
+
+    def test_every_demanded_model_stays_servable(self):
+        ctl = PlacementController(None, n_engines=2)
+        for m in ("a", "b", "c", "d"):
+            _drive(ctl, m, 3)
+        plan = ctl.rebuild(force=True)
+        assert set(plan) == {"a", "b", "c", "d"}
+        assert all(len(v) >= 1 for v in plan.values())
+        assert all(0 <= i < 2 for v in plan.values() for i in v)
+
+    def test_max_replicas_caps_hot_models(self):
+        ctl = PlacementController(None, n_engines=4, max_replicas=1)
+        _drive(ctl, "hot", 50)
+        ctl.rebuild(force=True)
+        assert ctl.replica_counts()["hot"] == 1
+
+    def test_residency_aware_packing_spreads_cost(self):
+        rec = _Recorder(costs={"a@v1": 100, "b@v1": 100})
+        ctl = PlacementController(rec, n_engines=2, hot_share=0.9)
+        _drive(ctl, "a", 5)
+        _drive(ctl, "b", 5)
+        plan = ctl.rebuild(force=True)
+        # two equal-cost single-replica models land on DIFFERENT
+        # engines (balanced packing), not both on engine 0
+        assert plan["a"] != plan["b"]
+
+    def test_assignments_are_sticky_across_rebuilds(self):
+        rec = _Recorder()
+        ctl = PlacementController(rec, n_engines=3)
+        _drive(ctl, "a", 5)
+        _drive(ctl, "b", 5)
+        first = ctl.rebuild(force=True)
+        n_events = len(rec.events)
+        second = ctl.rebuild(force=True)
+        assert second == first
+        # no assign/unassign churn — only the rebuild summary lands
+        new = rec.events[n_events:]
+        assert [e.kind for e in new] == ["rebuild"]
+
+    def test_rebuild_is_rate_limited(self):
+        ctl = PlacementController(None, n_engines=2,
+                                  rebuild_min_interval_s=600.0)
+        _drive(ctl, "a", 3)
+        ctl.rebuild(force=True)
+        n = ctl.rebuilds
+        _drive(ctl, "b", 30)
+        plan = ctl.rebuild()               # inside the min interval
+        assert ctl.rebuilds == n
+        assert "b" not in plan             # the frozen plan, unchanged
+
+    def test_mark_engine_dead_reassigns_immediately(self):
+        ctl = PlacementController(None, n_engines=2, hot_share=0.1)
+        _drive(ctl, "hot", 20)
+        ctl.rebuild(force=True)
+        assert ctl.replica_counts()["hot"] == 2
+        ctl.mark_engine_dead(0)
+        plan = ctl.assignments()
+        assert 0 not in plan["hot"] and plan["hot"] == (1,)
+        ctl.mark_engine_alive(0)
+        assert ctl.rebuild(force=True)["hot"] == (0, 1)
+
+    def test_stale_route_counted_for_unknown_model(self):
+        ctl = PlacementController(None, n_engines=2)
+        assert ctl.engines_for("never-seen") == []
+        assert ctl.stale_routes == 1
+
+    def test_timeline_events_carry_engine_deltas(self):
+        rec = _Recorder()
+        ctl = PlacementController(rec, n_engines=2, hot_share=0.1)
+        _drive(ctl, "hot", 20)
+        ctl.rebuild(force=True, reason="demand")
+        kinds = [e.kind for e in rec.events]
+        assert kinds == ["assign", "rebuild"]
+        assign = rec.events[0]
+        assert isinstance(assign, PlacementEvent)
+        assert assign.model == "hot"
+        assert assign.stats["engines"] == [0, 1]
+        assert rec.events[1].stats["models"] == 1
+        ctl.mark_engine_dead(1)
+        unassigns = [e for e in rec.events if e.kind == "unassign"]
+        assert unassigns and unassigns[0].stats["engines"] == [1]
+        assert unassigns[0].reason == "engine1_dead"
+
+
+class TestPlacementEviction:
+    def test_evict_coldest_offers_coldest_first(self):
+        zoo = fresh_zoo(n_models=3)
+        ctl = PlacementController(zoo, n_engines=2)
+        try:
+            zoo.get("m0")
+            zoo.get("m1")
+            _drive(ctl, "m0", 30)
+            _drive(ctl, "m1", 1)
+            assert ctl.evict_coldest(keep=1) == "m1"
+            assert zoo.lookup("m1@v1")[1] == UNLOADED
+            assert zoo.lookup("m0@v1")[1] == RESIDENT
+        finally:
+            zoo.close()
+
+    def test_zoo_invariants_arbitrate_every_offer(self):
+        zoo = fresh_zoo(n_models=3)
+        ctl = PlacementController(zoo, n_engines=2)
+        try:
+            zoo.get("m0")
+            zoo.get("m1")
+            _drive(ctl, "m0", 30)
+            _drive(ctl, "m1", 1)
+            # the coldest model has parked waiters somewhere in the
+            # fleet: the zoo refuses; the NEXT coldest is offered, but
+            # keep=1 protects the hottest — nothing is evicted
+            zoo.add_waiter("m1")
+            assert ctl.evict_coldest(keep=1) is None
+            assert zoo.lookup("m1@v1")[1] == RESIDENT
+            # outstanding batches refuse the same way
+            zoo.remove_waiter("m1")
+            handle, state, _ = zoo.acquire("m1")
+            assert state == RESIDENT
+            assert ctl.evict_coldest(keep=1) is None
+            handle.release()
+            assert ctl.evict_coldest(keep=1) == "m1"
+        finally:
+            zoo.close()
+
+    def test_demand_for_unregistered_spec_is_skipped(self):
+        zoo = fresh_zoo(n_models=1)
+        ctl = PlacementController(zoo, n_engines=1)
+        try:
+            _drive(ctl, "ghost", 1)
+            _drive(ctl, "m0", 5)
+            assert ctl.evict_coldest(keep=1) is None
+        finally:
+            zoo.close()
+
+
+class TestFleetPlacement:
+    def _fleet(self, base_port, n_models=3, **kw):
+        from mmlspark_tpu.serving.fleet import ServingFleet
+        zoo = fresh_zoo(n_models=n_models)
+        fleet = ServingFleet(n_engines=2, base_port=base_port, zoo=zoo,
+                             tracing=False)
+        ctl = fleet.attach_placement(**kw)
+        return fleet, zoo, ctl
+
+    def test_hot_cold_plan_with_one_activation(self):
+        fleet, zoo, ctl = self._fleet(20410, rebuild_min_interval_s=0.0)
+        try:
+            for i in range(20):
+                assert fleet.post({"x": i},
+                                  model="m0")["served_by"] == "m0"
+            for i in range(2):
+                assert fleet.post({"x": i},
+                                  model="m1")["served_by"] == "m1"
+            ctl.rebuild(force=True)
+            counts = ctl.replica_counts()
+            assert counts["m0"] == 2 and counts["m1"] == 1
+            # the engines share ONE zoo: replicating m0 across both
+            # engines never re-loaded it
+            rows = {r["model"]: r for r in zoo.stats()["models"]}
+            assert rows["m0"]["loads"] == 1
+            text = fleet.metrics_text()
+            assert "serving_placement_rebuilds_total" in text
+            assert 'serving_placement_replicas{model="m0"} 2' in text
+            assert "serving_placement_rebuild_ms_bucket" in text
+        finally:
+            fleet.stop_all()
+            zoo.close()
+
+    def test_stale_route_falls_back_and_lazily_activates(self):
+        fleet, zoo, ctl = self._fleet(20430,
+                                      rebuild_min_interval_s=600.0)
+        try:
+            ctl.rebuild(force=True)        # empty plan, then frozen
+            sr0 = ctl.stale_routes
+            out = fleet.post({"x": 9}, model="m2")
+            assert out["served_by"] == "m2"     # any engine + lazy load
+            assert ctl.stale_routes > sr0
+        finally:
+            fleet.stop_all()
+            zoo.close()
+
+    def test_routes_prefer_assigned_engines(self):
+        fleet, zoo, ctl = self._fleet(20450,
+                                      rebuild_min_interval_s=600.0)
+        try:
+            ctl.rebuild(force=True)
+            with ctl._lock:
+                ctl._assignments = {"m1": (1,)}
+            seen0 = [e.source.requests_seen for e in fleet.engines]
+            for i in range(6):
+                assert fleet.post({"x": i},
+                                  model="m1")["served_by"] == "m1"
+            seen1 = [e.source.requests_seen for e in fleet.engines]
+            assert seen1[1] - seen0[1] == 6
+            assert seen1[0] - seen0[0] == 0
+            # the engine dies (placement-plane view): the plan
+            # reassigns and traffic follows without a config change
+            ctl.mark_engine_dead(1)
+            assert ctl.assignments()["m1"] == (0,)
+            for i in range(3):
+                assert fleet.post({"x": i},
+                                  model="m1")["served_by"] == "m1"
+            seen2 = [e.source.requests_seen for e in fleet.engines]
+            assert seen2[0] - seen1[0] == 3
+        finally:
+            fleet.stop_all()
+            zoo.close()
+
+    def test_timeline_interleaves_zoo_and_placement_events(self):
+        fleet, zoo, ctl = self._fleet(20470, rebuild_min_interval_s=0.0)
+        try:
+            fleet.post({"x": 0}, model="m0")
+            fleet.post({"x": 1}, model="m1")
+            ctl.rebuild(force=True)
+            classes = {type(e).__name__ for e in zoo.events}
+            assert {"ZooEvent", "PlacementEvent"} <= classes
+            stamps = [e.at for e in zoo.events]
+            assert stamps == sorted(stamps)
+        finally:
+            fleet.stop_all()
+            zoo.close()
+
+
+class TestFabricLazyExports:
+    def test_import_serving_does_not_load_the_fabric(self):
+        """`import mmlspark_tpu.serving` must stay host-only cheap:
+        the placement plane and the shm transport load only when an
+        export is actually touched (PEP 562)."""
+        import os
+        import subprocess
+        import sys
+        code = (
+            "import sys\n"
+            "import mmlspark_tpu.serving as sv\n"
+            "assert 'mmlspark_tpu.serving.placement' not in sys.modules\n"
+            "assert 'mmlspark_tpu.io.shm' not in sys.modules\n"
+            "ctl = sv.PlacementController(None, n_engines=2)\n"
+            "assert 'mmlspark_tpu.serving.placement' in sys.modules\n"
+            "assert sv.shm_available() in (True, False)\n"
+            "assert 'mmlspark_tpu.io.shm' in sys.modules\n"
+            "ring = sv.ShmRing(nslots=1, slot_bytes=4096)\n"
+            "ring.close()\n"
+            "print('LAZY_OK')\n"
+        )
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-c", code], cwd=repo, text=True,
+            capture_output=True, timeout=240,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert out.returncode == 0, out.stderr
+        assert "LAZY_OK" in out.stdout

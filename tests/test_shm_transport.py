@@ -1,0 +1,507 @@
+"""Shared-memory columnar transport (io/shm.py): ring slot lifecycle,
+bit-parity with the in-body msgpack codec, crash-safety (generation
+tags, quarantine, dead-owner reaping), the fleet client's
+shm -> HTTP+msgpack -> per-row JSON fallback ladder, and the
+SIGKILL failure envelope across real OS processes."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.io import columnar as C
+from mmlspark_tpu.io import shm as S
+
+pytestmark = pytest.mark.skipif(
+    not S.shm_available(), reason="no POSIX shared memory on this host")
+
+
+COLS = {
+    "f32": np.array([[1.5, -2.25], [np.nan, np.inf], [-np.inf, 0.0]],
+                    dtype=np.float32),
+    "f64": np.array([1.0, np.nan, -1e300]),
+    "i64": np.array([1, -2, 2**40], dtype=np.int64),
+    "flag": np.array([True, False, True]),
+    "s": ["héllo", None, "𝔘nicode\n\"quoted\""],
+    "toks": [["a", "bb"], [], ["𝔠", ""]],
+}
+
+
+@pytest.fixture()
+def ring():
+    r = S.ShmRing(nslots=2, slot_bytes=1 << 16)
+    yield r
+    r.close()
+    S.close_attachments()
+
+
+def _tpu_model(dim=8, classes=4):
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    rng = np.random.default_rng(3)
+    W = rng.normal(size=(dim, classes)).astype(np.float32)
+    return TPUModel.from_fn(
+        lambda w, ins: list(ins.values())[0] @ w["W"], {"W": W},
+        inputCol="features", outputCol="scores", batchSize=32)
+
+
+class TestShmRing:
+    def test_roundtrip_parity_all_types(self, ring):
+        ctrl, ct, token = ring.write(COLS)
+        assert ct == C.CT_SHM_COLUMNS
+        try:
+            got = S.decode_control(ctrl)
+            oracle = C.decode_columnar(
+                "msgpack", C.encode_columns(COLS)[0])
+            assert got.codec == "shm"
+            assert got.n_rows == oracle.n_rows == 3
+            for k in ("f32", "f64", "i64"):
+                np.testing.assert_array_equal(got.columns[k],
+                                              oracle.columns[k])
+                assert got.columns[k].dtype == oracle.columns[k].dtype
+            assert list(np.asarray(got.columns["flag"], bool)) == \
+                list(np.asarray(oracle.columns["flag"], bool))
+            assert got.columns["s"] == oracle.columns["s"]
+            assert [list(t) for t in got.columns["toks"]] == \
+                [list(t) for t in oracle.columns["toks"]]
+        finally:
+            ring.release(token)
+
+    def test_numeric_columns_are_views_into_the_segment(self, ring):
+        arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+        ctrl, _, token = ring.write({"f": arr})
+        try:
+            dec = S.decode_control(ctrl).columns["f"]
+            assert dec.base is not None          # a view, not a copy
+            assert not dec.flags.owndata
+            np.testing.assert_array_equal(dec, arr)
+        finally:
+            ring.release(token)
+
+    def test_content_type_negotiates_to_shm_codec(self, ring):
+        ctrl, ct, token = ring.write({"x": np.ones(2)})
+        try:
+            assert C.negotiate({"Content-Type": ct}) == "shm"
+            # the engine-side decoder table route
+            b = C.decode_columnar("shm", ctrl)
+            assert b.codec == "shm" and b.n_rows == 2
+        finally:
+            ring.release(token)
+
+    def test_stale_generation_raises(self, ring):
+        ctrl_old, _, token = ring.write({"x": np.ones(3)})
+        ring.release(token)
+        # the slot recycles under a new generation; the old control
+        # message must be refused, never decoded against the new frame
+        ctrl_new, _, token2 = ring.write({"y": np.zeros(5)})
+        try:
+            with pytest.raises(C.CodecError, match="stale shm slot"):
+                S.decode_control(ctrl_old)
+            assert S.decode_control(ctrl_new).n_rows == 5
+        finally:
+            ring.release(token2)
+
+    def test_backpressure_when_all_slots_in_flight(self, ring):
+        t1 = ring.write({"x": np.ones(1)})[2]
+        t2 = ring.write({"x": np.ones(1)})[2]
+        with pytest.raises(S.ShmBackpressure):
+            ring.write({"x": np.ones(1)})
+        ring.release(t1)
+        t3 = ring.write({"x": np.ones(1)})[2]
+        ring.release(t2)
+        ring.release(t3)
+
+    def test_capacity_failure_returns_the_slot(self, ring):
+        big = np.zeros(1 << 18)     # 2 MiB frame vs 64 KiB slots
+        with pytest.raises(S.ShmCapacity):
+            ring.write({"x": big})
+        # the claimed slot went straight back to the free list
+        tokens = [ring.write({"x": np.ones(1)})[2] for _ in range(2)]
+        for t in tokens:
+            ring.release(t)
+
+    def test_unclean_release_quarantines_the_slot(self):
+        r = S.ShmRing(nslots=1, slot_bytes=1 << 12)
+        try:
+            token = r.write({"x": np.ones(1)})[2]
+            r.release(token, clean=False)
+            # quarantined: a reader might still hold views on the frame
+            with pytest.raises(S.ShmBackpressure):
+                r.write({"x": np.ones(1)})
+            # after the cooldown the slot recycles
+            with r._lock:
+                r._quarantine[:] = [(t, 0.0) for t, _ in r._quarantine]
+            r.release(r.write({"x": np.ones(1)})[2])
+        finally:
+            r.close()
+            S.close_attachments()
+
+    def test_nonexistent_segment_raises_codec_error(self):
+        ctrl = json.dumps({"v": 1, "seg": "psm_does_not_exist_xyz",
+                           "slot": 0, "off": 16, "len": 64, "gen": 1,
+                           "pid": 0}).encode()
+        with pytest.raises(C.CodecError, match="not attachable"):
+            S.decode_control(ctrl)
+
+    @pytest.mark.parametrize("bad", [
+        b"", b"not json", b"{}", b'{"seg": 7}',
+    ])
+    def test_malformed_control_raises_codec_error(self, bad):
+        with pytest.raises(C.CodecError):
+            S.decode_control(bad)
+
+    def test_out_of_bounds_frame_refused(self, ring):
+        ctrl, _, token = ring.write({"x": np.ones(2)})
+        try:
+            c = json.loads(ctrl)
+            c["len"] = ring.nslots * (S._SLOT_HDR.size
+                                      + ring.slot_bytes) + 64
+            with pytest.raises(C.CodecError, match="exceeds segment"):
+                S.decode_control(json.dumps(c).encode())
+        finally:
+            ring.release(token)
+
+    def test_close_unlinks_the_segment(self):
+        r = S.ShmRing(nslots=1, slot_bytes=1 << 12)
+        name = r.name
+        assert os.path.exists(f"/dev/shm/{name}")
+        r.close()
+        assert not os.path.exists(f"/dev/shm/{name}")
+
+
+class TestShmChecker:
+    def _tools(self):
+        import importlib
+        import sys as _sys
+        tools = os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools")
+        if tools not in _sys.path:
+            _sys.path.insert(0, tools)
+        return importlib.import_module("check_fusion_kernels")
+
+    def test_shipped_shm_hot_paths_clean(self):
+        chk = self._tools()
+        assert S.SHM_REGISTRY, "shm hot paths must be registered"
+        violations = chk.check_shm_transport()
+        assert violations == [], violations
+
+    def test_checker_catches_unacknowledged_copies(self):
+        chk = self._tools()
+
+        def copying_path(arr, mv):
+            mv[:arr.nbytes] = arr.tobytes()
+
+        def sanctioned_path(body):
+            return bytes(body)  # shm:copy-ok — control message
+
+        S.register_shm_kernel(copying_path, "test.copying_path")
+        S.register_shm_kernel(sanctioned_path, "test.sanctioned_path")
+        try:
+            violations = chk.check_shm_transport()
+            assert any("test.copying_path" in v and ".tobytes" in v
+                       for v in violations), violations
+            assert not any("test.sanctioned_path" in v
+                           for v in violations), violations
+        finally:
+            S.SHM_REGISTRY.pop(copying_path.__code__, None)
+            S.SHM_REGISTRY.pop(sanctioned_path.__code__, None)
+
+    def test_checker_catches_leaked_slot_acquire(self):
+        chk = self._tools()
+
+        def leaky(self, columns):
+            slot = self._claim_slot()
+            return self.encode(slot, columns)   # a raise leaks the slot
+
+        def paired(self, columns):
+            slot = self._claim_slot()
+            try:
+                return self.encode(slot, columns)
+            except Exception:
+                self.release(slot)
+                raise
+
+        S.register_shm_kernel(leaky, "test.leaky")
+        S.register_shm_kernel(paired, "test.paired")
+        try:
+            violations = chk.check_shm_transport()
+            assert any("test.leaky" in v and "leaks the slot" in v
+                       for v in violations), violations
+            assert not any("test.paired" in v for v in violations), \
+                violations
+        finally:
+            S.SHM_REGISTRY.pop(leaky.__code__, None)
+            S.SHM_REGISTRY.pop(paired.__code__, None)
+
+
+class TestShmFleetTransport:
+    def test_fleet_shm_bit_parity_and_slot_recycling(self):
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        fleet = ServingFleet(json_scoring_pipeline(_tpu_model()),
+                             n_engines=2, base_port=20310,
+                             batch_size=8, workers=1,
+                             shm_transport=True)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(5, 8))
+        x[0, 0] = np.nan
+        try:
+            out = fleet.post_columns({"features": x})
+            assert fleet._shm_ok is True
+            ring = fleet._shm_ring
+            assert ring is not None
+            # every slot released once the replies landed
+            assert sorted(ring._free) == list(range(ring.nslots))
+            # bit parity against the per-row JSON oracle
+            for i, row in enumerate(x):
+                ref = fleet.post({"features": list(map(float, row))})
+                assert out["prediction"][i] == ref["prediction"]
+            text = fleet.metrics_text()
+            assert "serving_shm_batches_total" in text
+            assert 'codec="shm"' in text
+            assert fleet._shm_fallbacks == 0
+        finally:
+            fleet.stop_all()
+        assert fleet._shm_ring is None
+
+    def test_old_engine_falls_down_the_whole_ladder(self):
+        """A pre-shm, pre-columnar engine parses the shm control
+        message as an ordinary JSON request and 500s; the msgpack body
+        also fails; the rows replay as per-row JSON — correct answers,
+        both fast rungs pinned down for a cooldown."""
+        from mmlspark_tpu.serving.fleet import ServingFleet
+        from mmlspark_tpu.stages.basic import Lambda
+
+        def old_handle(table):   # the pre-columnar protocol, verbatim
+            rows = [json.loads(r["entity"].decode())
+                    for r in table["request"]]
+            return table.with_column(
+                "reply", [{"prediction": float(sum(r["features"]))}
+                          for r in rows])
+
+        fleet = ServingFleet(Lambda.apply(old_handle), n_engines=1,
+                             base_port=20330, batch_size=8, workers=1,
+                             shm_transport=True)
+        try:
+            out = fleet.post_columns({"features": np.ones((3, 4))})
+            assert out["prediction"] == [4.0, 4.0, 4.0]
+            assert fleet._shm_ok is False
+            assert fleet._columnar_ok is False
+            assert fleet._shm_fallbacks >= 1
+            # verdicts remembered: the next call goes straight to JSON
+            seen0 = fleet.engines[0].source.requests_seen
+            out = fleet.post_columns({"features": np.ones((3, 4))})
+            assert out["prediction"] == [4.0, 4.0, 4.0]
+            assert fleet.engines[0].source.requests_seen - seen0 == 3
+        finally:
+            fleet.stop_all()
+
+    def test_shm_pin_is_a_cooldown_not_a_life_sentence(self):
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        fleet = ServingFleet(json_scoring_pipeline(_tpu_model()),
+                             n_engines=1, base_port=20350,
+                             batch_size=8, workers=1,
+                             shm_transport=True)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 8))
+        try:
+            fleet._shm_ok = False
+            fleet._shm_retry_at = time.monotonic() + 999
+            fleet.post_columns({"features": x})
+            # pinned: no ring was ever created for the HTTP body path
+            assert fleet._shm_ring is None
+            assert fleet._shm_ok is False
+            # cooldown expired: the next call re-probes shm and un-pins
+            fleet._shm_retry_at = 0.0
+            out = fleet.post_columns({"features": x})
+            assert len(out["prediction"]) == 2
+            assert fleet._shm_ok is True
+            assert fleet._shm_ring is not None
+        finally:
+            fleet.stop_all()
+
+    def test_backpressure_rides_http_without_a_cooldown(self):
+        from mmlspark_tpu.serving.fleet import (
+            ServingFleet, json_scoring_pipeline,
+        )
+        fleet = ServingFleet(json_scoring_pipeline(_tpu_model()),
+                             n_engines=1, base_port=20370,
+                             batch_size=8, workers=1,
+                             shm_transport=True)
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 8))
+        try:
+            ring = S.ShmRing(nslots=1, slot_bytes=1 << 12)
+            ring._claim_slot()          # every slot in flight
+            fleet._shm_ring = ring
+            out = fleet.post_columns({"features": x})
+            assert len(out["prediction"]) == 2
+            # a full ring is a transient local condition: one HTTP
+            # fallback, but the shm rung stays up for the next call
+            assert fleet._shm_fallbacks == 1
+            assert fleet._shm_ok is True
+            assert fleet._shm_retry_at == 0.0
+        finally:
+            fleet.stop_all()
+
+
+# ---------------------------------------------------------------------------
+# the failure envelope: SIGKILL across real OS processes
+# ---------------------------------------------------------------------------
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "serving_worker.py")
+
+_OWNER_SCRIPT = """
+import sys, time
+import numpy as np
+sys.path.insert(0, {repo!r})
+from mmlspark_tpu.io import shm as S
+r = S.ShmRing(nslots=2, slot_bytes=1 << 14)
+ctrl, ct, tok = r.write({{"x": np.arange(8.0)}})
+print(ctrl.decode("ascii"), flush=True)
+time.sleep(120)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _fd_count() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+class TestShmFailureEnvelope:
+    def test_survivor_reaps_dead_owner_segment(self):
+        """The client is SIGKILL'd mid-flight: the engine (survivor)
+        can still decode the in-flight frame, and the opportunistic
+        reaper unlinks the orphaned segment once the owner is gone."""
+        p = subprocess.Popen(
+            [sys.executable, "-c", _OWNER_SCRIPT.format(repo=_REPO)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            ctrl = p.stdout.readline().strip().encode()
+            assert ctrl, p.stderr.read()
+            name = json.loads(ctrl)["seg"]
+            assert os.path.exists(f"/dev/shm/{name}")
+            batch = S.decode_control(ctrl)    # cross-process attach
+            np.testing.assert_array_equal(batch.columns["x"],
+                                          np.arange(8.0))
+            del batch                         # drop the segment views
+            p.send_signal(signal.SIGKILL)
+            p.wait(timeout=30)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                S.reap_dead_owners(force=True)
+                if not os.path.exists(f"/dev/shm/{name}"):
+                    break
+                time.sleep(0.2)
+            assert not os.path.exists(f"/dev/shm/{name}")
+        finally:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+            S.close_attachments()
+
+    def test_sigkill_engine_under_shm_load(self):
+        """Kill one of three engine processes mid-shm-load: requests
+        fail over to the surviving attach-capable engines, availability
+        holds >= 99% with zero wrong replies, no fd leak in the client,
+        and the placement plane reassigns off the dead engine."""
+        from mmlspark_tpu.serving.fleet import ServingFleet
+        nworkers, dim = 3, 8
+        procs, addrs = [], []
+        for wid in range(nworkers):
+            port = _free_port()
+            p = subprocess.Popen(
+                [sys.executable, _WORKER, str(port), str(wid),
+                 "--scorer", "linear", "--dim", str(dim),
+                 "--batch-size", "32"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+            procs.append(p)
+            addrs.append(None)
+        fleet = None
+        try:
+            for wid, p in enumerate(procs):
+                line = p.stdout.readline().strip()
+                parts = line.split()
+                assert parts and parts[0] == "READY", line
+                addrs[wid] = parts[2]
+            fleet = ServingFleet.connect(addrs, wait_ready_s=60.0,
+                                         failure_threshold=2,
+                                         breaker_cooldown=1.0,
+                                         tracing=False,
+                                         shm_transport=True)
+            ctl = fleet.attach_placement()
+            rng = np.random.default_rng(3)
+            rows = rng.normal(size=(4, dim)).astype(np.float32)
+            expected = fleet.post_columns({"features": rows})
+            assert len(expected["prediction"]) == 4
+            assert fleet._shm_ok is True      # engines attach the ring
+            fd0 = _fd_count()
+
+            results = {"ok": 0, "failed": 0, "wrong": 0}
+            lock = threading.Lock()
+            stop = threading.Event()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        rep = fleet.post_columns({"features": rows},
+                                                 timeout=30)
+                        ok = rep == expected
+                        with lock:
+                            results["ok" if ok else "wrong"] += 1
+                    except Exception:  # noqa: BLE001
+                        with lock:
+                            results["failed"] += 1
+
+            threads = [threading.Thread(target=client, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(1.0)
+            procs[0].send_signal(signal.SIGKILL)
+            ctl.record_request("lin")
+            ctl.rebuild(force=True)
+            assert ctl.assignments()["lin"]   # planned somewhere
+            ctl.mark_engine_dead(0)           # confirmed death
+            assert 0 not in ctl.assignments()["lin"]
+            time.sleep(3.0)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            total = sum(results.values())
+            assert total > 20, results
+            availability = results["ok"] / total
+            assert availability >= 0.99, (availability, results)
+            assert results["wrong"] == 0, results
+            # the survivors still decode shm frames after the kill
+            assert fleet._shm_ok is True
+            # no fd leak through the kill + failover churn
+            assert _fd_count() - fd0 < 20
+            ring_name = fleet._shm_ring.name
+            fleet.stop_all()
+            fleet = None
+            # the owner unlinked its ring on teardown
+            assert not os.path.exists(f"/dev/shm/{ring_name}")
+        finally:
+            if fleet is not None:
+                fleet.stop_all()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.wait(timeout=30)

@@ -221,6 +221,177 @@ def check_ingress_kernels() -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# shared-memory transport hot paths (io/shm.py + the fleet client rung)
+# ---------------------------------------------------------------------------
+
+# the shm promise: ONE staged copy per numeric column (np.copyto into
+# the segment), zero body bytes. Any other materialization on a
+# registered shm hot path — ``.tobytes()``, ``.tolist()``, ``.copy()``,
+# a ``bytes(...)`` call — must carry the explicit acknowledgment (the
+# string-column contract and the ~150-byte control message are the
+# sanctioned cases).
+_SHM_MARK = "# shm:copy-ok"
+_SHM_COPY_ATTRS = {"tobytes", "tolist", "copy"}
+
+# additional shm hot paths living outside io/shm.py (the fleet client's
+# write->post->release rung), audited by (module, qualname)
+_SHM_EXTRA_PATHS = (
+    ("mmlspark_tpu.serving.fleet", "ServingFleet._post_columns_shm"),
+)
+
+# segment owners: every ``SharedMemory(create=True)`` class must also
+# hold the matching ``.unlink(`` and ``.close(`` teardown
+_SHM_SEGMENT_OWNERS = (
+    ("mmlspark_tpu.io.shm", "ShmRing"),
+)
+
+
+def _shm_sources() -> List[Tuple[str, str, int, List[str]]]:
+    from mmlspark_tpu.io.shm import SHM_REGISTRY
+    out = []
+    seen = set()
+    for code, name in SHM_REGISTRY.items():
+        key = (code.co_filename, code.co_firstlineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        try:
+            lines, first = inspect.getsourcelines(code)
+        except OSError:
+            continue   # dynamically built (tests); nothing to audit
+        out.append((name, textwrap.dedent("".join(lines)), first, lines))
+    for module, qualname in _SHM_EXTRA_PATHS:
+        fn = _resolve_qualname(module, qualname)
+        if fn is None:
+            out.append((f"{module}.{qualname}", "", 0, []))
+            continue
+        lines, first = inspect.getsourcelines(fn)
+        out.append((f"{module}.{qualname}",
+                    textwrap.dedent("".join(lines)), first, lines))
+    return out
+
+
+def _check_shm_copy_source(name: str, src: str, first: int,
+                           lines: List[str]) -> List[str]:
+    """Unacknowledged-copy audit of ONE registered shm hot path:
+    ``.tobytes()``/``.tolist()``/``.copy()`` attribute access and
+    ``bytes(...)`` calls need ``# shm:copy-ok`` on their line.
+    (``np.copyto`` is the ONE intended staged copy — allowed.)"""
+    if not src:
+        return [f"{name}: shm hot path is missing / unresolvable"]
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return [f"{name}: unparseable shm hot-path source"]
+    violations: List[str] = []
+
+    def line_ok(lineno: int) -> bool:
+        idx = lineno - 1
+        if 0 <= idx < len(lines):
+            return _SHM_MARK in lines[idx]
+        return False
+
+    for node in ast.walk(tree):
+        bad = None
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _SHM_COPY_ATTRS:
+            bad = f"unacknowledged copy '.{node.attr}'"
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Name) and \
+                node.func.id == "bytes":
+            bad = "unacknowledged copy 'bytes()'"
+        if bad is not None and not line_ok(node.lineno):
+            violations.append(
+                f"{name} (line {first + node.lineno - 1}): {bad} on a "
+                f"registered shm hot path (acknowledge a sanctioned "
+                f"materialization with '{_SHM_MARK}')")
+    return violations
+
+
+def _is_slot_acquire(node: ast.Call) -> bool:
+    """A slot acquire: ``*._claim_slot(...)`` or ``ring.write(...)``
+    (the fleet rung's token-producing call)."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return False
+    if f.attr == "_claim_slot":
+        return True
+    return (f.attr == "write" and isinstance(f.value, ast.Name)
+            and f.value.id == "ring")
+
+
+def _has_protected_release(fn) -> bool:
+    """Does ``fn`` release a slot on its failure paths — a
+    ``.release(...)`` call inside a ``finally`` block or an ``except``
+    handler?"""
+    for t in ast.walk(fn):
+        if not isinstance(t, ast.Try):
+            continue
+        bodies = list(t.finalbody)
+        for h in t.handlers:
+            bodies.extend(h.body)
+        for stmt in bodies:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) and \
+                        isinstance(n.func, ast.Attribute) and \
+                        n.func.attr == "release":
+                    return True
+    return False
+
+
+def _check_shm_pairing(name: str, src: str, first: int,
+                       lines: List[str]) -> List[str]:
+    """Acquire/release pairing audit: any function on a registered shm
+    hot path that claims a ring slot must release it on every failure
+    path (a ``.release(`` inside ``finally`` or an ``except`` handler;
+    the success path may hand the token to the caller by contract)."""
+    if not src:
+        return []   # the missing-source violation already fired
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    violations: List[str] = []
+    fns = [n for n in ast.walk(tree)
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    for fn in fns or [tree]:
+        acquires = [n for n in ast.walk(fn)
+                    if isinstance(n, ast.Call) and _is_slot_acquire(n)]
+        if acquires and not _has_protected_release(fn):
+            violations.append(
+                f"{name} (line {first + acquires[0].lineno - 1}): slot "
+                f"acquire without a '.release(' on the failure paths "
+                f"(finally / except handler) — a raised exception "
+                f"leaks the slot")
+    return violations
+
+
+def check_shm_transport() -> List[str]:
+    """The shared-memory transport audit: no unacknowledged copies on
+    registered shm hot paths, every slot acquire released on failure
+    paths, and every created segment unlinked (empty = clean)."""
+    violations: List[str] = []
+    for name, src, first, lines in _shm_sources():
+        violations.extend(_check_shm_copy_source(name, src, first, lines))
+        violations.extend(_check_shm_pairing(name, src, first, lines))
+    for module, qualname in _SHM_SEGMENT_OWNERS:
+        obj = _resolve_qualname(module, qualname)
+        if obj is None:
+            violations.append(
+                f"{module}.{qualname}: segment owner is missing")
+            continue
+        src = textwrap.dedent("".join(inspect.getsourcelines(obj)[0]))
+        if "create=True" in src:
+            for needed in (".unlink(", ".close("):
+                if needed not in src:
+                    violations.append(
+                        f"{module}.{qualname}: creates a SharedMemory "
+                        f"segment but never calls '{needed}' — a "
+                        f"leaked /dev/shm file outlives the process")
+    return violations
+
+
+# ---------------------------------------------------------------------------
 # out-of-core ingest hot paths (io/ooc.py + the chunked consumers)
 # ---------------------------------------------------------------------------
 
@@ -718,6 +889,7 @@ def main() -> int:
     from mmlspark_tpu.io.columnar import INGRESS_REGISTRY
     n_ingress = len(INGRESS_REGISTRY)
     violations += check_ingress_kernels()
+    violations += check_shm_transport()
     violations += check_ooc_ingest()
     violations += check_control_loop()
     if violations:
@@ -726,8 +898,11 @@ def main() -> int:
         for v in violations:
             print("  -", v)
         return 1
+    from mmlspark_tpu.io.shm import SHM_REGISTRY
     print(f"OK: {n} registered fused kernels, no host round trips; "
           f"{n_ingress} ingress kernels, no per-row iteration; "
+          f"{len(SHM_REGISTRY)} shm hot paths, one staged copy and no "
+          f"leaked slots/segments; "
           f"{len(_SHARDED_JIT_SITES)} sharded jit builders declare "
           f"explicit shardings; {len(_OOC_HOT_PATHS)} chunked hot "
           f"paths never materialize the stream; control loop "

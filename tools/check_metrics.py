@@ -89,6 +89,10 @@ CAPPED_FAMILIES = {
     # (top-K by score), overflow folds into feature="_other"
     # (core/prometheus.py drift_families)
     "serving_drift_score",
+    # placement plane: per-model replica gauges capped at
+    # REPLICA_LABEL_CAP, overflow summed into model="_other"
+    # (core/prometheus.py placement_families)
+    "serving_placement_replicas",
 }
 
 # dynamic (f-string) family names, with their FULL expected expansions —
