@@ -2369,6 +2369,269 @@ def bench_continuous() -> dict:
     }
 
 
+ADAPTIVE_DIM = 16
+ADAPTIVE_CLASSES = 4
+ADAPTIVE_FILLERS = 6          # zipf tail behind the hot 2-variant head
+ADAPTIVE_CLIENTS = 6
+ADAPTIVE_ROUNDS = 40          # lockstep rounds for the drain cadence
+
+
+def bench_adaptive() -> dict:
+    """SLO-adaptive serving (serving/variants.py + the continuous
+    batcher): a zipf-weighted ramp over a 2-variant model (full f32 +
+    quantized int8 behind one logical name), measuring
+
+    - per-variant measured cost (ms/row) and the declared-cost ratio
+      the selector trades on at equal SLO,
+    - reply p99 ACROSS a forced variant flip (fast-burn injected, then
+      cleared -> step_down, select, step_up on the timeline) with
+      availability + zero cross-model replies over the whole run,
+    - batcher occupancy: the same offered load driven in drain-cadence
+      lockstep (every client waits for the whole round to drain — the
+      old drain-then-block arrival shape) vs free-running continuous
+      admission.
+
+    CPU-honesty: on this container every engine thread timeshares the
+    same core(s) and int8 matmuls run SLOWER than f32 (no MXU), so the
+    cost/qps reduction is reported from the DECLARED TPU-relative
+    costs while measured ms/row carries what this box actually did;
+    the >=1x occupancy floor is the only claim asserted here."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from mmlspark_tpu.models.networks import build_network
+    from mmlspark_tpu.models.tpu_model import TPUModel
+    from mmlspark_tpu.serving import (
+        HTTPSource, ModelZoo, ServingEngine, VariantSelector,
+    )
+    from mmlspark_tpu.serving.fleet import json_scoring_pipeline
+    from mmlspark_tpu.stages.basic import Lambda
+
+    rng = np.random.default_rng(11)
+    x_warm = np.zeros((1, ADAPTIVE_DIM), np.float32)
+    x_cal = rng.normal(size=(64, ADAPTIVE_DIM)).astype(np.float32)
+    module = build_network({"type": "mlp", "features": [32],
+                            "num_classes": ADAPTIVE_CLASSES})
+    f32 = TPUModel.from_flax(
+        module, module.init(jax.random.PRNGKey(0), x_warm),
+        inputCol="features", outputCol="scores", batchSize=8)
+    int8 = f32.quantize({"features": x_cal})
+
+    zoo = ModelZoo(memory_probe=None)
+    zoo.register_factory(
+        "clf", "v1", lambda: json_scoring_pipeline(f32),
+        metadata={"precision": "f32",
+                  "warmup_example": {"features": x_warm}})
+    zoo.register_factory(
+        "clf_int8", "v1", lambda: json_scoring_pipeline(int8),
+        metadata={"precision": "int8",
+                  "warmup_example": {"features": x_warm}})
+
+    def filler_stage(tag):
+        def handle(table):
+            return table.with_column(
+                "reply", [{"model": tag} for _ in table["request"]])
+        return Lambda.apply(handle)
+
+    for i in range(ADAPTIVE_FILLERS):
+        zoo.register_factory(f"f{i}", "v1",
+                             (lambda i=i: filler_stage(f"f{i}")))
+
+    class _BurnToggle:
+        """The selector's fast-burn input, injectable on demand."""
+
+        def __init__(self):
+            self.burning = False
+            self.alerts = self
+
+        def active(self):
+            if not self.burning:
+                return []
+            a = type("A", (), {})()
+            a.rule, a.slo = "fast_burn", "latency"
+            return [a]
+
+    toggle = _BurnToggle()
+    sel = VariantSelector(zoo, slo=toggle, decide_interval_s=0.1,
+                          hold_s=1.0, pressure_limit=10_000)
+    sel.declare("clf", ["clf", "clf_int8"], slo_ms=100.0,
+                costs={"clf": 1.0, "clf_int8": 0.25})
+    source = HTTPSource(port=0)
+    engine = ServingEngine(source, zoo=zoo, variants=sel, batch_size=8,
+                           max_wait_ms=2.0, workers=1, tracing=False,
+                           slo=False).start()
+    addr = source.address
+
+    # zipf-weighted picks: the 2-variant head stays hot, fillers tail
+    names = ["clf"] + [f"f{i}" for i in range(ADAPTIVE_FILLERS)]
+    ranks = np.arange(1, len(names) + 1, dtype=np.float64)
+    probs = 1.0 / ranks ** 1.2
+    probs /= probs.sum()
+    payload = json.dumps(
+        {"features": rng.normal(size=ADAPTIVE_DIM).tolist()}).encode()
+    lock = threading.Lock()
+    wrong, failures = [], []
+
+    def post_one(model):
+        req = urllib.request.Request(
+            addr, data=payload,
+            headers={"Content-Type": "application/json",
+                     "X-Model": model})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                served = r.headers.get("X-Model", "")
+                r.read()
+            if model == "clf":
+                if served not in ("clf@v1", "clf_int8@v1"):
+                    with lock:
+                        wrong.append(served)
+            elif not served.startswith(model):
+                with lock:
+                    wrong.append((model, served))
+        except Exception as e:  # noqa: BLE001 — availability metric
+            with lock:
+                failures.append(str(e))
+        return (time.perf_counter() - t0) * 1e3
+
+    def run_phase(n_per_client, lockstep):
+        """ADAPTIVE_CLIENTS clients x n_per_client zipf requests.
+        ``lockstep`` reproduces the drain-then-block cadence: nobody
+        starts round i+1 until the whole round i drained."""
+        lats: list = []
+        picks = rng.choice(names, size=(ADAPTIVE_CLIENTS,
+                                        n_per_client), p=probs)
+        barrier = threading.Barrier(ADAPTIVE_CLIENTS)
+
+        def client(c):
+            out = []
+            for i in range(n_per_client):
+                if lockstep:
+                    barrier.wait()
+                out.append(post_one(str(picks[c][i])))
+            with lock:
+                lats.extend(out)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(ADAPTIVE_CLIENTS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        total = ADAPTIVE_CLIENTS * n_per_client
+        return {"qps": round(total / wall, 1),
+                "p50_ms": round(float(np.percentile(lats, 50)), 2),
+                "p99_ms": round(float(np.percentile(lats, 99)), 2),
+                "requests": total}
+
+    try:
+        for _ in range(4):                      # warm both rungs' path
+            post_one("clf")
+        # occupancy: drain-cadence lockstep vs continuous admission of
+        # the SAME offered load
+        drain = run_phase(ADAPTIVE_ROUNDS, lockstep=True)
+        cont = run_phase(ADAPTIVE_ROUNDS, lockstep=False)
+        occupancy_ratio = round(cont["qps"] / drain["qps"], 2)
+
+        # steady f32, then the forced flip under continuous load
+        steady = run_phase(20, lockstep=False)
+        active_before = sel.status()["clf"]["active"]
+        stop = threading.Event()
+        flip_lats: list = []
+
+        def hammer():
+            while not stop.is_set():
+                dt = post_one("clf")
+                with lock:
+                    flip_lats.append(dt)
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(ADAPTIVE_CLIENTS)]
+        for t in threads:
+            t.start()
+        toggle.burning = True
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if sel.status()["clf"]["active"] != active_before:
+                break
+            time.sleep(0.05)
+        flipped_to = sel.status()["clf"]["active"]
+        time.sleep(1.0)              # degraded tier under load
+        toggle.burning = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if sel.status()["clf"]["active"] == active_before:
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join()
+        recovered = sel.status()["clf"]["active"] == active_before
+        st = sel.status()["clf"]
+        profiles = {}
+        for v in st["variants"]:
+            prof = sel._profiles[v["variant"]]
+            measured = prof.ms_per_row(sel.window_s)
+            profiles[v["variant"]] = {
+                "declared_cost": (v["cost"]
+                                  if v["cost_source"] == "declared"
+                                  else None),
+                "measured_ms_per_row": (round(measured, 4)
+                                        if measured is not None
+                                        else None),
+                "p99_ms": v["p99_ms"],
+                "cost_source": v["cost_source"],
+            }
+        events = [e.kind for e in sel.events]
+    finally:
+        engine.stop()
+        zoo.close()
+
+    usable_cores = len(os.sched_getaffinity(0))
+    total_reqs = (drain["requests"] + cont["requests"]
+                  + steady["requests"] + len(flip_lats) + 4)
+    availability = 1.0 - len(failures) / max(1, total_reqs)
+    return {
+        "metric": "adaptive_occupancy_continuous_vs_drain",
+        "value": occupancy_ratio,
+        "unit": "x (free-running continuous admission qps vs "
+                "drain-then-block lockstep cadence, same offered "
+                "load)",
+        "occupancy": {"drain_cadence": drain, "continuous": cont},
+        "steady": steady,
+        "forced_flip": {
+            "flipped_to": flipped_to,
+            "recovered_to_preferred": recovered,
+            "p99_ms_across_flip": round(
+                float(np.percentile(flip_lats, 99)), 2) if flip_lats
+                else None,
+            "requests_during_flip": len(flip_lats),
+            "events": events,
+        },
+        "variant_profiles": profiles,
+        "declared_cost_ratio_int8_vs_f32": 0.25,
+        "availability": round(availability, 4),
+        "wrong_replies": len(wrong),
+        "zipf_models": len(names),
+        "clients": ADAPTIVE_CLIENTS,
+        "usable_cores": usable_cores,
+        "honesty_note": (
+            "int8 on this CPU container is SLOWER than f32 (no MXU; "
+            "PR 10 measured ~0.19x), so the cost/qps reduction at "
+            "equal SLO rides the DECLARED TPU-relative costs "
+            "(0.25x); measured ms/row above records what this box "
+            f"did on {usable_cores} timeshared core(s). The >=1x "
+            "occupancy floor and the flip-window p99 are the "
+            "hardware-independent claims"),
+        "backend": jax.default_backend(),
+    }
+
+
 # scenario registry for --scenarios (cheap subsets of the full bench:
 # the serving/lifecycle numbers are measurable on any backend, the
 # training-throughput scenarios only mean anything on the TPU chip)
@@ -2393,6 +2656,7 @@ SCENARIOS = {
     "ooc": lambda: ("secondary_ooc", bench_ooc()),
     "continuous": lambda: ("secondary_continuous",
                            bench_continuous()),
+    "adaptive": lambda: ("secondary_adaptive", bench_adaptive()),
 }
 
 

@@ -253,12 +253,98 @@ def zoo_families(r: PromRenderer, zoo: Any,
                {**base, "model": m["model"], "version": m["version"],
                 "precision": m["precision"],
                 "aot": "true" if m["aot"] else "false",
-                "state": m["state"]})
+                "state": m["state"],
+                "cost_source": m.get("cost_source", "estimate")})
     for label, hist in sorted(zoo.model_histograms().items()):
         r.histogram("serving_model_latency_ms",
                     "per-model batch execution latency (cardinality-"
                     'capped: overflow models fold into model="_other")',
                     hist, {**base, "model": label})
+
+
+def variant_families(r: PromRenderer, selector: Any,
+                     labels: Optional[Dict[str, Any]] = None) -> None:
+    """The SLO-adaptive variant plane's families (serving/variants.py):
+    selection/degradation counters (full totals), a fleet-wide
+    degraded gauge, and per-model rung/floor gauges plus ONE info row
+    carrying the routed variant, the last step-down reason, and the
+    active rung's cost provenance. The per-model label space is
+    HARD-CAPPED at ``VARIANT_LABEL_CAP`` ladders (declaration order)
+    — the serving_model_latency_ms discipline."""
+    from mmlspark_tpu.serving.variants import VARIANT_LABEL_CAP
+    base = dict(labels or {})
+    s = selector.stats()
+    r.gauge("serving_variant_ladders",
+            "logical models with a declared variant ladder",
+            s["declared"], base)
+    r.gauge("serving_variant_degraded",
+            "ladders currently running below their preferred rung",
+            s["degraded"], base)
+    r.counter("serving_variant_step_downs_total",
+              "degradation steps (burn/pressure opened a cheaper rung)",
+              s["step_downs"], base)
+    r.counter("serving_variant_step_ups_total",
+              "recovery steps (sustained clean air closed a rung)",
+              s["step_ups"], base)
+    r.counter("serving_variant_selects_total",
+              "active-variant changes applied by the selector",
+              s["selects"], base)
+    for i, (name, st) in enumerate(sorted(selector.status().items())):
+        if i >= VARIANT_LABEL_CAP:
+            break
+        ml = {**base, "model": name}
+        r.gauge("serving_variant_rung",
+                "active rung on the variant ladder (0 = preferred; "
+                "cardinality-capped per-model series)",
+                st["rung"], ml)
+        r.gauge("serving_variant_floor",
+                "lowest rung the degradation state has opened "
+                "(cardinality-capped per-model series)",
+                st["floor"], ml)
+        active = next((v for v in st["variants"]
+                       if v["variant"] == st["active"]), None)
+        r.info("serving_variant_info",
+               "per-model routing metadata (cardinality-capped: first "
+               "declared ladders up to VARIANT_LABEL_CAP)",
+               {**ml, "active": st["active"],
+                "last_step_down_reason":
+                    st["last_step_down_reason"] or "",
+                "cost_source": (active or {}).get("cost_source",
+                                                  "unprofiled")})
+
+
+def autoscale_families(r: PromRenderer, autoscaler: Any,
+                       labels: Optional[Dict[str, Any]] = None) -> None:
+    """The fleet autoscaler's families (serving/autoscale.py): the
+    width band and live demand rate as gauges, scale actions and
+    failure modes as counters. No per-engine labels — addresses are
+    unbounded; the fleet's own gauges carry the width."""
+    base = dict(labels or {})
+    s = autoscaler.stats()
+    r.gauge("serving_autoscale_engines",
+            "engines in the routing rotation", s["engines"], base)
+    r.gauge("serving_autoscale_owned_engines",
+            "engines the autoscaler spawned (its retire candidates)",
+            s["owned"], base)
+    r.gauge("serving_autoscale_min_engines",
+            "configured fleet-width floor", s["min_engines"], base)
+    r.gauge("serving_autoscale_max_engines",
+            "configured fleet-width ceiling", s["max_engines"], base)
+    r.gauge("serving_autoscale_demand_rate",
+            "windowed client demand rate (rows/s) driving decisions",
+            s["demand_rate"], base)
+    r.counter("serving_autoscale_scale_ups_total",
+              "engines spawned + joined by the autoscaler",
+              s["scale_ups"], base)
+    r.counter("serving_autoscale_scale_downs_total",
+              "engines retired through the drain path",
+              s["scale_downs"], base)
+    r.counter("serving_autoscale_drain_timeouts_total",
+              "retirements that hit the drain deadline",
+              s["drain_timeouts"], base)
+    r.counter("serving_autoscale_spawn_failures_total",
+              "spawner or startup-probe failures (fleet width "
+              "unchanged)", s["spawn_failures"], base)
 
 
 def placement_families(r: PromRenderer, placement: Any,

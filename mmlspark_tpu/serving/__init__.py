@@ -54,6 +54,12 @@ _FABRIC_EXPORTS = {
     "PlacementEvent": ("mmlspark_tpu.serving.placement",),
     "ShmRing": ("mmlspark_tpu.io.shm",),
     "shm_available": ("mmlspark_tpu.io.shm",),
+    # the SLO-adaptive plane (variant selection + fleet autoscaling)
+    # rides the same lazy path: most clients never opt in
+    "VariantSelector": ("mmlspark_tpu.serving.variants",),
+    "VariantEvent": ("mmlspark_tpu.serving.variants",),
+    "FleetAutoscaler": ("mmlspark_tpu.serving.autoscale",),
+    "AutoscaleEvent": ("mmlspark_tpu.serving.autoscale",),
 }
 
 
@@ -70,6 +76,7 @@ def __getattr__(name):
 
 
 __all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
+           "AutoscaleEvent",
            "BurnRateRule", "CanaryPolicy", "ContinuousTrainer",
            "FlightRecorder", "GatePolicy", "HTTPSource",
            "IngestDriver",
@@ -81,7 +88,8 @@ __all__ = ["AdmissionController", "Alert", "AlertEvent", "AlertLog",
            "ServingFleet", "ServingUnavailable", "ShadowEvent",
            "SharedSingleton",
            "SharedVariable", "SwapEvent", "SwapInProgress", "SwapResult",
-           "TenantQuota", "TriggerPolicy", "ZooEvent",
+           "TenantQuota", "TriggerPolicy", "VariantEvent",
+           "VariantSelector", "ZooEvent", "FleetAutoscaler",
            "assert_serves_from_mesh",
            "auto_weight_specs",
            "data_shard_pipeline", "device_residency", "export_model",

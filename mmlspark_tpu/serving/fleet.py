@@ -874,9 +874,26 @@ class ServingFleet:
         # concurrent client threads can't tear the round-robin
         self._next = itertools.count()
         self.breakers: List[CircuitBreaker] = []
+        # windowed demand (requests via post, rows via post_columns):
+        # the autoscaler's control signal — demand_rate() per engine
+        # against its scale-up/-down watermarks (serving/autoscale.py)
+        from mmlspark_tpu.core.metrics import WindowedCounter
+        self._demand = WindowedCounter(bucket_s=1.0, horizon_s=600.0)
+        # dynamic membership (autoscaler join/leave): mutations are
+        # serialized under this lock; in-flight posts read addresses/
+        # breakers without it — post() treats a membership-race index
+        # error as one more failover attempt, so the worst case is a
+        # retried leg, never a wrong reply
+        self._membership_lock = threading.Lock()
+        self.engines_added = 0
+        self.engines_removed = 0
 
     def _build_breakers(self, failure_threshold: int,
                         breaker_cooldown: float) -> None:
+        # remembered so engines joining later (add_engine) get
+        # breakers with the fleet's configured budget
+        self._breaker_params = (int(failure_threshold),
+                                float(breaker_cooldown))
         self.breakers = [
             CircuitBreaker(failure_threshold=failure_threshold,
                            cooldown=breaker_cooldown,
@@ -951,7 +968,8 @@ class ServingFleet:
         return fleet
 
     def _wait_ready(self, budget_s: float,
-                    probe_timeout_s: float = 1.0) -> List[str]:
+                    probe_timeout_s: float = 1.0,
+                    addresses: Optional[List[str]] = None) -> List[str]:
         """Bounded startup probe: poll every address's /healthz under
         ONE shared deadline with jittered backoff (utils/resilience
         discipline) until each answers anything at all — an HTTP
@@ -963,7 +981,8 @@ class ServingFleet:
         policy = RetryPolicy(max_attempts=1_000_000, base_delay=0.05,
                              multiplier=1.5, max_delay=0.5,
                              name="fleet.wait_ready")
-        pending = list(self._remote_addresses)
+        pending = list(addresses if addresses is not None
+                       else self._remote_addresses)
         not_ready: List[str] = []
         for addr in pending:
 
@@ -1410,6 +1429,7 @@ class ServingFleet:
             else json.dumps(payload).encode()
         extra_headers = self._route_headers(model, tenant, priority,
                                             headers)
+        self._demand.inc(1.0)    # the autoscaler's windowed signal
         n = len(self.addresses)
         start = next(self._next)
         order = [(start + k) % n for k in range(n)]
@@ -1578,6 +1598,17 @@ class ServingFleet:
         doomed columnar attempt (the PR 2 stale-connection retry
         discipline applied to content negotiation)."""
         from mmlspark_tpu.io import columnar as CIN
+        # demand is measured in ROWS: the nested post() counts the one
+        # HTTP request, this adds the rest of the batch so a columnar
+        # client's load registers at its true weight
+        rows = 0
+        for v in columns.values():
+            try:
+                rows = max(rows, len(v))
+            except TypeError:
+                pass
+        if rows > 1:
+            self._demand.inc(float(rows - 1))
         if self.shm_transport and (
                 self._shm_ok
                 or time.monotonic() >= self._shm_retry_at):
@@ -1692,6 +1723,73 @@ class ServingFleet:
             raise
         finally:
             ring.release(token, clean=clean)
+
+    # -- dynamic membership (the autoscaler's join/leave surface) -----------
+
+    def demand_rate(self, window_s: float = 30.0) -> float:
+        """Client-observed demand (rows/s, JSON posts counting 1) over
+        the trailing window — the autoscaler's control signal."""
+        return self._demand.rate(float(window_s))
+
+    def add_engine(self, address: str,
+                   wait_ready_s: float = 0.0) -> int:
+        """Join one engine to a CONNECTED fleet's rotation and return
+        its index. ``wait_ready_s`` > 0 runs the startup probe against
+        the new address first (the ``connect`` discipline: a slow
+        starter must not burn its fresh breaker's failure budget).
+        Membership mutations serialize under ``_membership_lock``;
+        the breaker appends BEFORE the address so a concurrently
+        routing ``post`` never indexes past the breaker list."""
+        if self._remote_addresses is None:
+            raise RuntimeError(
+                "add_engine joins remote engines; in-process fleets "
+                "are fixed at construction")
+        addr = str(address).rstrip("/")
+        if wait_ready_s > 0:
+            self._wait_ready(float(wait_ready_s), addresses=[addr])
+        ft, cd = self._breaker_params
+        with self._membership_lock:
+            if addr in self._remote_addresses:
+                return self._remote_addresses.index(addr)
+            idx = len(self._remote_addresses)
+            self.breakers.append(CircuitBreaker(
+                failure_threshold=ft, cooldown=cd,
+                name=f"engine{idx}@{addr}"))
+            self._remote_addresses.append(addr)
+            self.engines_added += 1
+        if self.placement is not None:
+            # rebalance the placement plane over the new width
+            self.placement.set_n_engines(len(self.addresses),
+                                         reason=f"join:{addr}")
+        log.info("fleet: engine %s joined (now %d engines)", addr,
+                 idx + 1)
+        return idx
+
+    def remove_engine(self, address: str) -> None:
+        """Drop one engine from a CONNECTED fleet's rotation (the
+        address shrinks BEFORE the breaker list — the mirror of
+        ``add_engine``'s ordering — so racing posts never index past
+        either). The engine process itself is NOT touched: retiring a
+        live engine is the autoscaler's drain-before-retire job
+        (serving/autoscale.py), which only stops a process after this
+        removal AND a drained /healthz."""
+        if self._remote_addresses is None:
+            raise RuntimeError(
+                "remove_engine is for connected fleets; in-process "
+                "fleets are fixed at construction")
+        addr = str(address).rstrip("/")
+        with self._membership_lock:
+            if addr not in self._remote_addresses:
+                raise ValueError(f"unknown engine address {addr!r}")
+            i = self._remote_addresses.index(addr)
+            del self._remote_addresses[i]
+            del self.breakers[i]
+            self.engines_removed += 1
+        if self.placement is not None:
+            self.placement.set_n_engines(len(self.addresses),
+                                         reason=f"leave:{addr}")
+        log.info("fleet: engine %s left (now %d engines)", addr,
+                 len(self.addresses))
 
     def attach_placement(self, controller=None, **kwargs):
         """Wire a fleet-wide ``PlacementController`` (serving/
@@ -1904,6 +2002,19 @@ class ServingFleet:
                   "client-side transport failures", transport)
         r.counter("serving_fleet_hedged_requests_total",
                   "tail-latency hedge requests fired", hedged)
+        r.gauge("serving_fleet_engines",
+                "engines currently in the routing rotation",
+                len(self.addresses))
+        r.gauge("serving_fleet_demand_rate",
+                "client-observed demand over the trailing 30s "
+                "(rows/s; JSON posts count 1)", self.demand_rate())
+        auto = self.__dict__.get("autoscaler")
+        if auto is not None:
+            from mmlspark_tpu.core.prometheus import autoscale_families
+            try:
+                autoscale_families(r, auto)
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
         # shared-memory transport: process-wide counters (io/shm.py) —
         # rendered only once the transport has actually loaded, so a
         # fleet that never negotiated shm pays no import

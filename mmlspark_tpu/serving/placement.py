@@ -143,6 +143,27 @@ class PlacementController:
         with self._lock:
             self._dead.discard(int(index))
 
+    def set_n_engines(self, n: int, reason: str = "resize") -> None:
+        """Resize the engine universe (the autoscaler's join/leave
+        hook) and rebuild the plan NOW: after a join, hot models fan
+        out onto the new replica; after a leave, its assignments
+        reassign before the next routed post. A default-capped
+        ``max_replicas`` (== the old width) follows the resize; an
+        explicit cap is the operator's and stays. Liveness marks for
+        engines beyond the new width are dropped — index ``i`` of a
+        future fleet is a different process."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("placement needs at least one engine")
+        with self._lock:
+            if n == self.n_engines:
+                return
+            if self.max_replicas == self.n_engines:
+                self.max_replicas = n
+            self.n_engines = n
+            self._dead = {i for i in self._dead if i < n}
+        self.rebuild(force=True, reason=reason)
+
     # -- the plan -----------------------------------------------------------
 
     def _zoo_costs(self) -> Dict[str, int]:

@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import json
+import math
 import os
 import queue
 import secrets
@@ -752,6 +753,20 @@ class _BatchTraceCtx:
         return self.tracer.start_span(name, tr, start=start)
 
 
+class _PendingGroup:
+    """One model's batch-in-formation on the continuous batcher:
+    admitted requests accumulating toward ``batch_size`` rows or
+    ``max_wait_ms`` age, whichever first. Groups form and dispatch
+    independently per model — the continuous-batching unit."""
+
+    __slots__ = ("reqs", "prio", "first_at")
+
+    def __init__(self, prio: int, first_at: float):
+        self.reqs: List[_ParkedRequest] = []
+        self.prio = prio
+        self.first_at = first_at
+
+
 class ServingEngine:
     """The streaming loop: source → adaptive micro-batcher → user
     pipeline → sink (the structured-streaming query of ref:
@@ -795,8 +810,11 @@ class ServingEngine:
                  activation_timeout_s: float = 30.0,
                  zoo_enforce_interval_s: float = 1.0,
                  slo=None, flight_recorder=None,
-                 slo_eval_interval_s: float = 0.25):
-        from mmlspark_tpu.core.metrics import histogram_set
+                 slo_eval_interval_s: float = 0.25,
+                 variants=None,
+                 retry_after_max_s: float = 30.0):
+        from mmlspark_tpu.core.metrics import WindowedCounter, \
+            histogram_set
         from mmlspark_tpu.core import trace as trace_mod
         self.source = source
         # multi-model plane (serving/zoo.py + serving/admission.py):
@@ -818,6 +836,26 @@ class ServingEngine:
         # source's parked-request table like every parked request)
         self._awaiting: Dict[str, List[_ParkedRequest]] = {}
         self._awaiting_since: Dict[str, float] = {}
+        # SLO-adaptive variant routing (serving/variants.py): resolved
+        # model keys pass through the selector's cached route table at
+        # ingest; the selector's DECISION pass runs only on the
+        # rate-gated batcher tick (enforced by check_adaptive_serving)
+        self.variants = variants
+        # continuous batcher state (batcher thread only): per-model
+        # groups forming toward batch_size/max_wait_ms, plus the ready
+        # lane of already-acquired chunks (cold-activation flushes)
+        # waiting for an in-flight token. A slow model's group waiting
+        # for a token no longer blocks any other model's dispatch.
+        self._pending: Dict[Optional[str], _PendingGroup] = {}
+        self._ready: List[List[Any]] = []   # [prio, first_at, handle, reqs]
+        # dynamic Retry-After (satellite of the adaptive plane): shed
+        # replies quote the live backlog / drain-rate estimate instead
+        # of a constant, clamped to [1, retry_after_max_s]
+        self.retry_after_max_s = max(1, int(retry_after_max_s))
+        self._retry_after_s = self.source.retry_after_s
+        self._drained_rows = WindowedCounter(bucket_s=1.0,
+                                             horizon_s=120.0)
+        self._retry_tick = 0.0
         # admission/routing rejections by reason (under _stats_lock):
         # quota, priority, no_model, unknown_model, load_failed,
         # activation_timeout
@@ -1169,6 +1207,10 @@ class ServingEngine:
             # HTTP handler; include_engine=False avoids double count)
             self.slo.record(True, dt_ms, model=handle.model_key,
                             include_engine=False)
+        if self.variants is not None and handle.model_key is not None:
+            # the selector's windowed latency/cost profile feed (O(1)
+            # counter writes; decisions happen on the batcher tick)
+            self.variants.observe(handle.model_key, dt_ms, len(ids))
         t1 = time.perf_counter()
         try:
             self._answer_output(out, ids, tctx, handle)
@@ -1338,16 +1380,36 @@ class ServingEngine:
         newly-queued requests up to batch_size, so batches grow toward
         full occupancy exactly when the device is the bottleneck.
 
-        With a model zoo attached the plane is MODEL-ROUTED: each
-        drained batch passes admission (per-tenant quotas, priority
-        tiers) and partitions by ``model=name@version`` so a
-        micro-batch never mixes models; cold models activate on the
-        zoo's loader thread while their requests park in
-        ``_awaiting`` — resident models keep dispatching meanwhile."""
+        With a model zoo attached the plane is CONTINUOUS and
+        MODEL-ROUTED (Orca-style iteration-level scheduling, OSDI'22,
+        adapted to micro-batch granularity): every loop turn drains
+        whatever is queued RIGHT NOW into per-model pending groups
+        (admission + variant routing at ingest), then ``_pump``
+        dispatches every group that is ready (full or aged past
+        ``max_wait_ms``) for which an in-flight token is free —
+        non-blocking, oldest-first within priority. A slow model's
+        group waiting on a token no longer blocks another model's
+        admission or dispatch (the old loop dispatched groups
+        sequentially, BLOCKING on the token inside each one), and
+        newly parked requests join their model's next dispatch slot
+        the moment a pipeline-depth token frees. Batches still never
+        mix models, and cold models still activate on the zoo's
+        loader thread while their requests park in ``_awaiting``."""
         while not self._stop.is_set():
+            busy = bool(self._pending) or bool(self._ready) \
+                or bool(self._awaiting)
             try:
-                parked = self.source.drain_parked(
-                    self.batch_size, self.max_wait_ms / 1e3)
+                if self.zoo is not None and busy:
+                    # continuous mode: absorb what is already queued
+                    # (bounded poll so pending work keeps pumping),
+                    # never block batch-formation on a full drain
+                    parked = self.source.drain_parked(
+                        self.batch_size, 0.0, poll_s=0.002)
+                    if parked:
+                        self.source.top_up(parked, self.batch_size)
+                else:
+                    parked = self.source.drain_parked(
+                        self.batch_size, self.max_wait_ms / 1e3)
             except Exception as e:  # noqa: BLE001 — keep collecting
                 log.error("serving batcher error (continuing): %s", e)
                 time.sleep(0.005)
@@ -1362,31 +1424,30 @@ class ServingEngine:
                         min_interval_s=self._slo_eval_interval_s)
                 except Exception as e:  # noqa: BLE001 — keep serving
                     log.error("slo evaluate failed (continuing): %s", e)
+            self._update_retry_after()
             if self.zoo is None:
                 if parked:
                     self._dispatch_parked(parked)
                 continue
-            groups: List[Tuple] = []
             try:
-                groups = self._partition_parked(parked)
-                groups.extend(self._poll_awaiting())
+                self._ingest(parked)
             except Exception as e:  # noqa: BLE001 — keep collecting
-                log.error("model routing failed (%s); dropping to 500s",
-                          e)
-                # last resort (partition/poll handle their own zoo
-                # faults per group): requests IN a built group are
-                # unanswered by construction — answer them and drain
-                # their zoo handles, or their models could never evict
-                # again. Rejected requests were already answered and
-                # must not be responded to twice; anything partition
-                # never reached runs out its reply timeout.
-                for handle, group, _prio in groups:
-                    if handle is not None:
-                        handle.release()
-                    for p in group:
-                        self.source.respond(p.id, HTTPSchema.response(
-                            500, f"model routing error: {e}", None))
-                continue
+                # per-request rejects answer inside _ingest; a fault
+                # here strands at most this drain's unrouted requests
+                # on their reply timeout — the loop must keep serving
+                log.error("request ingest failed (continuing): %s", e)
+            try:
+                now = time.perf_counter()
+                for handle, chunk, prio in self._poll_awaiting():
+                    # cold-activation flushes arrive pre-acquired and
+                    # chunked; they queue in the ready lane stamped
+                    # with their oldest member's dequeue time so the
+                    # oldest-first pump ranks them fairly
+                    self._ready.append(
+                        [prio, min((p.dequeued_at for p in chunk),
+                                   default=now), handle, chunk])
+            except Exception as e:  # noqa: BLE001 — keep collecting
+                log.error("awaiting poll failed (continuing): %s", e)
             try:
                 # LRU eviction under memory pressure, rate-gated: the
                 # batcher is the one thread that is always awake while
@@ -1397,39 +1458,196 @@ class ServingEngine:
                 # best-effort here; the loader's post-load enforce
                 # and the next tick retry
                 log.error("zoo enforce failed (continuing): %s", e)
-            # priority-tiered batching: higher tiers (lower numbers)
-            # dispatch first, so a cold-activation flush or low-tier
-            # burst never queues ahead of premium traffic
-            groups.sort(key=lambda g: g[2])
-            for handle, group, _prio in groups:
-                self._dispatch_parked(group, handle=handle)
-
-    def _dispatch_parked(self, parked: List[_ParkedRequest],
-                         handle: Optional[PipelineHandle] = None) -> None:
-        """Token-gate + assemble + dispatch ONE micro-batch. ``handle``
-        is None for the default (single-model) path — version routing
-        and acquisition happen here — or a zoo handle that arrives
-        ALREADY acquired (zoo.acquire bumps outstanding under the
-        registry lock, atomically with the eviction scan)."""
-        # wait for an in-flight token, topping the pending batch up
-        # from the queue meanwhile: back-pressure converts directly
-        # into batch occupancy instead of tiny trailing batches.
-        # (Model-routed engines skip the top-up: absorbed requests
-        # could belong to other models/tenants.)
-        granted = False
-        while not self._stop.is_set():
-            if self._inflight.acquire(timeout=0.005):
-                granted = True
-                break
-            if self.zoo is None and len(parked) < self.batch_size:
+            if self.variants is not None:
+                # the variant plane's DECISION tick (rate-gated
+                # inside the selector): profiles + burn alerts +
+                # queue pressure in, a fresh cached route table out.
+                # This is the ONLY place selection runs — never in
+                # the HTTP handler (check_adaptive_serving).
                 try:
-                    self.source.top_up(parked, self.batch_size)
-                except Exception:  # noqa: BLE001 — source closing
-                    pass
-        if not granted:              # stopping — parked requests will
-            if handle is not None:   # run out their reply timeout, but
-                handle.release()     # the zoo handle must drain
+                    self.variants.tick(pressure=self._pressure())
+                except Exception as e:  # noqa: BLE001 — routing
+                    # falls back to the last cached table
+                    log.error("variant tick failed (continuing): %s",
+                              e)
+            try:
+                self._pump()
+            except Exception as e:  # noqa: BLE001 — keep collecting
+                log.error("dispatch pump failed (continuing): %s", e)
+
+    def _ingest(self, parked: List[_ParkedRequest]) -> None:
+        """Admission-check + model-route newly drained requests into
+        their per-model pending groups (batcher thread only). Routing
+        happens BEFORE admission so unroutable requests answer 400/404
+        without spending quota tokens; the variant selector's cached
+        route table is applied here, once per request, as a dict
+        lookup. Groups hold a zoo waiter for their key so a model with
+        admitted-but-undispatched demand is never an eviction victim."""
+        if not parked:
             return
+        from mmlspark_tpu.serving.admission import request_identity
+        from mmlspark_tpu.serving.zoo import model_key_of
+        # one pressure sample per drained batch: the batcher is the
+        # only consumer of both queues, so it cannot meaningfully
+        # change within one ingest pass — no per-request qsize()
+        pressure = self._pressure() if self.admission is not None else 0
+        now = time.perf_counter()
+        for p in parked:
+            key = model_key_of(p.request)
+            if key is None and not self._default_ok:
+                self._reject_parked(
+                    p, 400, "no_model",
+                    "no model specified: set X-Model or POST "
+                    "/models/<name@version>")
+                continue
+            if key is not None:
+                # resolving here also merges bare-name and
+                # name@latest requests into ONE dispatch group
+                resolved = self.zoo.resolve(key)
+                if resolved is None:
+                    self._reject_parked(
+                        p, 404, "unknown_model",
+                        f"unknown model {key!r}; registered: "
+                        f"{self.zoo.names_preview()}")
+                    continue
+                key = resolved
+                if self.variants is not None:
+                    # cached table read (O(1)); the reply's X-Model
+                    # echoes the variant that actually served
+                    key = self.variants.route(key)
+            tenant, priority = request_identity(p.request)
+            if self.admission is not None:
+                verdict = self.admission.decide(tenant, priority,
+                                                pressure)
+                if verdict == "quota":
+                    self._reject_parked(
+                        p, 429, "quota",
+                        f"tenant {tenant!r} over quota",
+                        {"Retry-After": self._retry_header()})
+                    continue
+                if verdict == "priority":
+                    self._reject_parked(
+                        p, 503, "priority",
+                        f"shed: engine saturated (priority {priority})",
+                        {"Retry-After": self._retry_header()})
+                    continue
+            grp = self._pending.get(key)
+            if grp is None:
+                grp = _PendingGroup(priority, now)
+                self._pending[key] = grp
+                if key is not None:
+                    # parked demand must survive until dispatch (the
+                    # _awaiting discipline): without the hold, demand
+                    # > capacity livelocks on load/evict/reload
+                    self.zoo.add_waiter(key)
+            grp.reqs.append(p)
+            grp.prio = min(grp.prio, priority)
+
+    def _drop_pending(self, key: Optional[str]) -> None:
+        """Forget one pending group and release its zoo waiter hold."""
+        self._pending.pop(key, None)
+        if key is not None:
+            self.zoo.remove_waiter(key)
+
+    def _pump(self) -> None:
+        """Dispatch every READY unit an in-flight token can cover,
+        oldest-first within priority (batcher thread only). Units are
+        ready-lane chunks (always dispatchable: handle in hand) and
+        pending groups that are full or older than ``max_wait_ms``.
+        The token acquire is NON-blocking: when the device is
+        saturated the pump returns and groups keep absorbing arrivals
+        — back-pressure becomes batch occupancy, exactly like the old
+        top-up loop, but per model. Oldest-first ordering is the
+        fairness bound: a continuously-fed hot model re-forms its
+        group with a FRESH first_at after every dispatch, so a colder
+        group's older timestamp wins the next free token — no group
+        waits more than one token-release cycle behind hot traffic."""
+        max_wait_s = self.max_wait_ms / 1e3
+        while not self._stop.is_set():
+            now = time.perf_counter()
+            pick_ready = -1
+            pick_key: Optional[str] = None
+            best: Optional[Tuple[int, float]] = None
+            for i, entry in enumerate(self._ready):
+                rank = (entry[0], entry[1])
+                if best is None or rank < best:
+                    best, pick_ready, pick_key = rank, i, None
+            for key, grp in self._pending.items():
+                if len(grp.reqs) < self.batch_size \
+                        and now - grp.first_at < max_wait_s:
+                    continue        # still forming
+                rank = (grp.prio, grp.first_at)
+                if best is None or rank < best:
+                    best, pick_ready, pick_key = rank, -1, key
+            if best is None:
+                return              # nothing ready
+            if not self._inflight.acquire(blocking=False):
+                return              # saturated: groups keep absorbing
+            if pick_ready >= 0:
+                entry = self._ready.pop(pick_ready)
+                self._dispatch_now(entry[3], entry[2])
+                continue
+            grp = self._pending[pick_key]
+            if pick_key is None:
+                # default-pipeline group: version routing + handle
+                # acquisition happen inside _dispatch_now
+                chunk = grp.reqs[:self.batch_size]
+                del grp.reqs[:self.batch_size]
+                if grp.reqs:
+                    grp.first_at = grp.reqs[0].dequeued_at
+                else:
+                    self._drop_pending(None)
+                self._dispatch_now(chunk, None)
+                continue
+            try:
+                handle, state, msg = self.zoo.acquire(pick_key)
+            except Exception as e:  # noqa: BLE001 — e.g. the loader
+                # thread failing to spawn; this group answers alone,
+                # other groups (and the batcher) keep going
+                self._inflight.release()
+                for p in grp.reqs:
+                    self._reject_parked(
+                        p, 500, "routing_error",
+                        f"model routing error for {pick_key!r}: {e}")
+                self._drop_pending(pick_key)
+                continue
+            if state == "resident":
+                chunk = grp.reqs[:self.batch_size]
+                del grp.reqs[:self.batch_size]
+                if grp.reqs:
+                    grp.first_at = grp.reqs[0].dequeued_at
+                else:
+                    self._drop_pending(pick_key)
+                self._dispatch_now(chunk, handle)
+                continue
+            self._inflight.release()    # no dispatch on this path
+            if state == "loading":
+                # hand the whole group to the awaiting table (its own
+                # waiter hold + activation timeout); drop ours AFTER
+                # so the model is never transiently waiter-free
+                self._enqueue_awaiting(pick_key, grp.reqs)
+                self._pending.pop(pick_key, None)
+                self.zoo.remove_waiter(pick_key)
+            elif state == "failed":
+                for p in grp.reqs:
+                    self._reject_parked(
+                        p, 503, "load_failed",
+                        f"model {pick_key!r} failed to load: {msg}",
+                        {"Retry-After": self._retry_header(floor=5)})
+                self._drop_pending(pick_key)
+            else:   # unknown (e.g. deregistered while pending)
+                for p in grp.reqs:
+                    self._reject_parked(p, 404, "unknown_model", msg)
+                self._drop_pending(pick_key)
+
+    def _dispatch_now(self, parked: List[_ParkedRequest],
+                      handle: Optional[PipelineHandle]) -> None:
+        """Assemble + dispatch ONE micro-batch whose in-flight token is
+        ALREADY held (the pump acquired it non-blocking). ``handle`` is
+        None for the default (single-model) path — version routing and
+        acquisition happen here — or a zoo handle that arrives ALREADY
+        acquired (zoo.acquire bumps outstanding under the registry
+        lock, atomically with the eviction scan)."""
         # token ownership transfers to the worker ONLY on a
         # successful put; any other exit (assembly failure, a
         # respond() error, a BaseException killing this thread)
@@ -1474,6 +1692,7 @@ class ServingEngine:
                 if handle is not None:
                     handle.release()
                 self._inflight.release()
+        self._drained_rows.inc(len(parked))
         for p in parked:
             # dequeue stamp, not dispatch time: queue_wait must not
             # absorb the token wait or the decode stage (decode_ms
@@ -1482,21 +1701,80 @@ class ServingEngine:
                 max(0.0, p.dequeued_at - p.enqueued_at) * 1e3)
         self.hists["batch_rows"].observe(float(len(parked)))
 
+    def _dispatch_parked(self, parked: List[_ParkedRequest],
+                         handle: Optional[PipelineHandle] = None) -> None:
+        """Token-gate + assemble + dispatch ONE micro-batch (the
+        single-model path; zoo engines go through the continuous
+        ``_pump``). Waits for an in-flight token, topping the pending
+        batch up from the queue meanwhile: back-pressure converts
+        directly into batch occupancy instead of tiny trailing
+        batches."""
+        granted = False
+        while not self._stop.is_set():
+            if self._inflight.acquire(timeout=0.005):
+                granted = True
+                break
+            if self.zoo is None and len(parked) < self.batch_size:
+                try:
+                    self.source.top_up(parked, self.batch_size)
+                except Exception:  # noqa: BLE001 — source closing
+                    pass
+        if not granted:              # stopping — parked requests will
+            if handle is not None:   # run out their reply timeout, but
+                handle.release()     # the zoo handle must drain
+            return
+        self._dispatch_now(parked, handle)
+
     # -- model routing + admission (zoo engines; batcher thread only) -------
 
     def _pressure(self) -> int:
         """The admission layer's saturation signal: prepared batches
         queued behind busy workers PLUS requests backed up in the
-        source queue. The dispatch queue alone is bounded by the
-        in-flight token count (workers + pipeline_depth - 1, typically
-        2-3), which would leave the default tier limits unreachable;
-        the source backlog is where real overload actually shows."""
+        source queue PLUS the continuous batcher's admitted-but-
+        undispatched backlog (pending groups + the ready lane). The
+        dispatch queue alone is bounded by the in-flight token count
+        (workers + pipeline_depth - 1, typically 2-3), which would
+        leave the default tier limits unreachable; and the continuous
+        batcher drains the source queue eagerly, so WITHOUT the
+        pending/ready terms overload would hide in groups the old
+        queue-depth signal never saw."""
         pressure = self._dispatch_q.qsize()
         try:
             pressure += self.source.queue.qsize()
         except Exception:  # noqa: BLE001 — source closing
             pass
+        pressure += sum(len(g.reqs) for g in self._pending.values())
+        pressure += sum(len(entry[3]) for entry in self._ready)
         return pressure
+
+    def _retry_header(self, floor: int = 1) -> str:
+        """The current drain-estimate Retry-After (seconds, as the
+        header string) for shed replies; ``floor`` lifts paths with a
+        known longer horizon (e.g. a failed load's retry window)."""
+        return str(max(int(floor), self._retry_after_s))
+
+    def _update_retry_after(self, now: Optional[float] = None) -> None:
+        """Re-derive Retry-After from the live backlog / windowed
+        drain rate (rate-gated; batcher thread). Shed replies then
+        tell backoff-honoring clients when capacity should actually
+        exist — backlog/rate seconds, clamped to [1,
+        retry_after_max_s] — instead of a constant 1 s that invites
+        an immediate re-stampede under a deep queue."""
+        t = time.monotonic() if now is None else now
+        if t - self._retry_tick < 0.5:
+            return
+        self._retry_tick = t
+        backlog = self._pressure()
+        if backlog <= 0:
+            est = 1.0
+        else:
+            rate = self._drained_rows.rate(10.0)    # rows/s
+            est = (backlog / rate) if rate > 0 \
+                else float(self.retry_after_max_s)
+        self._retry_after_s = int(
+            min(max(1.0, math.ceil(est)), self.retry_after_max_s))
+        # the HTTP handler's 503-shed path reads the source attribute
+        self.source.retry_after_s = self._retry_after_s
 
     def _reject_parked(self, p: _ParkedRequest, code: int, reason: str,
                        message: str,
@@ -1511,95 +1789,6 @@ class ServingEngine:
             code, message,
             json.dumps({"error": message}).encode("utf-8"),
             {"Content-Type": "application/json", **(headers or {})}))
-
-    def _partition_parked(self, parked: List[_ParkedRequest]
-                          ) -> List[Tuple]:
-        """Admission-check + model-partition one drained batch:
-        returns ``[(handle, group, priority)]`` dispatch groups —
-        zoo handles pre-acquired, ``None`` handles meaning the default
-        pipeline. Batches never mix models by construction. Cold
-        models' requests park in ``_awaiting``; over-quota /
-        shed-tier / unroutable requests answer here and never
-        dispatch."""
-        from mmlspark_tpu.serving.admission import request_identity
-        from mmlspark_tpu.serving.zoo import model_key_of
-        buckets: Dict[Optional[str], List[_ParkedRequest]] = {}
-        prios: Dict[Optional[str], int] = {}
-        # one pressure sample per drained batch: the batcher is the
-        # only consumer of both queues, so it cannot meaningfully
-        # change within one partition pass — no per-request qsize()
-        pressure = self._pressure() if self.admission is not None else 0
-        for p in parked:
-            # route FIRST, admit second: an unroutable request (no
-            # model named on a zoo-only engine, or a typo'd name) must
-            # answer its 400/404 WITHOUT spending the tenant's quota
-            # tokens — a burst of mistyped requests could otherwise
-            # 429 the tenant's well-formed traffic
-            key = model_key_of(p.request)
-            if key is None and not self._default_ok:
-                self._reject_parked(
-                    p, 400, "no_model",
-                    "no model specified: set X-Model or POST "
-                    "/models/<name@version>")
-                continue
-            if key is not None:
-                # resolving here also merges bare-name and
-                # name@latest requests into ONE dispatch group
-                resolved = self.zoo.resolve(key)
-                if resolved is None:
-                    self._reject_parked(
-                        p, 404, "unknown_model",
-                        f"unknown model {key!r}; registered: "
-                        f"{self.zoo.names_preview()}")
-                    continue
-                key = resolved
-            tenant, priority = request_identity(p.request)
-            if self.admission is not None:
-                verdict = self.admission.decide(tenant, priority,
-                                                pressure)
-                if verdict == "quota":
-                    self._reject_parked(
-                        p, 429, "quota",
-                        f"tenant {tenant!r} over quota",
-                        {"Retry-After": "1"})
-                    continue
-                if verdict == "priority":
-                    self._reject_parked(
-                        p, 503, "priority",
-                        f"shed: engine saturated (priority {priority})",
-                        {"Retry-After": "1"})
-                    continue
-            buckets.setdefault(key, []).append(p)
-            prios[key] = min(prios.get(key, 9), priority)
-        out: List[Tuple] = []
-        for key, group in buckets.items():
-            if key is None:
-                out.append((None, group, prios[key]))
-                continue
-            try:
-                handle, state, msg = self.zoo.acquire(key)
-            except Exception as e:  # noqa: BLE001 — e.g. the loader
-                # thread failing to spawn; this group answers alone,
-                # other groups (and the batcher) keep going
-                for p in group:
-                    self._reject_parked(
-                        p, 500, "routing_error",
-                        f"model routing error for {key!r}: {e}")
-                continue
-            if state == "resident":
-                out.append((handle, group, prios[key]))
-            elif state == "loading":
-                self._enqueue_awaiting(key, group)
-            elif state == "failed":
-                for p in group:
-                    self._reject_parked(
-                        p, 503, "load_failed",
-                        f"model {key!r} failed to load: {msg}",
-                        {"Retry-After": "5"})
-            else:   # unknown
-                for p in group:
-                    self._reject_parked(p, 404, "unknown_model", msg)
-        return out
 
     def _enqueue_awaiting(self, key: str,
                           group: List[_ParkedRequest]) -> None:
@@ -1650,7 +1839,7 @@ class ServingEngine:
                         p, 503, "activation_timeout",
                         f"model {key!r} still activating after "
                         f"{self.activation_timeout_s:.0f}s",
-                        {"Retry-After": "1"})
+                        {"Retry-After": self._retry_header()})
             elif state == "resident":
                 prio = min(request_identity(p.request)[1]
                            for p in group)
@@ -1679,7 +1868,7 @@ class ServingEngine:
                     self._reject_parked(
                         p, 503, "load_failed",
                         f"model {key!r} failed to activate: {msg}",
-                        {"Retry-After": "5"})
+                        {"Retry-After": self._retry_header(floor=5)})
             self._drop_awaiting(key)
         return out
 
@@ -1799,6 +1988,15 @@ class ServingEngine:
                 out["slo"] = self.slo.status()
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
+        if self.variants is not None:
+            # /healthz carries the currently-routed variant + last
+            # step-down reason per logical model (satellite of the
+            # adaptive plane: a degrade-to-int8 is operator-visible)
+            try:
+                out["variants"] = self.variants.status()
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
+        out["retry_after_s"] = self._retry_after_s
         stage = getattr(active.pipeline, "metrics", None)
         if callable(stage):
             try:
@@ -1896,6 +2094,15 @@ class ServingEngine:
                 slo_families(r, self.slo)
             except Exception:  # noqa: BLE001 — stats stay partial
                 pass
+        if self.variants is not None:
+            from mmlspark_tpu.core.prometheus import variant_families
+            try:
+                variant_families(r, self.variants)
+            except Exception:  # noqa: BLE001 — stats stay partial
+                pass
+        r.gauge("serving_retry_after_s",
+                "live drain-estimate Retry-After quoted on sheds",
+                self._retry_after_s)
         cp = self.__dict__.get("controlplane")
         if cp is not None:
             from mmlspark_tpu.core.prometheus import (
@@ -2012,6 +2219,19 @@ class ServingEngine:
                 self.zoo.remove_waiter(key)
             self._awaiting.clear()
             self._awaiting_since.clear()
+            # same for the continuous batcher's pending groups, and
+            # the ready lane's acquired-but-undispatched handles (the
+            # batcher thread is joined above — no races): an
+            # unreleased handle would pin its model's outstanding
+            # count above zero forever
+            for key in list(self._pending):
+                if key is not None:
+                    self.zoo.remove_waiter(key)
+            self._pending.clear()
+            for entry in self._ready:
+                if entry[2] is not None:
+                    entry[2].release()
+            self._ready.clear()
         try:
             self.source.close()
         except Exception:  # noqa: BLE001 — already closed by kill()
@@ -2028,7 +2248,8 @@ def serve_model(pipeline: Optional[Transformer] = None,
                 tracing: Optional[bool] = None,
                 zoo=None, admission=None,
                 slo=None, flight_recorder=None,
-                slo_eval_interval_s: float = 0.25) -> ServingEngine:
+                slo_eval_interval_s: float = 0.25,
+                variants=None) -> ServingEngine:
     """One-call serving: the ``.server()`` DSL analog
     (ref: ServingImplicits.scala:10-50). Batches flush on
     ``batch_size`` rows or ``max_wait_ms`` elapsed, whichever first;
@@ -2046,4 +2267,5 @@ def serve_model(pipeline: Optional[Transformer] = None,
                          admission=admission, slo=slo,
                          flight_recorder=flight_recorder,
                          slo_eval_interval_s=slo_eval_interval_s,
+                         variants=variants,
                          ).start()

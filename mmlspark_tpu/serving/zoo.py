@@ -540,6 +540,9 @@ class ModelZoo(ModelRegistry):
             elif cost and not e.cost_bytes:
                 e.cost_bytes = int(cost)
             e.metadata["cost_source"] = cost_source
+            # cold-start cost: the variant plane prices activating a
+            # non-resident variant against serving a warm one
+            e.metadata["activation_ms"] = round(ms, 1)
             e.state = RESIDENT
             e.handle = handle
             e.failure = None
@@ -718,6 +721,27 @@ class ModelZoo(ModelRegistry):
 
     # -- observability ------------------------------------------------------
 
+    def entry_status(self, spec: str) -> Optional[Dict[str, Any]]:
+        """One entry's advisory snapshot for the variant plane:
+        state, residency cost + source, and the last measured
+        activation (cold-start) ms. None for unknown specs."""
+        with self._lock:
+            key = self._resolve_locked(spec)
+            e = self._entries.get(key) if key is not None else None
+            if e is None:
+                return None
+            return {
+                "key": e.key, "state": e.state,
+                "cost_bytes": e.cost_bytes,
+                "cost_source": str(
+                    e.metadata.get("cost_source", "estimate")),
+                "activation_ms": e.metadata.get("activation_ms"),
+                "precision": str(e.metadata.get("precision", "f32")),
+                "outstanding": (e.handle.outstanding
+                                if e.handle is not None else 0),
+                "waiters": e.waiters,
+            }
+
     def observe_latency(self, model: str, ms: float) -> None:
         """Per-model batch latency (the engine observes after every
         scored batch); cardinality-capped — see LabelledHistograms."""
@@ -750,6 +774,8 @@ class ModelZoo(ModelRegistry):
                     "pinned": e.pinned, "loads": e.loads,
                     "evictions": e.evictions,
                     "cost_bytes": e.cost_bytes,
+                    "cost_source": str(
+                        e.metadata.get("cost_source", "estimate")),
                     "outstanding": (e.handle.outstanding
                                     if e.handle is not None else 0),
                     "waiters": e.waiters,

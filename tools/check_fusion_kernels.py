@@ -665,6 +665,145 @@ def check_control_loop() -> List[str]:
 
 
 # ---------------------------------------------------------------------------
+# SLO-adaptive serving (serving/variants.py + serving/autoscale.py)
+# ---------------------------------------------------------------------------
+
+# The adaptive-serving discipline (docs/adaptive_serving.md):
+#
+# 1. Variant SELECTION never runs on the HTTP handler. The nested
+#    ``Handler`` class in serving/server.py must not touch the
+#    ``variants`` attribute at all — /healthz reads the selector
+#    through the engine's metrics probe, and routing/deciding happen
+#    on the batcher thread only: ``variants.tick`` solely in
+#    ``_batcher_loop`` (the rate-gated decision point),
+#    ``variants.route`` solely in ``_ingest`` (admission), and
+#    ``variants.observe`` solely in ``_execute_batch`` (the latency
+#    feed).
+# 2. Autoscaler scale-down goes ONLY through the drain path:
+#    ``fleet.remove_engine`` is called nowhere but
+#    ``_drain_and_stop`` (rotation removal precedes process stop),
+#    ``_stop_proc`` is reachable only from ``_drain_and_stop`` and
+#    the ``_scale_up`` join-failure cleanup (a process that never
+#    entered the rotation), and raw ``terminate``/``kill`` live only
+#    inside ``_stop_proc``.
+
+_ADAPTIVE_HANDLER_CLASS = "Handler"
+_VARIANT_CALL_OWNERS = {
+    "tick": {"_batcher_loop"},
+    "route": {"_ingest"},
+    "observe": {"_execute_batch"},
+}
+_AUTOSCALE_REMOVE_OWNERS = {"_drain_and_stop"}
+_AUTOSCALE_STOP_OWNERS = {"_drain_and_stop", "_scale_up"}
+_AUTOSCALE_KILL_OWNERS = {"_stop_proc"}
+
+
+def _is_variants_method(func) -> bool:
+    """``<anything>.variants.<method>(...)`` — the selector surface."""
+    return (isinstance(func, ast.Attribute)
+            and isinstance(func.value, ast.Attribute)
+            and func.value.attr == "variants")
+
+
+def check_adaptive_serving_source(server_src: str, autoscale_src: str,
+                                  ) -> List[str]:
+    """The adaptive-serving audit over both module sources (rules 1-2
+    above). Source-level so the tier-1 tests can feed it positive and
+    negative examples."""
+    violations: List[str] = []
+    try:
+        server_tree = ast.parse(textwrap.dedent(server_src))
+    except SyntaxError:
+        return ["serving/server.py: unparseable source"]
+    # rule 1a: the HTTP handler class never touches the variant plane
+    for node in ast.walk(server_tree):
+        if isinstance(node, ast.ClassDef) and \
+                node.name == _ADAPTIVE_HANDLER_CLASS:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Attribute) and \
+                        sub.attr == "variants":
+                    violations.append(
+                        f"serving/server.py (line {sub.lineno}): the "
+                        f"HTTP handler touches '.variants' — variant "
+                        f"selection/reads belong on the batcher "
+                        f"thread; /healthz reads the selector via the "
+                        f"engine metrics probe")
+    # rule 1b: each selector call lands only on its designated owner
+    seen_tick = False
+    for owner, node in _walk_with_owner(server_tree):
+        if isinstance(node, ast.Call) and \
+                _is_variants_method(node.func):
+            method = node.func.attr
+            allowed = _VARIANT_CALL_OWNERS.get(method)
+            if method == "tick":
+                seen_tick = True
+            if allowed is not None and owner not in allowed:
+                violations.append(
+                    f"serving/server.py (line {node.lineno}): "
+                    f"variants.{method} called from {owner!r} — "
+                    f"allowed only in {sorted(allowed)} (selection "
+                    f"never runs per-request)")
+    if not seen_tick:
+        violations.append(
+            "serving/server.py: no variants.tick call found in "
+            "'_batcher_loop' — the selector's decision point moved; "
+            "update check_adaptive_serving_source")
+    try:
+        auto_tree = ast.parse(textwrap.dedent(autoscale_src))
+    except SyntaxError:
+        return violations + ["serving/autoscale.py: unparseable source"]
+    # rule 2: scale-down only through the drain funnel
+    drain_seen = False
+    for owner, node in _walk_with_owner(auto_tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _call_name(node.func)
+        if callee == "remove_engine":
+            drain_seen = True
+            if owner not in _AUTOSCALE_REMOVE_OWNERS:
+                violations.append(
+                    f"serving/autoscale.py (line {node.lineno}): "
+                    f"remove_engine called from {owner!r} — engines "
+                    f"leave the rotation only inside "
+                    f"{sorted(_AUTOSCALE_REMOVE_OWNERS)} (drain "
+                    f"before retire)")
+        elif callee == "_stop_proc":
+            if owner not in _AUTOSCALE_STOP_OWNERS:
+                violations.append(
+                    f"serving/autoscale.py (line {node.lineno}): "
+                    f"_stop_proc called from {owner!r} — processes "
+                    f"stop only from {sorted(_AUTOSCALE_STOP_OWNERS)}")
+        elif callee in ("terminate", "kill"):
+            if owner not in _AUTOSCALE_KILL_OWNERS:
+                violations.append(
+                    f"serving/autoscale.py (line {node.lineno}): "
+                    f"raw {callee} call from {owner!r} — only "
+                    f"{sorted(_AUTOSCALE_KILL_OWNERS)} touches the "
+                    f"process handle")
+    if not drain_seen:
+        violations.append(
+            "serving/autoscale.py: no remove_engine call found in "
+            "'_drain_and_stop' — the drain funnel moved; update "
+            "check_adaptive_serving_source")
+    return violations
+
+
+def check_adaptive_serving() -> List[str]:
+    """Rules 1-2 over the real serving/server.py +
+    serving/autoscale.py sources."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    srcs = []
+    for rel in ("mmlspark_tpu/serving/server.py",
+                "mmlspark_tpu/serving/autoscale.py"):
+        try:
+            with open(os.path.join(root, rel)) as f:
+                srcs.append(f.read())
+        except OSError as e:
+            return [f"{rel}: unreadable ({e})"]
+    return check_adaptive_serving_source(*srcs)
+
+
+# ---------------------------------------------------------------------------
 # sharded serving programs (mesh-sharded pjit path — serving/sharded.py)
 # ---------------------------------------------------------------------------
 
@@ -892,6 +1031,7 @@ def main() -> int:
     violations += check_shm_transport()
     violations += check_ooc_ingest()
     violations += check_control_loop()
+    violations += check_adaptive_serving()
     if violations:
         print(f"{len(violations)} kernel violation(s) across {n} fused "
               f"+ {n_ingress} ingress registered kernels:")
@@ -907,7 +1047,8 @@ def main() -> int:
           f"explicit shardings; {len(_OOC_HOT_PATHS)} chunked hot "
           f"paths never materialize the stream; control loop "
           f"transitions all recorded, {len(_SERVING_HOT_LOOPS)} "
-          f"serving hot loops training-free")
+          f"serving hot loops training-free; variant selection off "
+          f"the HTTP handler, autoscale retire only via drain")
     return 0
 
 

@@ -93,6 +93,12 @@ CAPPED_FAMILIES = {
     # REPLICA_LABEL_CAP, overflow summed into model="_other"
     # (core/prometheus.py placement_families)
     "serving_placement_replicas",
+    # variant plane: per-model rung/floor gauges + the info row capped
+    # at VARIANT_LABEL_CAP declared ladders (core/prometheus.py
+    # variant_families; docs/adaptive_serving.md)
+    "serving_variant_rung",
+    "serving_variant_floor",
+    "serving_variant_info",
 }
 
 # dynamic (f-string) family names, with their FULL expected expansions —
