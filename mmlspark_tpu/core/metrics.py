@@ -447,6 +447,59 @@ def gbdt_train_histograms() -> Dict[str, LatencyHistogram]:
 
 
 # ---------------------------------------------------------------------------
+# Distributed-GBDT histogram-build phases and collective payload bytes
+# ---------------------------------------------------------------------------
+
+# per-phase wall milliseconds of the histogram hot loop, micro-timed by
+# the distributed bench (bench.py gbdt_dist): build (local histogram
+# kernel), reduce (the cross-device collective), split (best-gain scan)
+GBDT_HIST_PHASES = ("build", "reduce", "split")
+_GBDT_HIST_HISTS: Dict[str, LatencyHistogram] = histogram_set(
+    *GBDT_HIST_PHASES)
+
+
+def gbdt_hist_histograms() -> Dict[str, LatencyHistogram]:
+    """The process-wide GBDT histogram-phase family."""
+    return _GBDT_HIST_HISTS
+
+
+# per-device collective payload bytes the training schedule shipped,
+# keyed by collective type. Computed from the collective schedule's
+# ring-payload model at the end of every distributed train() (the
+# collectives run inside jit, so bytes cannot be counted on the wire;
+# the model is exact for ring implementations and labeled as such in
+# docs/distributed_gbdt.md) — the instrument behind the BENCH_r19
+# comm-reduction floor.
+GBDT_COMM_COLLECTIVES = ("psum", "psum_scatter", "all_gather")
+_GBDT_COMM_LOCK = threading.Lock()
+_GBDT_COMM_BYTES: Dict[str, float] = {c: 0.0 for c in
+                                      GBDT_COMM_COLLECTIVES}
+
+
+def gbdt_comm_add(collective: str, nbytes: float) -> None:
+    """Accumulate modeled per-device payload bytes for one collective
+    type ('psum' | 'psum_scatter' | 'all_gather')."""
+    if collective not in _GBDT_COMM_BYTES:
+        raise ValueError(f"unknown collective {collective!r}; expected "
+                         f"one of {GBDT_COMM_COLLECTIVES}")
+    with _GBDT_COMM_LOCK:
+        _GBDT_COMM_BYTES[collective] += float(nbytes)
+
+
+def gbdt_comm_counters() -> Dict[str, float]:
+    """Snapshot of the per-collective payload-byte counters."""
+    with _GBDT_COMM_LOCK:
+        return dict(_GBDT_COMM_BYTES)
+
+
+def gbdt_comm_reset() -> None:
+    """Zero the counters (bench/test isolation)."""
+    with _GBDT_COMM_LOCK:
+        for c in _GBDT_COMM_BYTES:
+            _GBDT_COMM_BYTES[c] = 0.0
+
+
+# ---------------------------------------------------------------------------
 # AutoML-phase histograms
 # ---------------------------------------------------------------------------
 

@@ -139,6 +139,17 @@ def process_families(r: PromRenderer, tracer: Any = None) -> None:
         r.histogram("gbdt_train_phase_ms",
                     "GBDT train() per-phase wall milliseconds",
                     hist, {"phase": phase})
+    for phase, hist in MC.gbdt_hist_histograms().items():
+        r.histogram("gbdt_hist_phase_ms",
+                    "distributed-GBDT histogram hot-loop per-phase "
+                    "wall milliseconds (build/reduce/split)",
+                    hist, {"phase": phase})
+    for coll, val in MC.gbdt_comm_counters().items():
+        r.counter("gbdt_comm_bytes_total",
+                  "modeled per-device collective payload bytes shipped "
+                  "by distributed GBDT training (ring model; see "
+                  "docs/distributed_gbdt.md)",
+                  val, {"collective": coll})
     for phase, hist in MC.automl_histograms().items():
         r.histogram("automl_phase_ms",
                     "AutoML hot-path per-phase wall milliseconds",

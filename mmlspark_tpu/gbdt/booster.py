@@ -70,6 +70,19 @@ DEFAULTS: Dict[str, Any] = {
     "alpha": 0.9,                      # quantile / huber
     "tweedie_variance_power": 1.5,
     "hist_method": "auto",  # 'auto' | 'scatter' | 'onehot' | 'pallas'
+    # histogram precision (Shi et al., NeurIPS'22 quantized GBDT):
+    # 32 = classic f32 (bit-identical to the pre-quantization engine);
+    # 16/8 = per-round gradients stochastically rounded to narrow ints,
+    # exact int32 histogram accumulation, int16 collective wire (2x
+    # fewer bytes than f32), one dequantize at split-gain time
+    "hist_bits": 32,
+    # data-parallel histogram collective: 'psum' allreduces the full
+    # (3, F, B) tensor to every device; 'reduce_scatter' partitions
+    # features across devices (O(F*B/D) wire; LightGBM's distributed
+    # recipe) and exchanges only (D, 4) split candidates. 'auto' keeps
+    # psum for f32 (bit-compat) and picks reduce_scatter for quantized
+    # data-parallel runs, where the wire saving is the point.
+    "hist_comm": "auto",
     "parallelism": "serial",  # 'serial' | 'data' | 'feature' | 'voting'
     "top_k": 20,               # voting-parallel candidates per worker
     # iterations fused per host dispatch (lax.scan chunk); 0 = auto
@@ -723,6 +736,127 @@ def _bin_stream(shards, max_bin: int, seed: int,
             np.concatenate(w_parts))
 
 
+def comm_payload_model(parallel_mode: str, hist_comm: str,
+                       hist_bits: int, num_trees: int, num_leaves: int,
+                       num_features: int, num_bins: int, n_shards: int,
+                       voting_k: int, num_rows: int) -> Dict[str, float]:
+    """Per-device collective payload bytes for one training run,
+    keyed by collective type ('psum' | 'psum_scatter' | 'all_gather').
+
+    The collectives run inside the jitted boosting program, so bytes
+    cannot be counted on the wire; this models the schedule exactly
+    (the grow_tree collective sequence is static — the fori_loop always
+    runs num_leaves-1 split steps) under the standard ring costs per
+    device: allreduce 2*S*(D-1)/D, reduce-scatter S*(D-1)/D, all-gather
+    S*(D-1)/D for an S-byte payload over D devices. Quantized runs
+    (hist_bits < 32) ship int16 histogram wire (2 bytes/cell vs 4) plus
+    one (3,) f32 scale psum per tree.
+    """
+    D = max(int(n_shards), 1)
+    if D < 2 or num_trees <= 0:
+        return {"psum": 0.0, "psum_scatter": 0.0, "all_gather": 0.0}
+    ring = (D - 1) / D
+    L, F, B = int(num_leaves), int(num_features), int(num_bins)
+    item = 2 if hist_bits < 32 else 4        # histogram wire itemsize
+    psum = scatter = gather = 0.0
+    if parallel_mode == "data" and hist_comm == "reduce_scatter":
+        fp = -(-F // D) * D                  # feature dim padded to D
+        # per tree: L leaf histograms, each one reduce-scatter of the
+        # (3, Fp, B) wire + one psum of the (3, B) feature-0 slice;
+        # 2L-1 best_split calls each all_gather a (4,) f32 candidate
+        scatter += L * (3 * fp * B * item) * ring
+        psum += L * 2 * (3 * B * item) * ring
+        gather += (2 * L - 1) * 16 * ring
+    elif parallel_mode == "data":
+        # per tree: L full-histogram allreduces (root + L-1 children)
+        psum += L * 2 * (3 * F * B * item) * ring
+    elif parallel_mode == "voting":
+        k = min(max(int(voting_k), 1), F)
+        c = D * k + 1                        # vote union + feature-0
+        # per tree: 2L-1 top-k vote all_gathers; L single-slice psums
+        # (root + right children) + L-1 UNSUBTRACTED pair psums (2x);
+        # two (L,) f32 leaf-total psums
+        gather += (2 * L - 1) * 4 * k * ring
+        psum += (L + (L - 1) * 2) * 2 * (3 * c * B * item) * ring
+        psum += 2 * 2 * (4 * L) * ring
+    elif parallel_mode == "feature":
+        # per tree: 2L-1 candidate all_gathers + L-1 row-indicator
+        # broadcasts ((N,) f32 psum)
+        gather += (2 * L - 1) * 16 * ring
+        psum += (L - 1) * 2 * (4 * int(num_rows)) * ring
+    if hist_bits < 32 and parallel_mode in ("data", "voting"):
+        psum += 2 * 12 * ring                # (3,) f32 scales, per tree
+    t = int(num_trees)
+    return {"psum": psum * t, "psum_scatter": scatter * t,
+            "all_gather": gather * t}
+
+
+def resolve_hist_method(hist_method: str, backend: str,
+                        max_bin: int) -> str:
+    """Resolve the ``hist_method`` knob against the backend.
+
+    'auto' picks the Pallas MXU kernel ONLY on TPU-class backends (the
+    analog of the reference's native histogram loop,
+    TrainUtils.scala:82-89); everywhere else it would run in slow
+    interpret mode, so CPU/GPU fall back to the scatter (segment_sum)
+    path. An explicit 'pallas' request beyond the kernel's VMEM tiling
+    range (max_bin + 1 > 2048: the minimum block can't fit the one-hot
+    budget) degrades to 'onehot' with a warning instead of failing
+    Mosaic allocation."""
+    if hist_method == "auto":
+        hist_method = ("pallas" if backend in ("tpu", "axon")
+                       else "scatter")
+    if hist_method == "pallas" and max_bin + 1 > 2048:
+        import logging
+        logging.getLogger("mmlspark_tpu.gbdt").warning(
+            f"max_bin={max_bin} exceeds the Pallas kernel's VMEM "
+            f"tiling range; using the onehot path")
+        hist_method = "onehot"
+    return hist_method
+
+
+def _validate_hist_params(p: Dict[str, Any]) -> None:
+    """Fail fast — an unsupported hist_bits/hist_comm combination must
+    raise an actionable error, never silently run f32."""
+    hist_bits = int(p["hist_bits"])
+    if hist_bits not in (32, 16, 8):
+        raise ValueError(
+            f"hist_bits={p['hist_bits']} is not supported: use 32 "
+            "(f32), 16 or 8 (quantized histograms)")
+    if hist_bits < 32 and p["hist_method"] == "onehot":
+        raise ValueError(
+            f"hist_bits={hist_bits} is not supported by "
+            "hist_method='onehot' (its einsum accumulates f32, so the "
+            "run would silently lose the integer-exactness contract); "
+            "use hist_method='scatter' (any backend) or 'pallas' "
+            "(TPU), or hist_bits=32")
+    if hist_bits < 32 and p["parallelism"] == "feature":
+        raise ValueError(
+            "hist_bits < 32 with parallelism='feature' is not "
+            "supported: feature-parallel histograms never cross the "
+            "wire, so quantization only adds rounding noise; use "
+            "parallelism='data' or 'voting', or hist_bits=32")
+    if p["hist_comm"] == "auto":
+        # quantized data-parallel gets the reduce-scatter partition
+        # (the wire saving is the point); f32 keeps psum so the
+        # default path stays bit-identical to the pre-reduce-scatter
+        # engine on any device count
+        p["hist_comm"] = ("reduce_scatter"
+                          if hist_bits < 32
+                          and p["parallelism"] == "data"
+                          else "psum")
+    elif p["hist_comm"] == "reduce_scatter":
+        if p["parallelism"] != "data":
+            raise ValueError(
+                "hist_comm='reduce_scatter' requires "
+                "parallelism='data' (feature/voting modes already "
+                f"keep histograms local); got {p['parallelism']!r}")
+    elif p["hist_comm"] != "psum":
+        raise ValueError(
+            f"unknown hist_comm={p['hist_comm']!r}; expected 'auto', "
+            "'psum' or 'reduce_scatter'")
+
+
 def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
           sample_weight: Optional[np.ndarray] = None,
           valid: Optional[Tuple[np.ndarray, np.ndarray]] = None,
@@ -777,23 +911,9 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
 
     p = dict(DEFAULTS)
     p.update(params or {})
-    if p["hist_method"] == "auto":
-        # the Pallas MXU kernel is the TPU production path (the analog of
-        # the reference's native histogram loop, TrainUtils.scala:82-89);
-        # on CPU it would run in slow interpret mode, so fall back to
-        # scatter (segment_sum) there.
-        p["hist_method"] = ("pallas"
-                            if jax.default_backend() in ("tpu", "axon")
-                            else "scatter")
-    if p["hist_method"] == "pallas" and int(p["max_bin"]) + 1 > 2048:
-        # beyond ~2048 bins the kernel's minimum block (c=128, fc=8)
-        # cannot fit the VMEM one-hot budget; onehot streams through HBM
-        # instead of failing Mosaic allocation
-        log_msg = (f"max_bin={p['max_bin']} exceeds the Pallas kernel's "
-                   f"VMEM tiling range; using the onehot path")
-        import logging
-        logging.getLogger("mmlspark_tpu.gbdt").warning(log_msg)
-        p["hist_method"] = "onehot"
+    p["hist_method"] = resolve_hist_method(
+        p["hist_method"], jax.default_backend(), int(p["max_bin"]))
+    _validate_hist_params(p)
 
     objective = get_objective(
         p["objective"], num_class=p["num_class"], alpha=p["alpha"],
@@ -1216,7 +1336,14 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
         lambda_l1=float(p["lambda_l1"]), lambda_l2=float(p["lambda_l2"]),
         min_gain_to_split=float(p["min_gain_to_split"]),
         hist_method=p["hist_method"],
-        voting_k=int(p["top_k"]))
+        voting_k=int(p["top_k"]),
+        hist_bits=int(p["hist_bits"]),
+        hist_comm=p["hist_comm"],
+        # n_shards is only consulted by the reduce-scatter partition;
+        # pinning it to 1 otherwise keeps every other config's jit key
+        # (and compiled-executable cache) identical across mesh sizes
+        n_shards=(n_shards if p["hist_comm"] == "reduce_scatter"
+                  else 1))
     lr = float(p["learning_rate"])
 
     scores_np = (base_scores if base_model is not None
@@ -1369,10 +1496,13 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     # compiled executable instead of recompiling the heaviest program
     # in the engine per seed; pinned to 0 when no mask is active
     # (is-None checks, not truthiness: ff_cfg == 0.0 is falsy but DOES
-    # sample masks, and must honor the user's seed)
+    # sample masks, and must honor the user's seed); quantized training
+    # derives its per-round stochastic-rounding keys from the same
+    # runtime key, so it must honor the seed too
     mask_key = jax.random.PRNGKey(
         int(p["seed"])
-        if (bag_cfg is not None or ff_cfg is not None) else 0)
+        if (bag_cfg is not None or ff_cfg is not None
+            or int(p["hist_bits"]) < 32) else 0)
     def _rows_global(w_np):
         if multi_host:
             return jax.make_array_from_process_local_data(
@@ -1390,7 +1520,8 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     fmask_base = np.zeros(f_eff, np.float32)
     fmask_base[:f] = 1.0          # padded dummy features stay masked
 
-    from mmlspark_tpu.core.metrics import gbdt_train_histograms
+    from mmlspark_tpu.core.metrics import (gbdt_comm_add,
+                                           gbdt_train_histograms)
     boost_chunk_hist = gbdt_train_histograms().get("boost_chunk")
     obj_key = (p["objective"], K, float(p["alpha"]),
                float(p["tweedie_variance_power"]))
@@ -1498,6 +1629,18 @@ def train(params: Dict[str, Any], X, y: Optional[np.ndarray] = None,
     booster.train_timing = {k: round(v, 3) for k, v in _phases.items()}
     booster.train_info = {"bin_path": bin_path, "boost_chunk": S_cfg,
                           "boost_chunks": n_chunks}
+    if axis_name is not None:
+        comm = comm_payload_model(
+            parallel_mode=parallel_mode, hist_comm=p["hist_comm"],
+            hist_bits=int(p["hist_bits"]), num_trees=trees_done,
+            num_leaves=int(p["num_leaves"]), num_features=f_eff,
+            num_bins=num_bins, n_shards=n_shards,
+            voting_k=int(p["top_k"]), num_rows=n_padded)
+        for _coll, _nb in comm.items():
+            if _nb:
+                gbdt_comm_add(_coll, _nb)
+        booster.train_info["comm_bytes"] = {
+            k: round(v) for k, v in comm.items()}
     # the frozen mapper rides on the booster (in-memory only): the
     # continued-boosting path bins FRESH data against the original cuts
     booster.bin_mapper = mapper
@@ -1663,10 +1806,17 @@ def _make_chunk_step(obj_key: Tuple[str, int, float, float],
             grad, hess = objective.grad_hess(score_in, y)
             if K == 1:
                 grad, hess = grad[None, :], hess[None, :]
+            # per-round stochastic-rounding key: fold 3 (disjoint from
+            # bagging=1 / feature-fraction=2), then the iteration and
+            # the class — every (round, class) rounds independently and
+            # reproducibly across topologies
+            kq = (jax.random.fold_in(jax.random.fold_in(key, it), 3)
+                  if gp.hist_bits < 32 else None)
             for k in range(K):
                 tree, leaf_of_row, leaf_vals, _ = grow_tree(
                     bins, grad[k], hess[k], w, fmask, gp, axis_name,
-                    parallel_mode)
+                    parallel_mode,
+                    None if kq is None else jax.random.fold_in(kq, k))
                 scores = scores.at[k].add(lr * leaf_vals[leaf_of_row])
                 forest = Tree(*[
                     getattr(forest, fld).at[it * K + k].set(

@@ -58,6 +58,20 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
         ["auto", "scatter", "onehot", "pallas"],
         "device histogram strategy ('auto' = pallas MXU kernel on TPU, "
         "scatter elsewhere)", default="auto")
+    histBits = IntParam(
+        "histogram precision: 32 = classic f32 (bit-identical to the "
+        "unquantized engine); 16/8 = per-round gradients stochastically "
+        "rounded to narrow ints, exact integer histogram accumulation, "
+        "int16 collective wire (2x fewer distributed bytes), one "
+        "dequantize at split-gain time (Shi et al., NeurIPS'22)",
+        default=32)
+    histComm = EnumParam(
+        ["auto", "psum", "reduce_scatter"],
+        "data-parallel histogram collective: 'psum' allreduces the full "
+        "(3, F, B) tensor; 'reduce_scatter' partitions features across "
+        "devices (O(F*B/D) wire) and exchanges only (D, 4) split "
+        "candidates; 'auto' = reduce_scatter for quantized data-"
+        "parallel runs, psum otherwise", default="auto")
     parallelism = EnumParam(
         ["serial", "data", "feature", "voting"],
         "tree learner parallelism: 'data' shards rows, 'feature' shards "
@@ -118,6 +132,8 @@ class _BoostParams(HasFeaturesCol, HasLabelCol, HasPredictionCol):
             "boost_from_average": self.get("boostFromAverage"),
             "seed": self.get("seed"),
             "hist_method": self.get("histMethod"),
+            "hist_bits": self.get("histBits"),
+            "hist_comm": self.get("histComm"),
             "parallelism": self.get("parallelism"),
             "top_k": self.get("topK"),
             "boost_chunk": self.get("boostChunk"),

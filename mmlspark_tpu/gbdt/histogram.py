@@ -23,8 +23,15 @@ Three device strategies, one contract:
   - 'onehot': stats×one-hot einsum over row chunks via lax.scan —
     portable fallback; round-trips the one-hot through HBM.
 
-Output layout: (3, L, F, B) float32 — channels grad / hess / count,
-L leaf slots, F features, B bins.
+Output layout: (3, L, F, B) — channels grad / hess / count, L leaf
+slots, F features, B bins. Float32 in the default path; quantized
+training (tree.py hist_bits < 32) feeds integer grad/hess/count values
+and gets exact int32 accumulators back — the Shi et al. (NeurIPS'22)
+quantized-histogram recipe, where the f32 work moves to a single
+dequantize at split-gain time. Integer histograms additionally ride the
+collective on a NARROW wire (``wire_dtype=int16``): the global-L1
+gradient scaling in tree.py bounds every partial sum by the quantization
+range, so the 2x-narrower psum payload cannot overflow.
 """
 
 from __future__ import annotations
@@ -41,7 +48,9 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                     num_leaves: int, num_bins: int,
                     method: str = "scatter",
                     axis_name: Optional[str] = None,
-                    true_shape=None) -> jnp.ndarray:
+                    true_shape=None,
+                    count_values: Optional[jnp.ndarray] = None,
+                    wire_dtype=None) -> jnp.ndarray:
     """Per-(leaf, feature, bin) sums of grad/hess/count.
 
     bins: (F, N) int32 features-major; grad/hess/weight: (N,) f32;
@@ -50,12 +59,26 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     ``axis_name`` when given. ``true_shape`` (pallas only) marks bins
     pre-padded to the kernel's block multiples — see
     pallas_hist.padded_bins_shape.
+
+    Quantized mode (tree.py hist_bits < 32): grad/hess arrive as
+    stochastically-rounded integers, ``weight`` is the 0/1 row mask, and
+    ``count_values`` carries the quantized per-row weight for the count
+    channel (None keeps the classic c = Σ weight). Accumulation is then
+    exact int32. ``wire_dtype`` (e.g. int16) narrows the collective:
+    the histogram is cast down for the psum and widened back — safe
+    because the global-L1 scales bound every partial sum (see
+    tree.grow_tree's quantization contract).
     """
     if true_shape is not None and method != "pallas":
         raise ValueError(
             "true_shape (pre-padded bins) is a pallas-only contract; "
             f"method={method!r} would return phantom padded features")
     if method == "onehot":
+        if count_values is not None:
+            raise ValueError(
+                "quantized histograms (hist_bits < 32) are not supported "
+                "by hist_method='onehot' (its einsum accumulates f32); "
+                "use hist_method='scatter' or 'pallas'")
         hist = _hist_onehot(bins, grad, hess, weight, leaf_of_row,
                             num_leaves, num_bins)
     elif method == "pallas":
@@ -63,17 +86,23 @@ def build_histogram(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hist = hist_pallas(
             bins, grad, hess, weight, leaf_of_row, num_leaves, num_bins,
             interpret=jax.default_backend() not in ("tpu", "axon"),
-            true_shape=true_shape)
+            true_shape=true_shape, count_values=count_values)
     else:
         hist = _hist_scatter(bins, grad, hess, weight, leaf_of_row,
-                             num_leaves, num_bins)
+                             num_leaves, num_bins,
+                             count_values=count_values)
     if axis_name is not None:
-        hist = lax.psum(hist, axis_name)
+        if wire_dtype is not None and \
+                jnp.issubdtype(hist.dtype, jnp.integer):
+            hist = lax.psum(hist.astype(wire_dtype), axis_name) \
+                .astype(jnp.int32)
+        else:
+            hist = lax.psum(hist, axis_name)
     return hist
 
 
 def _hist_scatter(bins, grad, hess, weight, leaf_of_row,
-                  num_leaves, num_bins):
+                  num_leaves, num_bins, count_values=None):
     f, n = bins.shape
     lfb = num_leaves * f * num_bins
     # flat segment id per (feature, row): ((leaf * F) + f) * B + bin
@@ -82,13 +111,17 @@ def _hist_scatter(bins, grad, hess, weight, leaf_of_row,
     seg = seg.reshape(-1)
 
     def one(values):
+        # integer stats (quantized mode) accumulate in int32 — the
+        # narrow per-row products widen BEFORE the segment reduction
+        if jnp.issubdtype(values.dtype, jnp.integer):
+            values = values.astype(jnp.int32)
         v = jnp.broadcast_to(values[None, :], (f, n)).reshape(-1)
         return jax.ops.segment_sum(v, seg, num_segments=lfb,
                                    indices_are_sorted=False)
 
     g = one(grad * weight)
     h = one(hess * weight)
-    c = one(weight)
+    c = one(weight if count_values is None else count_values * weight)
     return jnp.stack([g, h, c]).reshape(3, num_leaves, f, num_bins)
 
 

@@ -64,7 +64,8 @@ def _nibble_hl(b_pad: int):
     return (best[1], best[2]) if best else None
 
 
-def _hist_kernel_nibble(bins_ref, stats_ref, out_ref, *, h: int, l: int):
+def _hist_kernel_nibble(bins_ref, stats_ref, out_ref, *, h: int, l: int,
+                        acc_dtype=jnp.float32):
     """Single-leaf histogram via digit decomposition: bin = hi*l + lo,
     so 1[bin==b] = 1[hi==b_hi]*1[lo==b_lo] and the (3, B) histogram of
     one feature is the (3h, C) x (C, l) matmul of the stats-weighted
@@ -72,13 +73,19 @@ def _hist_kernel_nibble(bins_ref, stats_ref, out_ref, *, h: int, l: int):
     instead of O(B), which is what bounds the kernel (the one-hot build
     is VPU-compare work; the matmuls are almost free on the MXU).
 
+    Quantized stats (int8/int16) keep the one-hots in the SAME narrow
+    dtype and ask the MXU for an int32 accumulator via
+    ``preferred_element_type`` — the i8->i32 lowering the quantized
+    inference kernels use (core/quantize.py), giving exact integer
+    histogram sums.
+
     Output layout is (3h, fc*l) — feature j's (3h, l) block at columns
     [j*l, (j+1)*l) — because collapsing (h, l) into the lane axis is
     not a Mosaic-legal reshape; hist_pallas untangles it with one tiny
     XLA transpose on the final (3h, F*l) array."""
     r = pl.program_id(1)
     bins_blk = bins_ref[:]                         # (fc, C) int32
-    stats_blk = stats_ref[:]                       # (3, C) f32
+    stats_blk = stats_ref[:]                       # (3, C) f32|int
     fc, c = bins_blk.shape
 
     hi = bins_blk // l                             # (fc, C)
@@ -86,15 +93,16 @@ def _hist_kernel_nibble(bins_ref, stats_ref, out_ref, *, h: int, l: int):
     hi_ids = lax.broadcasted_iota(jnp.int32, (h, c), 0)
     lo_ids = lax.broadcasted_iota(jnp.int32, (l, c), 0)
 
+    oh_dtype = stats_blk.dtype
     parts = []
     for j in range(fc):                            # static unroll
-        hoh = (hi[j][None, :] == hi_ids).astype(jnp.float32)   # (h, C)
-        loh = (lo[j][None, :] == lo_ids).astype(jnp.float32)   # (l, C)
+        hoh = (hi[j][None, :] == hi_ids).astype(oh_dtype)       # (h, C)
+        loh = (lo[j][None, :] == lo_ids).astype(oh_dtype)       # (l, C)
         lhs = (stats_blk[:, None, :] * hoh[None, :, :]) \
             .reshape(3 * h, c)                     # (3h, C)
         parts.append(lax.dot_general(
             lhs, loh, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32))   # (3h, l)
+            preferred_element_type=acc_dtype))     # (3h, l)
     contrib = jnp.concatenate(parts, axis=1)       # (3h, fc*l)
 
     @pl.when(r == 0)
@@ -107,25 +115,29 @@ def _hist_kernel_nibble(bins_ref, stats_ref, out_ref, *, h: int, l: int):
 
 
 def _hist_kernel(bins_ref, stats_ref, leaf_ref, out_ref, *,
-                 num_leaves: int, num_bins: int):
+                 num_leaves: int, num_bins: int,
+                 acc_dtype=jnp.float32):
     r = pl.program_id(1)
 
     bins_blk = bins_ref[:]                         # (fc, C) int32
-    stats_blk = stats_ref[:]                       # (3, C) f32
+    stats_blk = stats_ref[:]                       # (3, C) f32|int
     fc, c = bins_blk.shape
+    oh_dtype = stats_blk.dtype
 
     # one-hot (fc*B, C): leading-dims collapse only (Mosaic cannot
-    # reshape trailing dims into the lane axis)
+    # reshape trailing dims into the lane axis). Quantized stats keep
+    # the one-hot in the same narrow int dtype and accumulate int32
+    # via preferred_element_type (i8->i32, cf. core/quantize.py).
     bin_ids = lax.broadcasted_iota(jnp.int32, (num_bins, c), 0)
     onehot = (bins_blk[:, None, :] == bin_ids[None, :, :]) \
-        .astype(jnp.float32).reshape(fc * num_bins, c)
+        .astype(oh_dtype).reshape(fc * num_bins, c)
 
     if num_leaves == 1:
         lhs = stats_blk                            # (3, C)
     else:
         leaf_blk = leaf_ref[:]                     # (1, C) int32
         leaf_ids = lax.broadcasted_iota(jnp.int32, (num_leaves, c), 0)
-        leaf_oh = (leaf_blk == leaf_ids).astype(jnp.float32)   # (L, C)
+        leaf_oh = (leaf_blk == leaf_ids).astype(oh_dtype)      # (L, C)
         lhs = (stats_blk[:, None, :] * leaf_oh[None, :, :]) \
             .reshape(3 * num_leaves, c)            # (3L, C)
 
@@ -134,7 +146,7 @@ def _hist_kernel(bins_ref, stats_ref, leaf_ref, out_ref, *,
     # lane axis (which would pad 3->128) — 16x less matmul work.
     contrib = lax.dot_general(
         lhs, onehot, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)        # (3L, fc*B)
+        preferred_element_type=acc_dtype)          # (3L, fc*B)
 
     @pl.when(r == 0)
     def _():
@@ -205,12 +217,21 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                 weight: jnp.ndarray, leaf_of_row: jnp.ndarray,
                 num_leaves: int, num_bins: int,
                 interpret: bool = False,
-                true_shape=None) -> jnp.ndarray:
-    """(3, L, F, B) float32 histogram via the Pallas MXU kernel.
+                true_shape=None,
+                count_values=None) -> jnp.ndarray:
+    """(3, L, F, B) histogram via the Pallas MXU kernel.
 
     ``bins`` is features-major (F, N) — consumed directly, no transpose.
     Same contract as histogram.build_histogram's other methods; rows
     with weight 0 (padding/bagging) contribute nothing.
+
+    Float32 by default. Quantized mode (integer grad/hess from
+    tree.py's hist_bits < 32 rounding): the stats block and the bin
+    one-hot stay in the NARROW int dtype and the MXU accumulates int32
+    via ``preferred_element_type`` — the same i8->i32 lowering the
+    quantized inference kernels use — returning an exact (3, L, F, B)
+    int32 histogram. ``count_values`` then carries the quantized
+    per-row weight for the count channel (None keeps c = sum(weight)).
 
     ``true_shape=(f, n)`` marks ``bins`` as ALREADY padded to
     padded_bins_shape(f, n, ...): the per-call full-matrix pad is then
@@ -238,22 +259,24 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         grad = jnp.pad(grad, (0, stat_pad))
         hess = jnp.pad(hess, (0, stat_pad))
         weight = jnp.pad(weight, (0, stat_pad))   # 0-weight padding
+        if count_values is not None:
+            count_values = jnp.pad(count_values, (0, stat_pad))
         if not nibble:                 # nibble kernel is single-leaf
             leaf_of_row = jnp.pad(leaf_of_row, (0, stat_pad))
 
     if nibble:
         return _hist_pallas_nibble(bins, grad, hess, weight, f, n,
-                                   num_bins, b_pad, c, fc, interpret)
+                                   num_bins, b_pad, c, fc, interpret,
+                                   count_values=count_values)
     f_p, n_p = bins.shape
 
-    stats = jnp.stack([grad * weight, hess * weight, weight],
-                      axis=0).astype(jnp.float32)        # (3, N_p)
+    stats, acc_dtype = _stats_block(grad, hess, weight, count_values)
     leaf2 = leaf_of_row.astype(jnp.int32)[None, :]       # (1, N_p)
 
     grid = (f_p // fc, n_p // c)
     out = pl.pallas_call(
         functools.partial(_hist_kernel, num_leaves=num_leaves,
-                          num_bins=b_pad),
+                          num_bins=b_pad, acc_dtype=acc_dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((fc, c), lambda fi, ri: (fi, ri)),
@@ -263,7 +286,7 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         out_specs=pl.BlockSpec((3 * num_leaves, fc * b_pad),
                                lambda fi, ri: (0, fi)),
         out_shape=jax.ShapeDtypeStruct(
-            (3 * num_leaves, f_p * b_pad), jnp.float32),
+            (3 * num_leaves, f_p * b_pad), acc_dtype),
         interpret=interpret,
     )(bins, stats, leaf2)
 
@@ -274,8 +297,27 @@ def hist_pallas(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     return hist
 
 
+def _stats_block(grad, hess, weight, count_values):
+    """(3, N) stats block + MXU accumulator dtype. Float32 inputs take
+    the classic path (bit-identical to HEAD). Integer grad/hess
+    (quantized training) keep the block in the narrow wire dtype —
+    weight is then the 0/1 row mask and count_values the quantized
+    per-row weight — and accumulate exactly in int32."""
+    if jnp.issubdtype(grad.dtype, jnp.integer):
+        sdt = grad.dtype
+        w = weight.astype(sdt)
+        cv = w if count_values is None \
+            else count_values.astype(sdt) * w
+        stats = jnp.stack([grad * w, hess.astype(sdt) * w, cv], axis=0)
+        return stats, jnp.int32
+    cw = weight if count_values is None else count_values * weight
+    stats = jnp.stack([grad * weight, hess * weight, cw],
+                      axis=0).astype(jnp.float32)
+    return stats, jnp.float32
+
+
 def _hist_pallas_nibble(bins, grad, hess, weight, f, n, num_bins,
-                        b_pad, c, fc, interpret):
+                        b_pad, c, fc, interpret, count_values=None):
     """Single-leaf histogram through the digit-decomposition kernel.
     The tiny per-step VMEM footprint (no (fc*B, C) one-hot block) lets
     row chunks grow to 8192, cutting grid-step count ~8x as well.
@@ -284,19 +326,19 @@ def _hist_pallas_nibble(bins, grad, hess, weight, f, n, num_bins,
     h, l = _nibble_hl(b_pad)
     f_p, n_p = bins.shape
 
-    stats = jnp.stack([grad * weight, hess * weight, weight],
-                      axis=0).astype(jnp.float32)        # (3, N_p)
+    stats, acc_dtype = _stats_block(grad, hess, weight, count_values)
 
     grid = (f_p // fc, n_p // c)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel_nibble, h=h, l=l),
+        functools.partial(_hist_kernel_nibble, h=h, l=l,
+                          acc_dtype=acc_dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((fc, c), lambda fi, ri: (fi, ri)),
             pl.BlockSpec((3, c), lambda fi, ri: (0, ri)),
         ],
         out_specs=pl.BlockSpec((3 * h, fc * l), lambda fi, ri: (0, fi)),
-        out_shape=jax.ShapeDtypeStruct((3 * h, f_p * l), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((3 * h, f_p * l), acc_dtype),
         interpret=interpret,
     )(bins, stats)
 

@@ -43,6 +43,17 @@ class GrowParams(NamedTuple):
     min_gain_to_split: float = 0.0
     hist_method: str = "scatter"
     voting_k: int = 20   # tree_learner='voting' candidates per worker
+    # quantized-histogram training (Shi et al., NeurIPS'22): 32 = f32
+    # (bit-identical to the classic path), 16/8 = stochastic-rounded
+    # integer gradients, exact int32 histogram accumulation, one
+    # dequantize at split-gain time, int16 collective wire
+    hist_bits: int = 32
+    # data-parallel histogram collective: 'psum' allreduces the full
+    # (3, F, B) tensor; 'reduce_scatter' gives each device ownership of
+    # F/n_shards features' slices (LightGBM's reduce-scatter recipe,
+    # Ke et al. NeurIPS'17) — O(F*B/D) wire per split instead of O(F*B)
+    hist_comm: str = "psum"
+    n_shards: int = 1    # mesh axis size (static: jax has no axis_size)
 
 
 class Tree(NamedTuple):
@@ -148,7 +159,8 @@ def _split_gain(g, h, l1, l2):
 def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
               weight: jnp.ndarray, feature_mask: jnp.ndarray,
               p: GrowParams, axis_name: Optional[str] = None,
-              parallel_mode: str = "data"):
+              parallel_mode: str = "data",
+              quant_key: Optional[jnp.ndarray] = None):
     """Grow one tree; returns (Tree, leaf_of_row, leaf_values_per_slot).
 
     bins is FEATURES-MAJOR (F, N) int32 — row-major (N, F) would make
@@ -195,6 +207,33 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
       (devices·k >= F with k < F is NOT sufficient: workers' top-k
       votes can overlap, shrinking the union below F and possibly
       missing the true best split.)
+
+    Quantized training (``p.hist_bits`` in {16, 8}; Shi et al.,
+    *Quantized Training of GBDT*, NeurIPS'22): per-round gradients /
+    hessians / weights are discretized ONCE per tree to narrow ints by
+    DETERMINISTIC stochastic rounding — counter-based uniforms keyed by
+    ``quant_key`` and the GLOBAL row index, so serial and sharded runs
+    round identically — under a global-L1 scale
+    ``delta = sum(|stat|) / Q`` (``Q = 2^(bits-2)``, psum'd when rows
+    are sharded). The global-L1 scale is what makes the narrow wire
+    safe: EVERY subset sum of quantized values is bounded by
+    Q + O(sqrt(N)) rounding noise, so int16 holds any histogram bin /
+    partial reduction at both bit widths. Histograms accumulate as
+    exact int32 (i8->i32 MXU lowering in the Pallas path), sibling
+    subtraction and bin cumsums stay in exact integer arithmetic —
+    collective association CANNOT flip near-ties — and the single
+    dequantize (* delta) happens at split-gain time.
+
+    ``p.hist_comm='reduce_scatter'`` (data-parallel only): instead of
+    psum'ing the full (3, F, B) histogram everywhere, each of the
+    ``p.n_shards`` devices reduce-scatters into ownership of a
+    contiguous F/D feature slice (plus one psum'd feature-0 slice that
+    carries the leaf totals in data-parallel's exact association
+    order), computes best splits for owned features locally, and only
+    the (D, 4) candidate table all_gathers — O(F·B/D) wire per split.
+    Winner selection reproduces psum's argmax tie-break exactly: the
+    feature partition is contiguous in device order, so local-argmax +
+    lowest-winning-device picks the globally lowest (feature, bin).
     """
     f, n = bins.shape
     L = p.num_leaves
@@ -202,12 +241,78 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
     B = p.num_bins
     feat_par = parallel_mode == "feature" and axis_name is not None
     voting = parallel_mode == "voting" and axis_name is not None
-    # voting keeps histograms LOCAL too — only candidate slices psum
-    hist_axis = None if (feat_par or voting) else axis_name
+    quantized = p.hist_bits < 32
+    rs = (p.hist_comm == "reduce_scatter" and axis_name is not None
+          and parallel_mode == "data")
+    if p.hist_comm not in ("psum", "reduce_scatter"):
+        raise ValueError(f"unknown hist_comm={p.hist_comm!r}; "
+                         "expected 'psum' or 'reduce_scatter'")
+    if p.hist_comm == "reduce_scatter" and (feat_par or voting):
+        raise ValueError(
+            "hist_comm='reduce_scatter' is a data-parallel recipe; "
+            f"parallel_mode={parallel_mode!r} already keeps histograms "
+            "local (feature/voting) — use hist_comm='psum'")
+    if quantized:
+        if p.hist_bits not in (16, 8):
+            raise ValueError(
+                f"hist_bits={p.hist_bits} is not supported: use 32 "
+                "(f32), 16 or 8 (quantized stochastic rounding)")
+        if feat_par:
+            raise ValueError(
+                "hist_bits < 32 with parallel_mode='feature' is not "
+                "supported: feature-parallel histograms never cross "
+                "the wire, so quantization only adds rounding noise")
+        if quant_key is None:
+            raise ValueError(
+                "hist_bits < 32 requires quant_key (per-round PRNG key "
+                "for deterministic stochastic rounding)")
+    # voting keeps histograms LOCAL too — only candidate slices psum;
+    # reduce_scatter runs its own collective inside leaf_hist
+    hist_axis = None if (feat_par or voting or rs) else axis_name
 
     min_hess = p.min_sum_hessian_in_leaf
     min_data = float(p.min_data_in_leaf)
     zero_leaf = jnp.zeros(n, dtype=jnp.int32)
+
+    # ---- quantization: discretize ONCE per tree (per boosting round) -
+    if quantized:
+        Q = 1 << (p.hist_bits - 2)
+        sdt = jnp.int8 if p.hist_bits == 8 else jnp.int16
+        gw = grad * weight
+        hw = hess * weight
+        # global-L1 scales: one stacked 3-scalar psum when rows sharded
+        scales = jnp.stack([jnp.sum(jnp.abs(gw)), jnp.sum(jnp.abs(hw)),
+                            jnp.sum(jnp.abs(weight))])
+        if axis_name is not None:
+            scales = lax.psum(scales, axis_name)
+        tiny = jnp.float32(1e-30)
+        dg = jnp.maximum(scales[0], tiny) / Q
+        dh = jnp.maximum(scales[1], tiny) / Q
+        dc = jnp.maximum(scales[2], tiny) / Q
+        row0 = (lax.axis_index(axis_name) * n
+                if axis_name is not None else 0)
+        row_ids = row0 + jnp.arange(n)
+
+        def _sround(vals, delta, chan):
+            """floor + Bernoulli(frac) with counter-based uniforms —
+            each (row, channel) draws the same uniform regardless of
+            shard layout or padding, so every topology rounds every row
+            identically (the bit-reproducibility contract)."""
+            x = vals / delta
+            fl = jnp.floor(x)
+            u = _index_uniforms(jax.random.fold_in(quant_key, chan),
+                                row_ids)
+            return (fl + (u < (x - fl))).astype(sdt)
+
+        qg = _sround(gw, dg, 0)
+        qh = _sround(hw, dh, 1)
+        qc = _sround(weight, dc, 2)   # 0-weight rows quantize to 0
+
+    # ---- reduce-scatter feature partition geometry ------------------
+    if rs:
+        D = p.n_shards
+        Fp = -(-f // D) * D           # F padded to a multiple of D
+        fs = Fp // D                  # owned features per device
 
     # the split loop builds one histogram per split on the SAME bins:
     # pre-pad once to the Pallas kernel's block multiples so the
@@ -225,12 +330,35 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         hist_true_shape = None
 
     def leaf_hist(mask_weight):
-        """(3, F, B) histogram of the rows selected by mask_weight."""
-        h = build_histogram(bins_hist, grad, hess, mask_weight,
-                            zero_leaf, 1, B, method=p.hist_method,
-                            axis_name=hist_axis,
-                            true_shape=hist_true_shape)  # (3, 1, F, B)
-        return h[:, 0]
+        """Histogram of the rows selected by mask_weight: (3, F, B) f32
+        (classic), int32 (quantized — mask_weight is then the 0/1 row
+        indicator; the weight lives inside qg/qh/qc), or (3, fs+1, B)
+        under reduce_scatter (owned feature slices + the psum'd
+        feature-0 slice whose bin sums are the leaf totals in the psum
+        oracle's exact association order)."""
+        if quantized:
+            h = build_histogram(bins_hist, qg, qh, mask_weight,
+                                zero_leaf, 1, B, method=p.hist_method,
+                                axis_name=hist_axis,
+                                true_shape=hist_true_shape,
+                                count_values=qc,
+                                wire_dtype=jnp.int16)[:, 0]
+        else:
+            h = build_histogram(bins_hist, grad, hess, mask_weight,
+                                zero_leaf, 1, B, method=p.hist_method,
+                                axis_name=hist_axis,
+                                true_shape=hist_true_shape)[:, 0]
+        if rs:
+            wire = h.astype(jnp.int16) if quantized else h
+            tot0 = lax.psum(wire[:, 0, :], axis_name)       # (3, B)
+            wire_p = jnp.pad(wire, ((0, 0), (0, Fp - f), (0, 0)))
+            owned = lax.psum_scatter(wire_p, axis_name,
+                                     scatter_dimension=1,
+                                     tiled=True)            # (3, fs, B)
+            h = jnp.concatenate([owned, tot0[:, None, :]], axis=1)
+            if quantized:
+                h = h.astype(jnp.int32)
+        return h
 
     def best_split_voting(hist, depth_ok, hist_sub=None):
         """PV-tree split search: rank features by LOCAL gain, vote the
@@ -245,9 +373,15 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         """
         local = hist if hist_sub is None else hist - hist_sub
         Gh, Hh = local[0], local[1]                      # (F, B) LOCAL
-        Gt, Ht = Gh[0].sum(), Hh[0].sum()
-        GLl = jnp.cumsum(Gh, axis=-1)
-        HLl = jnp.cumsum(Hh, axis=-1)
+        if quantized:
+            # exact int cumsums, one dequantize at gain time
+            Gt, Ht = Gh[0].sum() * dg, Hh[0].sum() * dh
+            GLl = jnp.cumsum(Gh, axis=-1) * dg
+            HLl = jnp.cumsum(Hh, axis=-1) * dh
+        else:
+            Gt, Ht = Gh[0].sum(), Hh[0].sum()
+            GLl = jnp.cumsum(Gh, axis=-1)
+            HLl = jnp.cumsum(Hh, axis=-1)
         parent_l = _split_gain(Gt, Ht, p.lambda_l1, p.lambda_l2)
         gain_l = (_split_gain(GLl, HLl, p.lambda_l1, p.lambda_l2)
                   + _split_gain(Gt - GLl, Ht - HLl,
@@ -264,17 +398,36 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         # Σ_bin-of-Σ_dev totals match data-parallel's association order
         # exactly (psum'ing local Σ_bin totals would reassociate)
         sel = jnp.concatenate([cand, jnp.zeros(1, cand.dtype)])
+        # quantized candidates ride the NARROW int16 wire (the global-L1
+        # scale bounds every partial sum) and widen back to exact int32
         if hist_sub is None:
-            ps = lax.psum(hist[:, sel, :], axis_name)     # (3, C+1, B)
+            sl = hist[:, sel, :]
+            if quantized:
+                ps = lax.psum(sl.astype(jnp.int16), axis_name) \
+                    .astype(jnp.int32)
+            else:
+                ps = lax.psum(sl, axis_name)              # (3, C+1, B)
         else:
-            pair = lax.psum(jnp.stack(
-                [hist[:, sel, :], hist_sub[:, sel, :]]), axis_name)
+            pair = jnp.stack([hist[:, sel, :], hist_sub[:, sel, :]])
+            if quantized:
+                pair = lax.psum(pair.astype(jnp.int16), axis_name) \
+                    .astype(jnp.int32)
+            else:
+                pair = lax.psum(pair, axis_name)
             ps = pair[0] - pair[1]
         ch, tot = ps[:, :-1, :], ps[:, -1, :]             # global
-        G, H, C = tot[0].sum(), tot[1].sum(), tot[2].sum()
-        GL = jnp.cumsum(ch[0], axis=-1)
-        HL = jnp.cumsum(ch[1], axis=-1)
-        CL = jnp.cumsum(ch[2], axis=-1)
+        if quantized:
+            G = tot[0].sum() * dg
+            H = tot[1].sum() * dh
+            C = tot[2].sum() * dc
+            GL = jnp.cumsum(ch[0], axis=-1) * dg
+            HL = jnp.cumsum(ch[1], axis=-1) * dh
+            CL = jnp.cumsum(ch[2], axis=-1) * dc
+        else:
+            G, H, C = tot[0].sum(), tot[1].sum(), tot[2].sum()
+            GL = jnp.cumsum(ch[0], axis=-1)
+            HL = jnp.cumsum(ch[1], axis=-1)
+            CL = jnp.cumsum(ch[2], axis=-1)
         GR, HR, CR = G - GL, H - HL, C - CL
         parent_score = _split_gain(G, H, p.lambda_l1, p.lambda_l2)
         gain = (_split_gain(GL, HL, p.lambda_l1, p.lambda_l2)
@@ -305,25 +458,63 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         ``hist_sub`` (voting only): see best_split_voting."""
         if voting:
             return best_split_voting(hist, depth_ok, hist_sub)
-        Gh, Hh, Ch = hist[0], hist[1], hist[2]           # (F, B)
-        # any feature's bins partition all rows; feature 0's sums = totals
-        G, H, C = Gh[0].sum(), Hh[0].sum(), Ch[0].sum()
-        GL = jnp.cumsum(Gh, axis=-1)                     # (F, B)
-        HL = jnp.cumsum(Hh, axis=-1)
-        CL = jnp.cumsum(Ch, axis=-1)
+        if rs:
+            # owned feature slices; the appended [-1] slice is the
+            # psum'd global feature-0 histogram → exact leaf totals
+            Gh, Hh, Ch = hist[0, :-1], hist[1, :-1], hist[2, :-1]
+            tot = hist[:, -1, :]                         # (3, B) global
+            t_g, t_h, t_c = tot[0].sum(), tot[1].sum(), tot[2].sum()
+        else:
+            Gh, Hh, Ch = hist[0], hist[1], hist[2]       # (F, B)
+            # any feature's bins partition all rows; feature 0's
+            # sums = totals
+            t_g, t_h, t_c = Gh[0].sum(), Hh[0].sum(), Ch[0].sum()
+        if quantized:
+            # exact int cumsums; ONE dequantize at split-gain time
+            G, H, C = t_g * dg, t_h * dh, t_c * dc
+            GL = jnp.cumsum(Gh, axis=-1) * dg            # (F, B)
+            HL = jnp.cumsum(Hh, axis=-1) * dh
+            CL = jnp.cumsum(Ch, axis=-1) * dc
+        else:
+            G, H, C = t_g, t_h, t_c
+            GL = jnp.cumsum(Gh, axis=-1)                 # (F, B)
+            HL = jnp.cumsum(Hh, axis=-1)
+            CL = jnp.cumsum(Ch, axis=-1)
         GR, HR, CR = G - GL, H - HL, C - CL
         parent_score = _split_gain(G, H, p.lambda_l1, p.lambda_l2)
         gain = (_split_gain(GL, HL, p.lambda_l1, p.lambda_l2)
                 + _split_gain(GR, HR, p.lambda_l1, p.lambda_l2)
                 - parent_score)
+        if rs:
+            # every device masks with ITS owned window of the global
+            # feature mask (padded slots → phantom features blocked)
+            fm = lax.dynamic_slice_in_dim(
+                jnp.pad(feature_mask, (0, Fp - f)),
+                lax.axis_index(axis_name) * fs, fs)
+        else:
+            fm = feature_mask
         ok = ((CL >= min_data) & (CR >= min_data)
               & (HL >= min_hess) & (HR >= min_hess)
-              & (feature_mask[:, None] > 0) & depth_ok)
+              & (fm[:, None] > 0) & depth_ok)
         gain = jnp.where(ok, gain, NEG_INF)
         flat = jnp.argmax(gain)
         bf, bb = jnp.unravel_index(flat, gain.shape)
         gain_v, cl_v = gain.reshape(-1)[flat], CL[bf, bb]
         bf, bb = bf.astype(jnp.int32), bb.astype(jnp.int32)
+        if rs:
+            # LightGBM's split-communication step: each device proposes
+            # its owned-slice winner, the tiny (D, 4) table all_gathers,
+            # every device argmaxes the same table. The partition is
+            # contiguous in device order and argmax takes the FIRST
+            # max, so ties resolve to the globally lowest (feature,
+            # bin) — exactly the psum oracle's flat-argmax tie-break.
+            bf_g = lax.axis_index(axis_name) * fs + bf
+            cand = jnp.stack([gain_v, bf_g.astype(jnp.float32),
+                              bb.astype(jnp.float32), cl_v])
+            allc = lax.all_gather(cand, axis_name)       # (D, 4)
+            win = jnp.argmax(allc[:, 0])
+            return (allc[win, 0], allc[win, 1].astype(jnp.int32),
+                    allc[win, 2].astype(jnp.int32), allc[win, 3], C)
         if feat_par:
             # exchange candidates; every device argmaxes the same table
             # so split decisions stay identical (tie → lowest device id)
@@ -348,8 +539,10 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         return (leaf_of_row == bl) & (bins[bf] > bb)
 
     # root: slot 0 holds all rows (its children sit at depth 1, legal for
-    # any max_depth >= 1, so the root's candidate is never depth-blocked)
-    root_hist = leaf_hist(weight)
+    # any max_depth >= 1, so the root's candidate is never depth-blocked).
+    # Quantized mode selects with a 0/1 int mask — the row weight is
+    # already inside qg/qh/qc (0-weight rows quantized to exactly 0).
+    root_hist = leaf_hist(jnp.ones(n, sdt) if quantized else weight)
     g0, f0, b0, cl0, c0 = best_split(root_hist, jnp.bool_(True))
     state = dict(
         leaf_of_row=zero_leaf,
@@ -367,7 +560,10 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         leaf_to_node=jnp.zeros(L, dtype=jnp.int32),
         leaf_depth=jnp.zeros(L, dtype=jnp.int32),
         # per-leaf histogram cache + cached best candidate split
-        hist_cache=jnp.zeros((L, 3, f, B), jnp.float32).at[0].set(root_hist),
+        # (shape/dtype follow the histogram contract: int32 quantized,
+        # (3, fs+1, B) owned-slices+totals under reduce_scatter)
+        hist_cache=jnp.zeros((L,) + root_hist.shape,
+                             root_hist.dtype).at[0].set(root_hist),
         best_gain=jnp.full(L, NEG_INF, jnp.float32).at[0].set(g0),
         best_feat=jnp.zeros(L, jnp.int32).at[0].set(f0),
         best_bin=jnp.zeros(L, jnp.int32).at[0].set(b0),
@@ -390,8 +586,12 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
                                  st["leaf_of_row"])
 
         # one masked single-leaf histogram for the right child; the left
-        # sibling is parent - right (the LightGBM subtraction trick)
-        mask_w = weight * (leaf_of_row2 == new_leaf) * do
+        # sibling is parent - right (the LightGBM subtraction trick —
+        # exact in int32 when quantized, so association cannot flip ties)
+        if quantized:
+            mask_w = ((leaf_of_row2 == new_leaf) & do).astype(sdt)
+        else:
+            mask_w = weight * (leaf_of_row2 == new_leaf) * do
         hist_r = leaf_hist(mask_w)
         hist_l = st["hist_cache"][bl] - hist_r
 
@@ -468,8 +668,12 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
         g_leaf = jax.ops.segment_sum(grad * weight, seg, num_segments=L)
         h_leaf = jax.ops.segment_sum(hess * weight, seg, num_segments=L)
     else:
-        g_leaf = st["hist_cache"][:, 0, 0, :].sum(-1)
-        h_leaf = st["hist_cache"][:, 1, 0, :].sum(-1)
+        # reduce_scatter caches carry the psum'd global feature-0
+        # histogram in the appended [-1] slice; psum-mode caches hold
+        # it at feature index 0
+        fslot = -1 if rs else 0
+        g_leaf = st["hist_cache"][:, 0, fslot, :].sum(-1)
+        h_leaf = st["hist_cache"][:, 1, fslot, :].sum(-1)
         if voting:
             # voting keeps cached histograms LOCAL (only candidate
             # slices psum during splits); leaf totals must allreduce.
@@ -477,6 +681,9 @@ def grow_tree(bins: jnp.ndarray, grad: jnp.ndarray, hess: jnp.ndarray,
             # psums) — summing again would double-count.
             g_leaf = lax.psum(g_leaf, axis_name)
             h_leaf = lax.psum(h_leaf, axis_name)
+        if quantized:
+            g_leaf = g_leaf * dg
+            h_leaf = h_leaf * dh
     leaf_values = _leaf_output(g_leaf, h_leaf, p.lambda_l1, p.lambda_l2)
     active = jnp.arange(L) < st["n_leaves"]
     leaf_values = jnp.where(active, leaf_values, 0.0)

@@ -7,9 +7,15 @@ Spawned N times by tests/test_multihost_fabric.py (and the bench.py
 with the bounded timeout and gloo CPU collectives — then runs the two
 fabric drills end-to-end:
 
-- PR 15's ``bin_fit='sketch'`` multi-host GBDT fit on disjoint streamed
-  row shards (forest must come out bit-identical on every host, and
-  bit-identical to the parent's single-group oracle replay);
+- PR 15's ``bin_fit='sketch'`` multi-host GBDT fit on disjoint row
+  shards streamed as an out-of-core Arrow ``ChunkedTable`` (the PR 18
+  ingest composed under a REAL process group): forest must come out
+  bit-identical on every host, and bit-identical to the parent's
+  single-group in-memory oracle replay;
+- the PR 19 quantized reduce-scatter drill: the SAME stream retrained
+  at ``hist_bits=16, hist_comm='reduce_scatter'`` — bit-identical
+  across hosts, and the modeled collective wire (``COMM`` lines) must
+  come out >=2x under the f32 psum run's;
 - a PR 14-shape explicit-shardings serving jit over the GLOBAL mesh
   (in_shardings/out_shardings declared, batch dim sharded across the
   processes' devices).
@@ -18,6 +24,15 @@ Usage::
 
     python multihost_worker.py <coordinator_port> <process_id> <nproc>
         [--timeout-s T] [--die-before-rendezvous]
+        [--bench-rows N --bench-feats F --bench-iters T
+         --hist-bits B --hist-comm C]
+
+With ``--bench-rows`` the fabric drills are replaced by ONE
+HIGGS-shaped training run at the given scale (bench.py's
+``gbdt_dist`` scenario): each host writes its row shard to an Arrow
+IPC file, streams it back as ChunkedTable chunks through sketch
+binning, trains data-parallel over the group, and prints ``BENCH``
+lines (per-phase walls, modeled comm bytes, peak RSS).
 
 ``--die-before-rendezvous`` makes a non-coordinator member exit before
 ever calling initialize() — the member-death drill: the SURVIVING member
@@ -44,6 +59,78 @@ from mmlspark_tpu.utils.jax_compat import set_cpu_device_count  # noqa: E402
 set_cpu_device_count(1)
 
 
+def _run_bench(pid: int, args) -> None:
+    """bench.py ``gbdt_dist`` payload: a HIGGS-shaped quantized
+    distributed training run at the requested scale. The local row
+    shard is staged to an Arrow IPC file and streamed back as
+    memory-mapped ChunkedTable chunks through sketch binning — the
+    raw f64 matrix never materializes — then trained data-parallel
+    over the REAL process group. Prints machine-parsable lines:
+
+        BENCH_PHASE <pid> <phase> <seconds>
+        BENCH_COMM <pid> <collective> <modeled_bytes>
+        BENCH_STAT <pid> <auc4> <raw_mb> <peak_chunk_mb> <maxrss_mb>
+    """
+    import resource
+    import tempfile
+
+    import numpy as np
+
+    from mmlspark_tpu.core.table import DataTable
+    from mmlspark_tpu.gbdt.booster import train as gbdt_train
+    from mmlspark_tpu.io.ooc import ChunkedTable, write_arrow_ipc
+
+    n, f = args.bench_rows, args.bench_feats
+    rng = np.random.default_rng(100 + pid)    # disjoint per-host rows
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = (X[:, 0] + 0.6 * X[:, 1] * X[:, 2]
+             + 0.4 * np.sin(2 * X[:, 3]) + 0.3)
+    y = (logit + rng.normal(scale=0.5, size=n) > 0
+         ).astype(np.float32)
+    raw_mb = X.nbytes / 2 ** 20
+    with tempfile.NamedTemporaryFile(suffix=".arrow",
+                                     delete=False) as tf:
+        path = tf.name
+    try:
+        write_arrow_ipc(DataTable({"features": X, "label": y}), path,
+                        chunk_rows=max(1, n // 64))
+        del X
+        ct = ChunkedTable.from_arrow_ipc(path,
+                                         chunk_rows=max(1, n // 64))
+        booster = gbdt_train(
+            {"objective": "binary",
+             "num_iterations": args.bench_iters, "num_leaves": 31,
+             "max_bin": 63, "parallelism": "data",
+             "hist_method": "scatter", "bin_fit": "sketch",
+             "hist_bits": args.hist_bits, "hist_comm": args.hist_comm},
+            ct)
+        for phase, secs in booster.train_timing.items():
+            print(f"BENCH_PHASE {pid} {phase} {secs}", flush=True)
+        for coll, nb in booster.train_info.get(
+                "comm_bytes", {}).items():
+            print(f"BENCH_COMM {pid} {coll} {nb}", flush=True)
+        # holdout AUC on fresh rows from the same generator family
+        ho = np.random.default_rng(999)
+        Xh = ho.normal(size=(4096, f)).astype(np.float32)
+        lh = (Xh[:, 0] + 0.6 * Xh[:, 1] * Xh[:, 2]
+              + 0.4 * np.sin(2 * Xh[:, 3]) + 0.3)
+        yh = (lh + ho.normal(scale=0.5, size=4096) > 0)
+        p = booster.predict(Xh)
+        order = np.argsort(p, kind="stable")
+        ranks = np.empty(len(p))
+        ranks[order] = np.arange(1, len(p) + 1)
+        npos = int(yh.sum())
+        auc = (ranks[yh].sum() - npos * (npos + 1) / 2) / max(
+            npos * (len(yh) - npos), 1)
+        peak_mb = ct.stats.snapshot()["tracked_peak_bytes"] / 2 ** 20
+        rss_mb = resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1024
+        print(f"BENCH_STAT {pid} {auc:.4f} {raw_mb:.1f} "
+              f"{peak_mb:.1f} {rss_mb:.1f}", flush=True)
+    finally:
+        os.unlink(path)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("port", type=int)
@@ -51,6 +138,12 @@ def main() -> None:
     ap.add_argument("nproc", type=int)
     ap.add_argument("--timeout-s", type=float, default=60.0)
     ap.add_argument("--die-before-rendezvous", action="store_true")
+    ap.add_argument("--bench-rows", type=int, default=0,
+                    help="rows per host; >0 switches to bench mode")
+    ap.add_argument("--bench-feats", type=int, default=28)
+    ap.add_argument("--bench-iters", type=int, default=10)
+    ap.add_argument("--hist-bits", type=int, default=16)
+    ap.add_argument("--hist-comm", default="auto")
     args = ap.parse_args()
     pid, nproc = args.process_id, args.nproc
 
@@ -80,25 +173,45 @@ def main() -> None:
 
     import numpy as np
 
+    from mmlspark_tpu.core.table import DataTable
     from mmlspark_tpu.gbdt.booster import train as gbdt_train
+    from mmlspark_tpu.io.ooc import ChunkedTable
 
-    # -- drill 1: multi-host sketch-binned GBDT on disjoint row shards.
-    # Every host streams its LOCAL 200 rows as two replayable chunks;
-    # bin boundaries are agreed through the allgathered quantile-sketch
-    # summaries; histograms psum over the global mesh. The forest must
-    # be bit-identical on every host AND to the parent's single-group
-    # oracle (same merged sketches, same global row order).
+    if args.bench_rows > 0:
+        _run_bench(pid, args)
+        print(f"OK {pid}", flush=True)
+        return
+
+    def _comm_line(tag, booster):
+        cb = booster.train_info.get("comm_bytes", {})
+        print(f"COMM {pid} {tag} {cb.get('psum', 0)} "
+              f"{cb.get('psum_scatter', 0)} {cb.get('all_gather', 0)}",
+              flush=True)
+
+    # -- drill 1: multi-host sketch-binned GBDT on disjoint row shards,
+    # streamed through the out-of-core ChunkedTable ingest (PR 18's
+    # path composed under a REAL group). Every host replays its LOCAL
+    # 200 rows as two 100-row chunks; bin boundaries are agreed through
+    # the allgathered quantile-sketch summaries; histograms psum over
+    # the global mesh. The forest must be bit-identical on every host
+    # AND to the parent's single-group in-memory oracle (same merged
+    # sketches, same global row order).
     grng = np.random.default_rng(11)
     GX = grng.normal(size=(400, 6))
     GY = (GX[:, 0] + 0.5 * GX[:, 1] > 0).astype(float)
     lo, hi = pid * 200, (pid + 1) * 200
-    shards = [(GX[lo:lo + 100], GY[lo:lo + 100]),
-              (GX[lo + 100:hi], GY[lo + 100:hi])]
+
+    def _local_chunks():
+        for k in (lo, lo + 100):
+            yield DataTable({"features": GX[k:k + 100],
+                             "label": GY[k:k + 100]})
+
+    base_params = {
+        "objective": "binary", "num_iterations": 5, "num_leaves": 7,
+        "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "data",
+        "hist_method": "scatter", "bin_fit": "sketch"}
     booster = gbdt_train(
-        {"objective": "binary", "num_iterations": 5, "num_leaves": 7,
-         "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "data",
-         "hist_method": "scatter", "bin_fit": "sketch"},
-        shards)
+        base_params, ChunkedTable.from_generator(_local_chunks))
     digest = hashlib.sha256(
         booster.model_to_string().encode()).hexdigest()[:16]
     bin_digest = hashlib.sha256(
@@ -107,6 +220,20 @@ def main() -> None:
     ).hexdigest()[:16]
     acc_ok = int(np.mean((booster.predict(GX) > 0.5) == GY) > 0.9)
     print(f"DIGEST {pid} {digest} {bin_digest} {acc_ok}", flush=True)
+    _comm_line("f32", booster)
+
+    # -- drill 1b: the SAME stream retrained on the quantized
+    # reduce-scatter engine (PR 19). Integer histogram accumulation
+    # makes the forest exactly reproducible across the group, and the
+    # modeled wire must come out >=2x under the f32 psum run's.
+    qbooster = gbdt_train(
+        {**base_params, "hist_bits": 16, "hist_comm": "reduce_scatter"},
+        ChunkedTable.from_generator(_local_chunks))
+    qdigest = hashlib.sha256(
+        qbooster.model_to_string().encode()).hexdigest()[:16]
+    qacc_ok = int(np.mean((qbooster.predict(GX) > 0.5) == GY) > 0.9)
+    print(f"QDIGEST {pid} {qdigest} {qacc_ok}", flush=True)
+    _comm_line("q16", qbooster)
 
     # -- drill 2: explicit-shardings serving jit UNDER the group (the
     # PR 14 jit shape: shardings declared, never inferred) — the linear

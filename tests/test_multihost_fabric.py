@@ -74,14 +74,21 @@ def _single_group_oracle():
 
     shards = [(GX[k:k + 100], GY[k:k + 100]) for k in range(0, 400, 100)]
     mesh = mesh_lib.make_mesh({"data": 2}, devices=jax.devices()[:2])
-    booster = gbdt_train(
-        {"objective": "binary", "num_iterations": 5, "num_leaves": 7,
-         "max_bin": 15, "min_data_in_leaf": 5, "parallelism": "data",
-         "hist_method": "scatter", "bin_fit": "sketch"},
-        shards, bin_mapper=mapper, mesh=mesh)
+    params = {"objective": "binary", "num_iterations": 5,
+              "num_leaves": 7, "max_bin": 15, "min_data_in_leaf": 5,
+              "parallelism": "data", "hist_method": "scatter",
+              "bin_fit": "sketch"}
+    booster = gbdt_train(params, shards, bin_mapper=mapper, mesh=mesh)
     forest_digest = hashlib.sha256(
         booster.model_to_string().encode()).hexdigest()[:16]
-    return forest_digest, bin_digest
+    # the quantized reduce-scatter oracle: integer histograms make the
+    # 2-device local replay exactly the 2-process group's arithmetic
+    qbooster = gbdt_train(
+        {**params, "hist_bits": 16, "hist_comm": "reduce_scatter"},
+        shards, bin_mapper=mapper, mesh=mesh)
+    q_digest = hashlib.sha256(
+        qbooster.model_to_string().encode()).hexdigest()[:16]
+    return forest_digest, bin_digest, q_digest
 
 
 class TestProcessGroupDrill:
@@ -104,6 +111,7 @@ class TestProcessGroupDrill:
             pytest.fail(f"fabric workers hung; partial: {outs}")
 
         digests, bins, jits, totals = {}, {}, {}, {}
+        qdigests, comm = {}, {}
         for rc, out, err in outs:
             assert rc == 0, f"worker failed (rc={rc}):\n{out}\n{err}"
             assert "OK" in out, out
@@ -113,6 +121,14 @@ class TestProcessGroupDrill:
                     digests[int(pid)] = digest
                     bins[int(pid)] = bdig
                     assert acc_ok == "1", line
+                if line.startswith("QDIGEST"):
+                    _, pid, qdig, qacc_ok = line.split()
+                    qdigests[int(pid)] = qdig
+                    assert qacc_ok == "1", line
+                if line.startswith("COMM"):
+                    _, pid, tag, ps, rs, ag = line.split()
+                    comm[(int(pid), tag)] = (float(ps) + float(rs)
+                                             + float(ag))
                 if line.startswith("SERVEJIT"):
                     _, pid, ok, total = line.split()
                     jits[int(pid)] = ok
@@ -121,18 +137,28 @@ class TestProcessGroupDrill:
         assert len(digests) == 2 and len(set(digests.values())) == 1, \
             digests
         assert len(set(bins.values())) == 1, bins
+        # PR 19: the quantized reduce-scatter forest is also
+        # bit-identical across the group...
+        assert len(qdigests) == 2 \
+            and len(set(qdigests.values())) == 1, qdigests
+        # ... and its modeled collective wire is >=2x under f32 psum's
+        for pid in (0, 1):
+            assert comm[(pid, "f32")] >= 2.0 * comm[(pid, "q16")], comm
         # explicit-shardings jit ran under the group on every member,
         # and both members fetched the same replicated global reduction
         assert jits == {0: "1", 1: "1"}, jits
         assert len(set(totals.values())) == 1, totals
         # ... and bit-identical to the single-group oracle (pinned)
-        oracle_forest, oracle_bins = _single_group_oracle()
+        oracle_forest, oracle_bins, oracle_q = _single_group_oracle()
         assert bins[0] == oracle_bins, (
             "multi-host agreed sketch cuts differ from the single-group "
             "merged-sketch oracle")
         assert digests[0] == oracle_forest, (
             "multi-host sketch-binned forest is not bit-identical to "
             "the single-group oracle")
+        assert qdigests[0] == oracle_q, (
+            "multi-host quantized reduce-scatter forest is not "
+            "bit-identical to the single-group oracle")
 
     def test_member_death_raises_cleanly_within_timeout(self):
         """Member death during rendezvous: the survivor gets a clean

@@ -819,3 +819,38 @@ class TestFabricFloors:
             f"multi-host sketch-GBDT fit wall floor: {wall:.1f}s on "
             f"{info.process_count} processes (bench.py fabric measured "
             f"~10s spawn-to-OK for the whole 2-process drill)")
+
+    def test_quantized_gbdt_comm_bytes_floor_in_process_group(self):
+        """PR 19 wire floor: hist_bits=16 + reduce_scatter must model
+        >=2x fewer collective bytes than the f32 psum engine on the
+        SAME distributed fit (BENCH_r19.json measures ~3.7x; the int16
+        wire alone is 2x and the feature partition pays the rest)."""
+        from mmlspark_tpu.parallel import distributed as dist
+        if not dist.in_process_group():
+            pytest.skip("comm-bytes floor needs process_count >= 2 "
+                        "(a live jax.distributed group); single-process "
+                        "tier-1 pins the same floor via the COMM lines "
+                        "of the 2-process spawn drill in "
+                        "tests/test_multihost_fabric.py")
+        from mmlspark_tpu.gbdt.booster import train as gbdt_train
+
+        info = dist.host_info()
+        assert info.process_count >= 2, info
+        rows_per_host = 400 // info.process_count
+        grng = np.random.default_rng(11)
+        GX = grng.normal(size=(400, 6))
+        GY = (GX[:, 0] + 0.5 * GX[:, 1] > 0).astype(float)
+        lo = info.process_index * rows_per_host
+        shards = [(GX[lo:lo + rows_per_host],
+                   GY[lo:lo + rows_per_host])]
+        kw = {"objective": "binary", "num_iterations": 5,
+              "num_leaves": 7, "max_bin": 15, "min_data_in_leaf": 5,
+              "parallelism": "data", "hist_method": "scatter",
+              "bin_fit": "sketch"}
+        totals = {}
+        for tag, extra in (("f32", {}),
+                           ("q16", {"hist_bits": 16,
+                                    "hist_comm": "reduce_scatter"})):
+            b = gbdt_train({**kw, **extra}, shards)
+            totals[tag] = sum(b.train_info["comm_bytes"].values())
+        assert totals["f32"] >= 2.0 * totals["q16"], totals

@@ -86,9 +86,13 @@ def _kernel_sources() -> List[Tuple[str, str, int, List[str]]]:
 
 
 def is_quantized_kernel(name: str) -> bool:
-    """Whether the f64-upcast rule applies: quantize.py helpers and the
-    int8 variants of the stage device ops."""
-    return ":int8" in name or name.startswith("quantize.")
+    """Whether the f64-upcast rule applies: quantize.py helpers, the
+    int8 variants of the stage device ops, and the quantized-histogram
+    GBDT kernels (hist_bits<32 — integer accumulation must stay
+    integer; a silent f64 upcast would both waste the narrow wire and
+    break the exact-int reassociation-invariance contract)."""
+    return (":int8" in name or name.startswith("quantize.")
+            or name.startswith("gbdt.quanthist."))
 
 
 def _check_source(name: str, src: str, first: int,
@@ -915,7 +919,22 @@ def register_known_callees() -> int:
     QZ._register_audit_kernels()
     register_kernel(QZ.QuantizedFlaxApply.__call__,
                     "quantize.QuantizedFlaxApply.__call__")
-    return count + 3
+    count += 3
+    # quantized-histogram GBDT kernels (hist_bits<32): audited for host
+    # syncs like every kernel AND for silent f64 upcasts — integer
+    # histogram accumulation is the reassociation-invariance contract
+    from mmlspark_tpu.gbdt import histogram as HIST
+    from mmlspark_tpu.gbdt import pallas_hist as PH
+    for fn, qname in (
+            (HIST.build_histogram, "gbdt.quanthist.build_histogram"),
+            (HIST._hist_scatter, "gbdt.quanthist.hist_scatter"),
+            (PH._stats_block, "gbdt.quanthist.stats_block"),
+            (PH._hist_kernel, "gbdt.quanthist.hist_kernel"),
+            (PH._hist_kernel_nibble, "gbdt.quanthist.hist_kernel_nibble"),
+    ):
+        register_kernel(fn, qname)
+        count += 1
+    return count
 
 
 def register_representative_pipelines() -> int:
